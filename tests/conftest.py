@@ -1,0 +1,43 @@
+"""Test configuration.
+
+JAX-dependent tests run on a virtual 8-device CPU mesh so multi-chip sharding
+is exercised without TPU hardware (the driver separately dry-run-compiles the
+multi-chip path; see __graft_entry__.py).  The env vars must be set before jax
+is first imported, hence here at conftest import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Minimal async test support (pytest-asyncio is not in the image): run any
+# coroutine test function on a fresh event loop.
+import asyncio
+import inspect
+
+import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: coroutine test (run via asyncio.run)")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None

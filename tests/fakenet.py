@@ -1,0 +1,156 @@
+"""In-memory fake peer network.
+
+Port of the reference's test seam (/root/reference/test/Haskoin/NodeSpec.hs:
+``dummyPeerConnect`` :94-133 and ``mockPeerReact`` :135-147): the node's
+transport hook is replaced with an in-memory duplex pipe; a background task
+speaks the real wire format — it sends ``version`` first, then decodes frames
+with the same 24-byte-header algorithm as production and replies from a
+scripted protocol brain (ping->pong, version->verack, getheaders->the canned
+chain, getdata->matching canned blocks).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import random
+import time
+
+from tpunode.params import NODE_NETWORK, Network
+from tpunode.util import Reader
+from tpunode.wire import (
+    Block,
+    HEADER_SIZE,
+    InvType,
+    MsgBlock,
+    MsgGetData,
+    MsgGetHeaders,
+    MsgHeaders,
+    MsgPing,
+    MsgPong,
+    MsgVerAck,
+    MsgVersion,
+    NetworkAddress,
+    decode_message,
+    decode_message_header,
+    encode_message,
+)
+
+
+class QueueConnection:
+    """One side of an in-memory duplex byte pipe."""
+
+    def __init__(self, inbound: asyncio.Queue, outbound: asyncio.Queue):
+        self._in = inbound
+        self._out = outbound
+
+    async def read_chunk(self) -> bytes:
+        return await self._in.get()
+
+    async def write(self, data: bytes) -> None:
+        self._out.put_nowait(bytes(data))
+
+
+class _QueueReader:
+    def __init__(self, q: asyncio.Queue):
+        self._q = q
+        self._buf = bytearray()
+
+    async def read_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = await self._q.get()
+            if not chunk:
+                raise EOFError
+            self._buf.extend(chunk)
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+
+def mock_peer_react(net: Network, blocks: list[Block], msg) -> list:
+    """Scripted protocol brain (reference ``mockPeerReact`` NodeSpec.hs:135-147)."""
+    if isinstance(msg, MsgPing):
+        return [MsgPong(msg.nonce)]
+    if isinstance(msg, MsgVersion):
+        return [MsgVerAck()]
+    if isinstance(msg, MsgGetHeaders):
+        return [MsgHeaders(tuple((b.header, len(b.txs)) for b in blocks))]
+    if isinstance(msg, MsgGetData):
+        out = []
+        by_hash = {b.header.hash: b for b in blocks}
+        for iv in msg.invs:
+            if iv.type in (InvType.BLOCK, InvType.WITNESS_BLOCK):
+                b = by_hash.get(iv.hash)
+                if b is not None:
+                    out.append(MsgBlock(b))
+        return out
+    return []
+
+
+async def _fake_remote(
+    net: Network,
+    blocks: list[Block],
+    to_node: asyncio.Queue,
+    from_node: asyncio.Queue,
+    send_version_first: bool = True,
+) -> None:
+    """The remote endpoint: speaks real wire bytes over the pipe."""
+    if send_version_first:
+        local = NetworkAddress.from_host_port("::1", 0, services=NODE_NETWORK)
+        remote = NetworkAddress.from_host_port("::1", 0)
+        ver = MsgVersion(
+            version=70012,
+            services=NODE_NETWORK,
+            timestamp=int(time.time()),
+            addr_recv=remote,
+            addr_from=local,
+            nonce=random.getrandbits(64),
+            user_agent=b"/fakenet:0/",
+            start_height=len(blocks),
+            relay=True,
+        )
+        to_node.put_nowait(encode_message(net, ver))
+    reader = _QueueReader(from_node)
+    try:
+        while True:
+            raw_header = await reader.read_exact(HEADER_SIZE)
+            header = decode_message_header(net, raw_header)
+            payload = await reader.read_exact(header.length) if header.length else b""
+            msg = decode_message(net, header, payload)
+            for reply in mock_peer_react(net, blocks, msg):
+                to_node.put_nowait(encode_message(net, reply))
+    except EOFError:
+        pass
+
+
+def dummy_peer_connect(net: Network, blocks: list[Block], send_version_first: bool = True):
+    """Transport factory injected as ``NodeConfig.connect``
+    (reference ``dummyPeerConnect`` NodeSpec.hs:94-133)."""
+
+    @contextlib.asynccontextmanager
+    async def factory():
+        to_node: asyncio.Queue = asyncio.Queue()
+        from_node: asyncio.Queue = asyncio.Queue()
+        task = asyncio.get_running_loop().create_task(
+            _fake_remote(net, blocks, to_node, from_node, send_version_first)
+        )
+        try:
+            yield QueueConnection(to_node, from_node)
+        finally:
+            task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await task
+
+    return factory
+
+
+def silent_peer_connect():
+    """A transport whose remote never says anything (for timeout tests)."""
+
+    @contextlib.asynccontextmanager
+    async def factory():
+        to_node: asyncio.Queue = asyncio.Queue()
+        from_node: asyncio.Queue = asyncio.Queue()
+        yield QueueConnection(to_node, from_node)
+
+    return factory

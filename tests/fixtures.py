@@ -1,0 +1,23 @@
+"""Canned 15-block BCH-regtest chain fixture.
+
+The wire bytes are ported from the reference test suite
+(/root/reference/test/Haskoin/NodeSpec.hs:282-340 ``allBlocksBase64``) — they
+are implementation-neutral serialized blocks mined on regtest, decoded here
+with the production codec, exactly as the reference decodes them with its own.
+"""
+
+import os
+
+from tpunode.util import Reader
+from tpunode.wire import Block
+
+_DATA = os.path.join(os.path.dirname(__file__), "data", "regtest_blocks.bin")
+
+
+def all_blocks() -> list[Block]:
+    with open(_DATA, "rb") as f:
+        raw = f.read()
+    r = Reader(raw)
+    blocks = [Block.deserialize(r) for _ in range(15)]
+    assert r.remaining() == 0
+    return blocks

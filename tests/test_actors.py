@@ -1,0 +1,109 @@
+import asyncio
+
+import pytest
+
+from tpunode.actors import LinkedTasks, Mailbox, Publisher, Supervisor, receive_match
+
+
+@pytest.mark.asyncio
+async def test_mailbox_send_receive():
+    mb: Mailbox[int] = Mailbox()
+    mb.send(1)
+    mb.send(2)
+    assert await mb.receive() == 1
+    assert await mb.receive() == 2
+
+
+@pytest.mark.asyncio
+async def test_receive_match_skips_nonmatching():
+    mb: Mailbox[int] = Mailbox()
+    for i in range(5):
+        mb.send(i)
+    out = await mb.receive_match(lambda x: x if x >= 3 else None)
+    assert out == 3
+
+
+@pytest.mark.asyncio
+async def test_receive_match_timeout():
+    mb: Mailbox[int] = Mailbox()
+    with pytest.raises(TimeoutError):
+        await receive_match(mb, lambda x: x, timeout=0.05)
+
+
+@pytest.mark.asyncio
+async def test_publisher_broadcast_and_scoping():
+    pub: Publisher[str] = Publisher()
+    pub.publish("lost")  # no subscribers yet: dropped
+    async with pub.subscription() as a, pub.subscription() as b:
+        pub.publish("x")
+        assert await a.receive() == "x"
+        assert await b.receive() == "x"
+    pub.publish("after")  # no live subscribers again
+    assert a.qsize() == 0
+
+
+@pytest.mark.asyncio
+async def test_supervisor_notifies_crash():
+    deaths: list[tuple[str, BaseException | None]] = []
+
+    async def crash():
+        raise RuntimeError("boom")
+
+    async def ok():
+        return None
+
+    sup = Supervisor(on_death=lambda t, e: deaths.append((t.get_name(), e)))
+    sup.add_child(crash(), name="crasher")
+    sup.add_child(ok(), name="fine")
+    await asyncio.sleep(0.05)
+    names = {n for n, _ in deaths}
+    assert names == {"crasher", "fine"}
+    by_name = dict(deaths)
+    assert isinstance(by_name["crasher"], RuntimeError)
+    assert by_name["fine"] is None
+    await sup.aclose()
+
+
+@pytest.mark.asyncio
+async def test_supervisor_close_cancels_without_notify():
+    deaths = []
+
+    async def forever():
+        await asyncio.Event().wait()
+
+    async with Supervisor(on_death=lambda t, e: deaths.append(e)) as sup:
+        t = sup.add_child(forever())
+        await asyncio.sleep(0.01)
+    assert t.cancelled()
+    assert deaths == []  # closing is not a death notification
+
+
+@pytest.mark.asyncio
+async def test_linked_tasks_propagate_failure():
+    async def crash():
+        await asyncio.sleep(0.01)
+        raise ValueError("linked crash")
+
+    async def forever():
+        await asyncio.Event().wait()
+
+    lt = LinkedTasks()
+    lt.link(crash())
+    survivor = lt.link(forever())
+    await asyncio.sleep(0.05)
+    with pytest.raises(ValueError, match="linked crash"):
+        lt.check()
+    assert survivor.cancelled()  # crash cancels siblings
+    with pytest.raises(ValueError, match="linked crash"):
+        await lt.aclose()
+
+
+@pytest.mark.asyncio
+async def test_linked_tasks_clean_exit():
+    async def forever():
+        await asyncio.Event().wait()
+
+    async with LinkedTasks() as lt:
+        lt.link(forever())
+        await asyncio.sleep(0.01)
+        lt.check()  # no failure

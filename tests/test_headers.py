@@ -1,0 +1,221 @@
+import time
+
+import pytest
+
+from tests.fixtures import all_blocks
+from tpunode.headers import (
+    BadHeaders,
+    BlockNode,
+    MemoryHeaderStore,
+    block_locator,
+    connect_blocks,
+    genesis_node,
+    get_ancestor,
+    get_parents,
+    median_time_past,
+    next_work_required,
+    split_point,
+)
+from tpunode.params import BCH, BCH_REGTEST, BTC, BTC_REGTEST, BTC_TEST
+from tpunode.util import bits_to_target, target_to_bits
+from tpunode.wire import BlockHeader
+
+NOW = int(time.time())
+
+
+def test_genesis_hashes():
+    assert genesis_node(BTC).hash_hex == (
+        "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+    )
+    assert genesis_node(BTC_TEST).hash_hex == (
+        "000000000933ea01ad0ee984209779baaec3ced90fa3f408719526f8d77f4943"
+    )
+    assert genesis_node(BTC_REGTEST).hash_hex == (
+        "0f9188f13cb7b2c71f2a335e3a4fc328bf5beb436012afca590b1a11466e2206"
+    )
+    # BCH shares BTC's genesis; regtest genesis equals BTC regtest genesis
+    assert genesis_node(BCH).hash_hex == genesis_node(BTC).hash_hex
+    assert genesis_node(BCH_REGTEST).hash_hex == genesis_node(BTC_REGTEST).hash_hex
+
+
+def _synced_store():
+    store = MemoryHeaderStore(BCH_REGTEST)
+    headers = [b.header for b in all_blocks()]
+    nodes, best = connect_blocks(store, BCH_REGTEST, NOW, headers)
+    store.add_headers(nodes)
+    store.set_best(best)
+    return store, nodes, best
+
+
+def test_connect_fixture_chain():
+    store, nodes, best = _synced_store()
+    assert best.height == 15
+    assert best.hash_hex == (
+        "3bfa0c6da615fc45aa44ddea6854ac19d16f3ca167e0e21ac2cc262a49c9b002"
+    )
+    assert [n.height for n in nodes] == list(range(1, 16))
+    # chain work strictly increases
+    works = [n.work for n in nodes]
+    assert works == sorted(works) and len(set(works)) == 15
+
+
+def test_connect_is_idempotent():
+    store, nodes, best = _synced_store()
+    headers = [b.header for b in all_blocks()]
+    nodes2, best2 = connect_blocks(store, BCH_REGTEST, NOW, headers)
+    assert best2.hash == best.hash
+    assert [n.hash for n in nodes2] == [n.hash for n in nodes]
+
+
+def test_connect_rejects_unknown_parent():
+    store = MemoryHeaderStore(BCH_REGTEST)
+    headers = [b.header for b in all_blocks()]
+    with pytest.raises(BadHeaders, match="does not connect"):
+        connect_blocks(store, BCH_REGTEST, NOW, headers[1:])
+
+
+def test_connect_rejects_future_timestamp():
+    store = MemoryHeaderStore(BCH_REGTEST)
+    h = all_blocks()[0].header
+    past = h.timestamp - 10000  # pretend "now" is before the block's time
+    with pytest.raises(BadHeaders, match="future"):
+        connect_blocks(store, BCH_REGTEST, past, [h])
+
+
+def test_connect_rejects_bad_pow_bits():
+    store = MemoryHeaderStore(BCH_REGTEST)
+    h = all_blocks()[0].header
+    tampered = BlockHeader(
+        h.version, h.prev, h.merkle, h.timestamp, 0x1D00FFFF, h.nonce
+    )
+    with pytest.raises(BadHeaders, match="bad bits"):
+        connect_blocks(store, BCH_REGTEST, NOW, [tampered])
+
+
+def test_connect_rejects_old_timestamp():
+    store, nodes, best = _synced_store()
+    # timestamp at/below MTP of parent must be rejected
+    mtp = median_time_past(store, best)
+    h = BlockHeader(0x20000000, best.hash, b"\x00" * 32, mtp, 0x207FFFFF, 0)
+    with pytest.raises(BadHeaders, match="MTP"):
+        connect_blocks(store, BCH_REGTEST, NOW, [h])
+
+
+def test_ancestor_and_parents():
+    store, nodes, best = _synced_store()
+    a10 = get_ancestor(store, 10, best)
+    assert a10 is not None and a10.height == 10
+    assert a10.hash_hex == (
+        "7dc835a78a55fa76f9184dc4f6663a73e418c7afec789c5ae25e432fd7fc8467"
+    )
+    # parents from height 12 of the height-15 best: heights 12,13,14
+    ps = get_parents(store, 12, best)
+    assert [p.height for p in ps] == [12, 13, 14]
+    expected = [
+        "52e886df7b166d961ac2d3d2d561d806325d51a609dc0a5d9d5fcb65d47906d7",
+        "2537a081b9e2b24d217fac2886f387758cb3aa4e4956b3be7ed229bafbb71b0f",
+        "7c72f306215a296f9714320a497b1f2cb5f9b99f162d7e04333c243fac9a54d8",
+    ]
+    assert [p.hash_hex for p in ps] == expected
+
+
+def test_block_locator_shape():
+    store, nodes, best = _synced_store()
+    loc = block_locator(store, best)
+    assert loc[0] == best.hash
+    assert loc[-1] == genesis_node(BCH_REGTEST).hash
+    # strictly descending heights, all present
+    heights = [store.get_header(h).height for h in loc]
+    assert heights == sorted(heights, reverse=True)
+
+
+def test_split_point():
+    store, nodes, best = _synced_store()
+    a5 = get_ancestor(store, 5, best)
+    assert split_point(store, a5, best).hash == a5.hash
+    assert split_point(store, best, best).hash == best.hash
+
+
+def test_mainnet_retarget_math():
+    # Synthetic: exact two-week timespan keeps bits unchanged.
+    net = BTC
+    g = genesis_node(net)
+    store = MemoryHeaderStore(net)
+
+    # Build a fake parent at height 2015 with ancestor at height 0.
+    # Use a store stub: we only need get_ancestor walk; build chain of 2016
+    # light-weight nodes all at pow limit with ideal spacing.
+    prev = g
+    for i in range(1, 2016):
+        h = BlockHeader(
+            1, prev.hash, b"\x00" * 32, g.header.timestamp + 600 * i, 0x1D00FFFF, i
+        )
+        node = BlockNode(h, i, prev.work + 1)
+        store.add_headers([node])
+        prev = node
+    nxt = BlockHeader(
+        1, prev.hash, b"\x00" * 32, g.header.timestamp + 600 * 2016, 0, 0
+    )
+    bits = next_work_required(store, net, prev, nxt)
+    # Bitcoin's retarget measures 2015 intervals (its famous off-by-one), so
+    # the target shrinks by 1209000/1209600 even at ideal spacing.
+    expected = target_to_bits(
+        bits_to_target(0x1D00FFFF) * (600 * 2015) // net.pow_target_timespan
+    )
+    assert bits == expected
+    # Non-retarget height keeps parent bits on mainnet.
+    mid = get_ancestor(store, 1000, prev)
+    assert next_work_required(store, net, mid, nxt) == mid.header.bits
+
+
+def test_testnet_min_difficulty_rule():
+    net = BTC_TEST
+    g = genesis_node(net)
+    store = MemoryHeaderStore(net)
+    # block arriving >20 min after parent may use min difficulty
+    h_slow = BlockHeader(1, g.hash, b"\x00" * 32, g.header.timestamp + 1300, 0, 0)
+    assert next_work_required(store, net, g, h_slow) == net.pow_limit_bits
+    # block arriving quickly must use last non-min-difficulty bits
+    h_fast = BlockHeader(1, g.hash, b"\x00" * 32, g.header.timestamp + 100, 0, 0)
+    assert next_work_required(store, net, g, h_fast) == g.header.bits
+
+
+def test_asert_at_anchor_is_stable():
+    # At the anchor block with ideal spacing, ASERT returns ~anchor bits.
+    net = BCH
+    anchor_height, anchor_bits, anchor_parent_time = net.asert_anchor
+    parent_header = BlockHeader(
+        0x20000000,
+        b"\x11" * 32,
+        b"\x00" * 32,
+        anchor_parent_time + 600,
+        anchor_bits,
+        0,
+    )
+    parent = BlockNode(parent_header, anchor_height, 1 << 80)
+    nxt = BlockHeader(
+        0x20000000, parent.hash, b"\x00" * 32, anchor_parent_time + 1200, 0, 0
+    )
+    store = MemoryHeaderStore(net)
+    bits = next_work_required(store, net, parent, nxt)
+    assert bits == anchor_bits
+
+
+def test_asert_eases_when_slow():
+    # If far more time than ideal has passed, the target must rise (easier).
+    net = BCH
+    anchor_height, anchor_bits, anchor_parent_time = net.asert_anchor
+    week = 7 * 24 * 3600
+    parent_header = BlockHeader(
+        0x20000000,
+        b"\x11" * 32,
+        b"\x00" * 32,
+        anchor_parent_time + 600 + week,
+        anchor_bits,
+        0,
+    )
+    parent = BlockNode(parent_header, anchor_height, 1 << 80)
+    nxt = BlockHeader(0x20000000, parent.hash, b"\x00" * 32, 0, 0, 0)
+    store = MemoryHeaderStore(net)
+    bits = next_work_required(store, net, parent, nxt)
+    assert bits_to_target(bits) > bits_to_target(anchor_bits)

@@ -1,0 +1,123 @@
+import asyncio
+
+import pytest
+
+from tests.fakenet import dummy_peer_connect, silent_peer_connect
+from tests.fixtures import all_blocks
+from tpunode.actors import Mailbox, Publisher, Supervisor
+from tpunode.params import BCH_REGTEST
+from tpunode.peer import (
+    Peer,
+    PeerConfig,
+    PeerMessage,
+    PeerTimeout,
+    get_blocks,
+    get_txs,
+    ping_peer,
+    run_peer,
+)
+from tpunode.util import hex_to_hash
+from tpunode.wire import MsgVerAck, MsgVersion, build_merkle_root
+
+NET = BCH_REGTEST
+
+
+async def start_peer(connect, pub):
+    inbox = Mailbox(name="peer")
+    cfg = PeerConfig(pub=pub, net=NET, label="fake", connect=connect)
+    peer = Peer(inbox, pub, "fake")
+    task = asyncio.get_running_loop().create_task(run_peer(cfg, peer, inbox))
+    return peer, task
+
+
+@pytest.mark.asyncio
+async def test_peer_publishes_version():
+    pub = Publisher()
+    async with pub.subscription() as sub:
+        peer, task = await start_peer(dummy_peer_connect(NET, all_blocks()), pub)
+        msg = await sub.receive_match(
+            lambda ev: ev.message
+            if isinstance(ev, PeerMessage) and isinstance(ev.message, MsgVersion)
+            else None
+        )
+        assert msg.version >= 70002
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+
+@pytest.mark.asyncio
+async def test_peer_ping_roundtrip():
+    pub = Publisher()
+    peer, task = await start_peer(dummy_peer_connect(NET, all_blocks()), pub)
+    assert await ping_peer(5, peer)
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+
+
+@pytest.mark.asyncio
+async def test_get_blocks_in_order_with_merkle():
+    # mirrors the reference "downloads some blocks" spec (NodeSpec.hs:178-193)
+    pub = Publisher()
+    peer, task = await start_peer(dummy_peer_connect(NET, all_blocks()), pub)
+    h1 = hex_to_hash("3094ed3592a06f3d8e099eed2d9c1192329944f5df4a48acb29e08f12cfbb660")
+    h2 = hex_to_hash("0c89955fc5c9f98ecc71954f167b938138c90c6a094c4737f2e901669d26763f")
+    blocks = await get_blocks(NET, 10, peer, [h1, h2])
+    assert blocks is not None
+    b1, b2 = blocks
+    assert b1.header.hash == h1
+    assert b2.header.hash == h2
+    for b in blocks:
+        assert b.header.merkle == build_merkle_root([t.txid for t in b.txs])
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+
+
+@pytest.mark.asyncio
+async def test_get_blocks_unknown_hash_is_none():
+    # peer answers nothing for an unknown block; the ping sentinel bounds the wait
+    pub = Publisher()
+    peer, task = await start_peer(dummy_peer_connect(NET, all_blocks()), pub)
+    out = await get_blocks(NET, 5, peer, [b"\x42" * 32])
+    assert out is None
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+
+
+@pytest.mark.asyncio
+async def test_get_txs_not_served_returns_none():
+    pub = Publisher()
+    peer, task = await start_peer(dummy_peer_connect(NET, all_blocks()), pub)
+    out = await get_txs(NET, 2, peer, [b"\x99" * 32])
+    assert out is None
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+
+
+@pytest.mark.asyncio
+async def test_kill_peer_raises_into_session():
+    pub = Publisher()
+    peer, task = await start_peer(silent_peer_connect(), pub)
+    await asyncio.sleep(0.01)
+    peer.kill(PeerTimeout("test kill"))
+    with pytest.raises(PeerTimeout):
+        await task
+
+
+@pytest.mark.asyncio
+async def test_ping_timeout_false():
+    pub = Publisher()
+    peer, task = await start_peer(silent_peer_connect(), pub)
+    assert not await ping_peer(0.05, peer)
+    task.cancel()
+    await asyncio.gather(task, return_exceptions=True)
+
+
+@pytest.mark.asyncio
+async def test_busy_lock_cas():
+    pub = Publisher()
+    peer = Peer(Mailbox(), pub, "x")
+    assert not peer.get_busy()
+    assert peer.set_busy()
+    assert not peer.set_busy()  # second take fails
+    peer.set_free()
+    assert peer.set_busy()

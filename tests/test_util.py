@@ -1,0 +1,75 @@
+import pytest
+
+from tpunode.util import (
+    Reader,
+    bits_to_target,
+    double_sha256,
+    hash_to_hex,
+    header_work,
+    hex_to_hash,
+    read_varint,
+    target_to_bits,
+    write_varint,
+    write_varstr,
+)
+
+
+def test_double_sha256_known_vector():
+    # dsha256("hello") is a widely published vector
+    assert (
+        double_sha256(b"hello").hex()
+        == "9595c9df90075148eb06860365df33584b75bff782a510c6cd4883a419833d50"
+    )
+
+
+@pytest.mark.parametrize("n", [0, 1, 0xFC, 0xFD, 0xFFFF, 0x10000, 0xFFFFFFFF, 0x100000000])
+def test_varint_roundtrip(n):
+    enc = write_varint(n)
+    dec, pos = read_varint(enc)
+    assert dec == n
+    assert pos == len(enc)
+
+
+def test_varstr_roundtrip():
+    r = Reader(write_varstr(b"abc") + b"tail")
+    assert r.varstr() == b"abc"
+    assert r.read(4) == b"tail"
+
+
+def test_reader_truncated():
+    with pytest.raises(ValueError):
+        Reader(b"ab").read(3)
+
+
+def test_hash_hex_roundtrip():
+    h = bytes(range(32))
+    assert hex_to_hash(hash_to_hex(h)) == h
+
+
+def test_compact_bits_mainnet_limit():
+    # bits 0x1d00ffff is the mainnet pow limit
+    target = bits_to_target(0x1D00FFFF)
+    assert target == 0xFFFF << (8 * (0x1D - 3))
+    assert target_to_bits(target) == 0x1D00FFFF
+
+
+def test_compact_bits_regtest_limit():
+    target = bits_to_target(0x207FFFFF)
+    assert target_to_bits(target) == 0x207FFFFF
+    assert target.bit_length() == 255
+
+
+def test_compact_bits_genesis_work():
+    # Work of one min-difficulty mainnet block = 2^32 / (0xffff+1) * 2^... ≈ 4295032833
+    assert header_work(0x1D00FFFF) == 0x0100010001
+
+
+def test_compact_bits_negative_is_zero():
+    assert bits_to_target(0x01803456) == 0  # sign bit set
+
+
+@pytest.mark.parametrize(
+    "bits", [0x1D00FFFF, 0x207FFFFF, 0x1B0404CB, 0x1A05DB8B, 0x170331DB, 0x1804DAFE]
+)
+def test_compact_bits_roundtrip_real_values(bits):
+    assert target_to_bits(bits_to_target(bits)) == bits

@@ -1,0 +1,180 @@
+import pytest
+
+from tests.fixtures import all_blocks
+from tpunode.params import BCH_REGTEST, BTC
+from tpunode.util import Reader, double_sha256, hash_to_hex
+from tpunode.wire import (
+    Block,
+    BlockHeader,
+    DecodeError,
+    InvType,
+    InvVector,
+    MessageHeader,
+    MsgGetData,
+    MsgGetHeaders,
+    MsgHeaders,
+    MsgOther,
+    MsgPing,
+    MsgPong,
+    MsgVerAck,
+    MsgVersion,
+    NetworkAddress,
+    build_merkle_root,
+    decode_message,
+    decode_message_header,
+    encode_message,
+)
+
+NET = BCH_REGTEST
+
+
+def frame_roundtrip(msg):
+    raw = encode_message(NET, msg)
+    hdr = decode_message_header(NET, raw[:24])
+    return decode_message(NET, hdr, raw[24 : 24 + hdr.length])
+
+
+def test_fixture_decodes_15_blocks():
+    blocks = all_blocks()
+    assert len(blocks) == 15
+    # every block reserializes to identical bytes
+    for b in blocks:
+        r = Reader(b.serialize())
+        assert Block.deserialize(r) == b
+
+
+def test_fixture_known_hashes():
+    blocks = all_blocks()
+    # expected hashes from the reference test (NodeSpec.hs:180-229)
+    assert blocks[14].header.hash_hex == (
+        "3bfa0c6da615fc45aa44ddea6854ac19d16f3ca167e0e21ac2cc262a49c9b002"
+    )
+    assert blocks[9].header.hash_hex == (
+        "7dc835a78a55fa76f9184dc4f6663a73e418c7afec789c5ae25e432fd7fc8467"
+    )
+    by_hex = {b.header.hash_hex for b in blocks}
+    assert "3094ed3592a06f3d8e099eed2d9c1192329944f5df4a48acb29e08f12cfbb660" in by_hex
+    assert "0c89955fc5c9f98ecc71954f167b938138c90c6a094c4737f2e901669d26763f" in by_hex
+
+
+def test_fixture_merkle_roots():
+    for b in all_blocks():
+        assert b.header.merkle == build_merkle_root([t.txid for t in b.txs])
+
+
+def test_fixture_chain_links():
+    blocks = all_blocks()
+    for prev, cur in zip(blocks, blocks[1:]):
+        assert cur.header.prev == prev.header.hash
+
+
+def test_message_header_roundtrip():
+    hdr = MessageHeader(NET.magic, "version", 100, b"abcd")
+    assert MessageHeader.deserialize(hdr.serialize()) == hdr
+
+
+def test_bad_magic_rejected():
+    raw = encode_message(BTC, MsgVerAck())
+    with pytest.raises(DecodeError):
+        decode_message_header(NET, raw[:24])
+
+
+def test_bad_checksum_rejected():
+    raw = bytearray(encode_message(NET, MsgPing(7)))
+    raw[-1] ^= 0xFF  # corrupt payload
+    hdr = decode_message_header(NET, bytes(raw[:24]))
+    with pytest.raises(DecodeError):
+        decode_message(NET, hdr, bytes(raw[24:]))
+
+
+def test_version_roundtrip():
+    na = NetworkAddress.from_host_port("127.0.0.1", 8333, services=1)
+    v = MsgVersion(
+        version=70012,
+        services=1,
+        timestamp=1700000000,
+        addr_recv=na,
+        addr_from=NetworkAddress.from_host_port("::1", 18444),
+        nonce=0xDEADBEEF,
+        user_agent=b"/tpunode:0.1.0/",
+        start_height=42,
+        relay=True,
+    )
+    assert frame_roundtrip(v) == v
+
+
+def test_network_address_v4_mapping():
+    na = NetworkAddress.from_host_port("10.0.0.1", 8333)
+    host, port = na.to_host_port()
+    assert (host, port) == ("10.0.0.1", 8333)
+    na6 = NetworkAddress.from_host_port("2002::dead:beef", 1234)
+    assert na6.to_host_port() == ("2002::dead:beef", 1234)
+
+
+def test_ping_pong_roundtrip():
+    assert frame_roundtrip(MsgPing(123456789)) == MsgPing(123456789)
+    assert frame_roundtrip(MsgPong(987654321)) == MsgPong(987654321)
+
+
+def test_getheaders_roundtrip():
+    g = MsgGetHeaders(
+        version=70012,
+        locator=(b"\x11" * 32, b"\x22" * 32),
+        stop=b"\x00" * 32,
+    )
+    assert frame_roundtrip(g) == g
+
+
+def test_headers_roundtrip():
+    blocks = all_blocks()
+    m = MsgHeaders(tuple((b.header, len(b.txs)) for b in blocks))
+    assert frame_roundtrip(m) == m
+
+
+def test_getdata_roundtrip():
+    m = MsgGetData((InvVector(InvType.BLOCK, b"\x33" * 32),))
+    assert frame_roundtrip(m) == m
+
+
+def test_block_message_roundtrip():
+    b = all_blocks()[0]
+    from tpunode.wire import MsgBlock
+
+    assert frame_roundtrip(MsgBlock(b)) == MsgBlock(b)
+
+
+def test_unknown_command_passthrough():
+    m = MsgOther("weirdcmd", b"\x01\x02\x03")
+    out = frame_roundtrip(m)
+    assert isinstance(out, MsgOther)
+    assert out.cmd == "weirdcmd"
+    assert out.payload == b"\x01\x02\x03"
+
+
+def test_tx_ids_against_merkle():
+    # txid correctness is implied by merkle-root reconstruction over the
+    # fixture, but also pin one concrete value: coinbase of block 1.
+    b = all_blocks()[0]
+    tx = b.txs[0]
+    assert double_sha256(tx.serialize(include_witness=False)) == tx.txid
+    assert hash_to_hex(tx.txid) == hash_to_hex(b.header.merkle)  # single-tx block
+
+
+def test_segwit_tx_roundtrip():
+    # hand-built segwit tx: 1 input with witness, 1 output
+    from tpunode.wire import OutPoint, Tx, TxIn, TxOut
+
+    tx = Tx(
+        version=2,
+        inputs=(TxIn(OutPoint(b"\xaa" * 32, 1), b"", 0xFFFFFFFF),),
+        outputs=(TxOut(5000, b"\x00\x14" + b"\x11" * 20),),
+        locktime=0,
+        witnesses=((b"\x30\x45" + b"\x01" * 69, b"\x02" * 33),),
+    )
+    raw = tx.serialize()
+    assert raw[4:6] == b"\x00\x01"  # marker+flag present
+    parsed = Tx.deserialize(Reader(raw))
+    assert parsed == tx
+    # txid excludes witness data
+    assert tx.txid == double_sha256(tx.serialize(include_witness=False))
+    assert tx.wtxid != tx.txid

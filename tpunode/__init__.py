@@ -1,0 +1,81 @@
+"""tpunode — a TPU-native peer-to-peer node framework.
+
+A from-scratch framework with the capabilities of ``haskoin/haskoin-node``
+(reference mounted read-only at /root/reference; design blueprint in
+SURVEY.md): a Bitcoin / Bitcoin Cash P2P library that maintains a validated
+block-header chain in a persistent key-value store, manages a fleet of peers
+(handshake, discovery, health, supervised lifecycle) and offers a
+request/response API for fetching blocks and transactions — plus a batch
+secp256k1 ECDSA verification engine on the block/mempool ingest path whose
+hot path runs on TPU (``tpunode.verify``, landing with the verify milestone;
+see SURVEY.md §7 step 7).
+
+Public surface mirrors the reference's single exposed module
+(``Haskoin.Node`` re-exporting Peer/PeerMgr/Chain; reference
+src/Haskoin/Node.hs:10-19).
+"""
+
+from .actors import LinkedTasks, Mailbox, Publisher, Supervisor
+from .chain import (
+    Chain,
+    ChainBestBlock,
+    ChainConfig,
+    ChainEvent,
+    ChainSynced,
+)
+from .headers import (
+    BadHeaders,
+    BlockNode,
+    block_locator,
+    connect_blocks,
+    genesis_node,
+    get_ancestor,
+    get_parents,
+    median_time_past,
+    next_work_required,
+    split_point,
+)
+from .node import Node, NodeConfig, tcp_connect
+from .params import (
+    BCH,
+    BCH_REGTEST,
+    BCH_TEST,
+    BTC,
+    BTC_REGTEST,
+    BTC_TEST,
+    NETWORKS,
+    Network,
+)
+from .peer import (
+    Peer,
+    PeerConfig,
+    PeerConnected,
+    PeerDisconnected,
+    PeerError,
+    PeerEvent,
+    PeerMessage,
+    get_blocks,
+    get_data,
+    get_txs,
+    ping_peer,
+)
+from .peermgr import (
+    OnlinePeer,
+    PeerMgr,
+    PeerMgrConfig,
+    build_version,
+    to_host_service,
+    to_sock_addr,
+)
+from .store import LogKV, MemoryKV, Namespaced, open_store
+from .wire import (
+    Block,
+    BlockHeader,
+    InvType,
+    InvVector,
+    NetworkAddress,
+    Tx,
+    build_merkle_root,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,244 @@
+"""Actor substrate: typed mailboxes, pub/sub, supervision.
+
+The reference builds on the NQE actor library (reference: package.yaml:29;
+``Inbox``/``Mailbox``/``Publisher``/``Supervisor`` imported at
+src/Haskoin/Node.hs:49-56, src/Haskoin/Node/PeerMgr.hs:98-115, etc.).  This is
+the asyncio-native equivalent:
+
+* :class:`Mailbox` — an unbounded typed queue; ``send`` never blocks (NQE's
+  ``send``/``sendSTM``), ``receive`` awaits the next message.
+* :class:`Publisher` — broadcast pub/sub where every subscriber owns a private
+  queue (NQE ``withPublisher``/``withSubscription``); subscribing is an async
+  context manager so subscriptions are always scoped.
+* :class:`Supervisor` — owns child tasks and delivers death notifications to a
+  callback, the analog of NQE's ``withSupervisor (Notify ...)`` + ``addChild``
+  (reference: PeerMgr.hs:215,230,562-563).
+* :class:`LinkedTasks` — the ``withAsync``+``link`` pattern: background loops
+  whose failure must take the whole enclosing scope down
+  (reference: Node.hs:191-192, Chain.hs:296, PeerMgr.hs:234).
+
+Everything runs on one event loop; like the reference's STM-guarded actors,
+state transitions are race-free because they never yield mid-update.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from typing import (
+    AsyncIterator,
+    Awaitable,
+    Callable,
+    Generic,
+    Optional,
+    TypeVar,
+)
+
+__all__ = [
+    "Mailbox",
+    "Publisher",
+    "Supervisor",
+    "LinkedTasks",
+    "receive_match",
+]
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+class Mailbox(Generic[T]):
+    """Typed unbounded actor queue (NQE ``Inbox``/``Mailbox``)."""
+
+    def __init__(self, name: str = ""):
+        self._queue: asyncio.Queue[T] = asyncio.Queue()
+        self.name = name
+
+    def send(self, item: T) -> None:
+        """Enqueue without blocking (NQE ``send``)."""
+        self._queue.put_nowait(item)
+
+    async def receive(self) -> T:
+        return await self._queue.get()
+
+    async def receive_match(self, select: Callable[[T], Optional[U]]) -> U:
+        """Await the first message for which ``select`` returns non-None;
+        non-matching messages are discarded (NQE ``receiveMatch`` as used on
+        event subscriptions, e.g. NodeSpec.hs:202-205)."""
+        while True:
+            item = await self._queue.get()
+            out = select(item)
+            if out is not None:
+                return out
+
+    def qsize(self) -> int:
+        return self._queue.qsize()
+
+    def __repr__(self) -> str:
+        return f"<Mailbox {self.name or hex(id(self))} n={self._queue.qsize()}>"
+
+
+async def receive_match(
+    mailbox: Mailbox[T],
+    select: Callable[[T], Optional[U]],
+    timeout: float | None = None,
+) -> U:
+    """``receive_match`` with an optional timeout (NQE ``receiveMatchS``)."""
+    if timeout is None:
+        return await mailbox.receive_match(select)
+    async with asyncio.timeout(timeout):
+        return await mailbox.receive_match(select)
+
+
+class Publisher(Generic[T]):
+    """Broadcast bus with per-subscriber queues (NQE ``Publisher``)."""
+
+    def __init__(self, name: str = ""):
+        self._subscribers: set[Mailbox[T]] = set()
+        self.name = name
+
+    def publish(self, event: T) -> None:
+        for sub in tuple(self._subscribers):
+            sub.send(event)
+
+    @contextlib.asynccontextmanager
+    async def subscription(self) -> AsyncIterator[Mailbox[T]]:
+        """Scoped subscription (NQE ``withSubscription``)."""
+        mb: Mailbox[T] = Mailbox(name=f"{self.name}-sub")
+        self._subscribers.add(mb)
+        try:
+            yield mb
+        finally:
+            self._subscribers.discard(mb)
+
+
+DeathCallback = Callable[[asyncio.Task, Optional[BaseException]], None]
+
+
+class Supervisor:
+    """Parent of crash-isolated child tasks with death notification.
+
+    Equivalent of NQE's ``withSupervisor (Notify cb)``: any child ending — by
+    crash, cancellation or normal return — invokes ``on_death(task, exc)``
+    instead of propagating, exactly how the reference turns peer-thread deaths
+    into ``PeerDied`` manager messages (PeerMgr.hs:230).
+    """
+
+    def __init__(self, on_death: Optional[DeathCallback] = None, name: str = ""):
+        self._children: set[asyncio.Task] = set()
+        self._on_death = on_death
+        self._closing = False
+        self.name = name
+
+    def add_child(self, coro: Awaitable, name: str = "") -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        if name:
+            task.set_name(name)
+        self._children.add(task)
+        task.add_done_callback(self._child_done)
+        return task
+
+    def _child_done(self, task: asyncio.Task) -> None:
+        self._children.discard(task)
+        if self._closing:
+            return
+        if task.cancelled():
+            exc: Optional[BaseException] = asyncio.CancelledError()
+        else:
+            exc = task.exception()
+        if self._on_death is not None:
+            self._on_death(task, exc)
+
+    @property
+    def children(self) -> set[asyncio.Task]:
+        return set(self._children)
+
+    async def aclose(self) -> None:
+        """Cancel and await every child (end of the supervisor bracket)."""
+        self._closing = True
+        children = tuple(self._children)
+        for t in children:
+            t.cancel()
+        for t in children:
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await t
+        self._children.clear()
+
+    async def __aenter__(self) -> "Supervisor":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.aclose()
+
+
+class LinkedTasks:
+    """Background loops whose crash must abort the owning scope.
+
+    The reference ``link``s its glue loops and actor main loops so an internal
+    crash tears down the whole node bracket (crash-only design, SURVEY.md §5).
+    Here: the first exception from any linked task cancels all of them, is
+    reported to ``on_failure`` (the hook the node uses to abort the embedding
+    scope) and re-raised when the scope closes.
+    """
+
+    def __init__(
+        self,
+        name: str = "",
+        on_failure: Optional[Callable[[BaseException], None]] = None,
+    ):
+        self._tasks: set[asyncio.Task] = set()
+        self._failure: Optional[BaseException] = None
+        self._closing = False
+        self.name = name
+        self.on_failure = on_failure
+
+    def link(self, coro: Awaitable, name: str = "") -> asyncio.Task:
+        task = asyncio.ensure_future(coro)
+        if name:
+            task.set_name(name)
+        self._tasks.add(task)
+        task.add_done_callback(self._task_done)
+        return task
+
+    def _task_done(self, task: asyncio.Task) -> None:
+        self._tasks.discard(task)
+        if self._closing or task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None and self._failure is None:
+            self._failure = exc
+            for t in tuple(self._tasks):
+                t.cancel()
+            if self.on_failure is not None:
+                self.on_failure(exc)
+
+    def check(self) -> None:
+        if self._failure is not None:
+            raise self._failure
+
+    async def aclose(self) -> None:
+        self._closing = True
+        tasks = tuple(self._tasks)
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await t
+        self._tasks.clear()
+        if self._failure is not None:
+            raise self._failure
+
+    async def __aenter__(self) -> "LinkedTasks":
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb) -> None:
+        self._closing = True
+        tasks = tuple(self._tasks)
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await t
+        self._tasks.clear()
+        # don't mask an exception already unwinding the scope
+        if exc is None and self._failure is not None:
+            raise self._failure

@@ -1,0 +1,221 @@
+"""Node composition: wire the chain and peer managers together.
+
+Mirror of /root/reference/src/Haskoin/Node.hs: ``Node`` starts the Chain actor,
+then the PeerMgr actor, then links two glue loops that route events between
+them — the ONLY place the two managers are wired to each other (reference
+Node.hs:130-174).  Everything is scoped: leaving the async context kills every
+actor, peer session and timer (the ``withNode`` bracket, Node.hs:177-193).
+
+Also provides the production TCP transport (reference ``withConnection``
+Node.hs:108-128); tests inject an in-memory transport instead through
+``NodeConfig.connect`` — the seam that makes the whole stack testable without
+a network.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .actors import LinkedTasks, Publisher
+from .chain import Chain, ChainBestBlock, ChainConfig, ChainEvent
+from .params import NODE_NETWORK, Network
+from .peer import (
+    Connection,
+    PeerAddressInvalid,
+    PeerConnected,
+    PeerDisconnected,
+    PeerEvent,
+    PeerMessage,
+    WithConnection,
+)
+from .peermgr import PeerMgr, PeerMgrConfig, SockAddr
+from .store import KVStore
+from .wire import (
+    MsgAddr,
+    MsgHeaders,
+    MsgPing,
+    MsgPong,
+    MsgVerAck,
+    MsgVersion,
+    NetworkAddress,
+)
+
+__all__ = ["NodeConfig", "Node", "tcp_connect"]
+
+
+@dataclass
+class NodeConfig:
+    """The entire configuration surface (reference ``NodeConfig``
+    Node.hs:74-96)."""
+
+    net: Network
+    store: KVStore
+    pub: Publisher
+    max_peers: int = 20
+    peers: list[str] = field(default_factory=list)
+    discover: bool = False
+    address: NetworkAddress = field(
+        default_factory=lambda: NetworkAddress.from_host_port(
+            "0.0.0.0", 0, services=NODE_NETWORK
+        )
+    )
+    timeout: float = 120.0
+    max_peer_life: float = 48 * 3600.0
+    # transport hook; defaults to real TCP (reference Node.hs:95,108-128)
+    connect: Callable[[SockAddr], WithConnection] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.connect is None:
+            self.connect = tcp_connect
+
+
+class Node:
+    """A running node: ``peer_mgr`` + ``chain`` (reference ``Node``
+    Node.hs:98-101).  Use as an async context manager::
+
+        async with Node(cfg) as node:
+            best = node.chain.get_best()
+    """
+
+    def __init__(self, cfg: NodeConfig):
+        self.cfg = cfg
+        self._chain_pub: Publisher[ChainEvent] = Publisher(name="chain-internal")
+        self._peer_pub: Publisher[PeerEvent] = Publisher(name="peer-internal")
+        self.chain = Chain(
+            ChainConfig(
+                store=cfg.store,
+                net=cfg.net,
+                pub=self._chain_pub,
+                timeout=cfg.timeout,
+            ),
+            on_failure=self._component_failed,
+        )
+        self.peer_mgr = PeerMgr(
+            PeerMgrConfig(
+                max_peers=cfg.max_peers,
+                peers=cfg.peers,
+                discover=cfg.discover,
+                address=cfg.address,
+                net=cfg.net,
+                pub=self._peer_pub,
+                timeout=cfg.timeout,
+                max_peer_life=cfg.max_peer_life,
+                connect=cfg.connect,
+            ),
+            on_failure=self._component_failed,
+        )
+        self._tasks = LinkedTasks(name="node", on_failure=self._component_failed)
+        self._stack = contextlib.AsyncExitStack()
+        self._owner: Optional[asyncio.Task] = None
+        self._failure: Optional[BaseException] = None
+
+    def _component_failed(self, exc: BaseException) -> None:
+        """An internal actor crashed: abort the embedding scope, the analog of
+        the reference ``link``-ing its loops so a crash takes down the whole
+        node bracket (Node.hs:191-192; crash-only design, SURVEY.md §5)."""
+        if self._failure is None:
+            self._failure = exc
+            if self._owner is not None:
+                self._owner.cancel()
+
+    async def __aenter__(self) -> "Node":
+        # Subscriptions must exist before the actors start so the chain's
+        # initial best-block event reaches the peer manager (the startup
+        # ordering constraint, reference Node.hs:183-192 + PeerMgr.hs:245-247).
+        self._owner = asyncio.current_task()
+        await self._stack.__aenter__()
+        chain_sub = await self._stack.enter_async_context(
+            self._chain_pub.subscription()
+        )
+        peer_sub = await self._stack.enter_async_context(
+            self._peer_pub.subscription()
+        )
+        await self._stack.enter_async_context(self.chain)
+        await self._stack.enter_async_context(self.peer_mgr)
+        self._tasks.link(self._chain_events(chain_sub), name="glue-chain")
+        self._tasks.link(self._peer_events(peer_sub), name="glue-peer")
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb) -> None:
+        self._owner = None
+        try:
+            await self._tasks.__aexit__(exc_type, exc, tb)
+        finally:
+            await self._stack.__aexit__(exc_type, exc, tb)
+        # Surface an internal crash instead of the bare CancelledError the
+        # embedding scope was aborted with.
+        if self._failure is not None and isinstance(exc, asyncio.CancelledError):
+            raise self._failure
+
+    async def _chain_events(self, sub) -> None:
+        """Chain events -> PeerMgr best height + user bus
+        (reference ``chainEvents`` Node.hs:130-142)."""
+        while True:
+            event = await sub.receive()
+            if isinstance(event, ChainBestBlock):
+                self.peer_mgr.set_best(event.node.height)
+            self.cfg.pub.publish(event)
+
+    async def _peer_events(self, sub) -> None:
+        """Peer events -> demux raw messages to the managers + user bus
+        (reference ``peerEvents`` Node.hs:144-174)."""
+        mgr = self.peer_mgr
+        chain = self.chain
+        while True:
+            event = await sub.receive()
+            if isinstance(event, PeerConnected):
+                chain.peer_connected(event.peer)
+            elif isinstance(event, PeerDisconnected):
+                chain.peer_disconnected(event.peer)
+            elif isinstance(event, PeerMessage):
+                p, msg = event.peer, event.message
+                if isinstance(msg, MsgVersion):
+                    mgr.version(p, msg)
+                elif isinstance(msg, MsgVerAck):
+                    mgr.verack(p)
+                elif isinstance(msg, MsgPing):
+                    mgr.ping(p, msg.nonce)
+                elif isinstance(msg, MsgPong):
+                    mgr.pong(p, msg.nonce)
+                elif isinstance(msg, MsgAddr):
+                    mgr.addrs(p, [na for _, na in msg.addrs])
+                elif isinstance(msg, MsgHeaders):
+                    chain.headers(p, [h for h, _ in msg.headers])
+                # every message refreshes liveness (reference Node.hs:173)
+                mgr.tickle(p)
+            self.cfg.pub.publish(event)
+
+
+class _TCPConnection:
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+
+    async def read_chunk(self) -> bytes:
+        return await self._reader.read(65536)
+
+    async def write(self, data: bytes) -> None:
+        self._writer.write(data)
+        await self._writer.drain()
+
+
+def tcp_connect(sa: SockAddr) -> WithConnection:
+    """Production transport (reference ``withConnection`` Node.hs:108-128)."""
+
+    @contextlib.asynccontextmanager
+    async def factory():
+        try:
+            reader, writer = await asyncio.open_connection(sa[0], sa[1])
+        except OSError as e:
+            raise PeerAddressInvalid(f"{sa}: {e}") from e
+        try:
+            yield _TCPConnection(reader, writer)
+        finally:
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+
+    return factory

@@ -1,0 +1,273 @@
+"""Persistent key-value store behind the header chain.
+
+The reference persists headers in RocksDB (C++) through a typed query layer
+(reference: package.yaml:32-33, used at src/Haskoin/Node/Chain.hs:73-84,
+233-263, 454-491) with optional column families, atomic ``writeBatch`` and
+prefix iterators (used by the version-purge at Chain.hs:472-491).
+
+This module defines the same capability surface as a small protocol —
+``get``/``put``/``delete``/``write_batch``/``scan_prefix`` plus column-family
+style namespacing — with two Python engines:
+
+* :class:`MemoryKV` — ephemeral dict store for tests.
+* :class:`LogKV` — durable append-only log with in-memory index, replayed on
+  open and compacted when garbage accumulates.  Batch writes are atomic at
+  the record level (a torn tail record is dropped on replay).
+
+A C++ engine (``native/kvstore``) plugs in behind the same protocol via
+:func:`open_store` once built; see native/kvstore/README.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, Optional, Protocol, Sequence
+
+__all__ = [
+    "KVStore",
+    "BatchOp",
+    "put_op",
+    "delete_op",
+    "MemoryKV",
+    "LogKV",
+    "Namespaced",
+    "open_store",
+]
+
+# ('put', key, value) | ('del', key, b'')
+BatchOp = tuple[str, bytes, bytes]
+
+
+def put_op(key: bytes, value: bytes) -> BatchOp:
+    return ("put", key, value)
+
+
+def delete_op(key: bytes) -> BatchOp:
+    return ("del", key, b"")
+
+
+class KVStore(Protocol):
+    def get(self, key: bytes) -> Optional[bytes]: ...
+
+    def put(self, key: bytes, value: bytes) -> None: ...
+
+    def delete(self, key: bytes) -> None: ...
+
+    def write_batch(self, ops: Sequence[BatchOp]) -> None: ...
+
+    def scan_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]: ...
+
+    def close(self) -> None: ...
+
+
+class MemoryKV:
+    """Ephemeral dict-backed store."""
+
+    def __init__(self) -> None:
+        self._data: dict[bytes, bytes] = {}
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._data.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        self._data.pop(key, None)
+
+    def write_batch(self, ops: Sequence[BatchOp]) -> None:
+        for op, k, v in ops:
+            if op == "put":
+                self._data[k] = v
+            elif op == "del":
+                self._data.pop(k, None)
+            else:
+                raise ValueError(f"unknown batch op {op!r}")
+
+    def scan_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        for k in sorted(self._data):
+            if k.startswith(prefix):
+                yield k, self._data[k]
+
+    def close(self) -> None:
+        pass
+
+
+_REC = struct.Struct("<BII")  # op, key len, value len
+_OP_PUT = 1
+_OP_DEL = 2
+
+
+class LogKV:
+    """Durable append-only log + in-memory index.
+
+    Write path: append records, keep live values in a dict.  Open path: replay
+    the log, dropping a torn tail.  Compaction rewrites only live records once
+    dead bytes dominate.  This trades memory for simplicity — the header store
+    working set (~120 bytes/header) stays comfortably in RAM even for a full
+    mainnet chain, matching how the reference leans on RocksDB's memtable for
+    its hot path.
+    """
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._data: dict[bytes, bytes] = {}
+        self._dead_bytes = 0
+        self._live_bytes = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._replay()
+        self._file = open(path, "ab")
+
+    def _replay(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        good = 0
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        pos = 0
+        while pos + _REC.size <= len(raw):
+            op, klen, vlen = _REC.unpack_from(raw, pos)
+            end = pos + _REC.size + klen + vlen
+            if end > len(raw) or op not in (_OP_PUT, _OP_DEL):
+                break  # torn or corrupt tail: stop replay here
+            key = raw[pos + _REC.size : pos + _REC.size + klen]
+            if op == _OP_PUT:
+                value = raw[pos + _REC.size + klen : end]
+                self._note_replace(key)
+                self._data[key] = value
+                self._live_bytes += end - pos
+            else:
+                self._note_replace(key)
+                self._data.pop(key, None)
+                self._dead_bytes += end - pos
+            pos = end
+            good = pos
+        if good < len(raw):
+            with open(self.path, "r+b") as f:
+                f.truncate(good)
+
+    def _note_replace(self, key: bytes) -> None:
+        old = self._data.get(key)
+        if old is not None:
+            dead = _REC.size + len(key) + len(old)
+            self._dead_bytes += dead
+            self._live_bytes -= dead
+
+    def _append(self, op: int, key: bytes, value: bytes) -> bytes:
+        return _REC.pack(op, len(key), len(value)) + key + value
+
+    def _commit(self, blob: bytes) -> None:
+        self._file.write(blob)
+        self._file.flush()
+        if self.fsync:
+            os.fsync(self._file.fileno())
+        self._maybe_compact()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._data.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.write_batch([put_op(key, value)])
+
+    def delete(self, key: bytes) -> None:
+        self.write_batch([delete_op(key)])
+
+    def write_batch(self, ops: Sequence[BatchOp]) -> None:
+        blobs = []
+        for op, k, v in ops:
+            if op == "put":
+                self._note_replace(k)
+                self._data[k] = v
+                blob = self._append(_OP_PUT, k, v)
+                self._live_bytes += len(blob)
+            elif op == "del":
+                self._note_replace(k)
+                self._data.pop(k, None)
+                blob = self._append(_OP_DEL, k, b"")
+                self._dead_bytes += len(blob)
+            else:
+                raise ValueError(f"unknown batch op {op!r}")
+            blobs.append(blob)
+        self._commit(b"".join(blobs))
+
+    def scan_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        for k in sorted(self._data):
+            if k.startswith(prefix):
+                yield k, self._data[k]
+
+    def _maybe_compact(self) -> None:
+        if self._dead_bytes < 1 << 20 or self._dead_bytes < 3 * self._live_bytes:
+            return
+        self.compact()
+
+    def compact(self) -> None:
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            for k, v in self._data.items():
+                f.write(self._append(_OP_PUT, k, v))
+            f.flush()
+            os.fsync(f.fileno())
+        self._file.close()
+        os.replace(tmp, self.path)
+        self._file = open(self.path, "ab")
+        self._dead_bytes = 0
+        self._live_bytes = os.path.getsize(self.path)
+
+    def close(self) -> None:
+        if not self._file.closed:
+            self._file.flush()
+            self._file.close()
+
+
+class Namespaced:
+    """Column-family analog: a prefixed view over another store
+    (reference: ``withDBCF``/``insertCF`` usage, NodeSpec.hs:247,279-280)."""
+
+    def __init__(self, inner: KVStore, namespace: bytes):
+        self._inner = inner
+        self._ns = namespace
+
+    def _k(self, key: bytes) -> bytes:
+        return self._ns + key
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._inner.get(self._k(key))
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._inner.put(self._k(key), value)
+
+    def delete(self, key: bytes) -> None:
+        self._inner.delete(self._k(key))
+
+    def write_batch(self, ops: Sequence[BatchOp]) -> None:
+        self._inner.write_batch([(op, self._k(k), v) for op, k, v in ops])
+
+    def scan_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        n = len(self._ns)
+        for k, v in self._inner.scan_prefix(self._k(prefix)):
+            yield k[n:], v
+
+    def close(self) -> None:
+        pass  # owner closes the inner store
+
+
+def open_store(path: Optional[str], engine: str = "auto") -> KVStore:
+    """Open a store: ``None`` -> in-memory; else durable at ``path``.
+
+    ``engine`` may be ``auto``/``native``/``log``/``memory``.  ``auto``
+    prefers the C++ native engine when its shared library has been built
+    (native/kvstore), falling back to :class:`LogKV`.
+    """
+    if path is None or engine == "memory":
+        return MemoryKV()
+    if engine in ("auto", "native"):
+        try:
+            from .native import NativeKV  # built lazily; see native/kvstore
+
+            return NativeKV(path)
+        except Exception:
+            if engine == "native":
+                raise
+    return LogKV(path)
