@@ -1,0 +1,486 @@
+// secp256k1 ECDSA batch verification — C++ CPU engine.
+//
+// The reference consumes libsecp256k1 (C) through haskoin-core
+// (reference /root/reference/stack.yaml:5,9; SURVEY.md C9).  This is the
+// framework's native CPU equivalent: the single-core baseline the TPU kernel
+// is benchmarked against, and the small-batch fallback path of
+// tpunode/verify/engine.py.  Written from scratch: 4x64-bit limb field
+// arithmetic with __int128 products, Jacobian points (a = 0), and interleaved
+// 4-bit fixed-window double-and-add (Shamir's trick) for u1*G + u2*Q.
+//
+// Exposed C ABI (ctypes): secp_verify_batch().
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+typedef unsigned __int128 u128;
+
+// ---------- 256-bit field element, little-endian u64 limbs ----------
+
+struct Fe {
+  uint64_t v[4];
+};
+
+// p = 2^256 - 0x1000003D1
+constexpr uint64_t P0 = 0xFFFFFFFEFFFFFC2FULL;
+constexpr uint64_t P1 = 0xFFFFFFFFFFFFFFFFULL;
+constexpr uint64_t P2 = 0xFFFFFFFFFFFFFFFFULL;
+constexpr uint64_t P3 = 0xFFFFFFFFFFFFFFFFULL;
+constexpr uint64_t PC = 0x1000003D1ULL;  // 2^256 mod p
+
+// n = group order
+constexpr uint64_t N0 = 0xBFD25E8CD0364141ULL;
+constexpr uint64_t N1 = 0xBAAEDCE6AF48A03BULL;
+constexpr uint64_t N2 = 0xFFFFFFFFFFFFFFFEULL;
+constexpr uint64_t N3 = 0xFFFFFFFFFFFFFFFFULL;
+
+inline bool ge(const Fe &a, const uint64_t m[4]) {
+  for (int i = 3; i >= 0; --i) {
+    if (a.v[i] > m[i]) return true;
+    if (a.v[i] < m[i]) return false;
+  }
+  return true;  // equal
+}
+
+inline void sub_mod_raw(Fe &a, const uint64_t m[4]) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.v[i] - m[i] - (uint64_t)borrow;
+    a.v[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+}
+
+inline bool is_zero(const Fe &a) {
+  return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+inline bool fe_eq(const Fe &a, const Fe &b) {
+  return a.v[0] == b.v[0] && a.v[1] == b.v[1] && a.v[2] == b.v[2] &&
+         a.v[3] == b.v[3];
+}
+
+struct Mod {
+  uint64_t m[4];   // modulus
+  uint64_t fold;   // 2^256 mod m (single limb for both p and n folds)
+  uint64_t fold1;  // second limb of 2^256 mod m (n needs 3 limbs; see below)
+  uint64_t fold2;
+};
+
+// 2^256 mod n = 2^256 - n  (since 2^255 < n < 2^256)
+// = 0x...01457365 4... compute: (~n + 1) over 256 bits.
+constexpr uint64_t NF0 = 0x402DA1732FC9BEBFULL;  // -N0 mod 2^64 with borrows
+constexpr uint64_t NF1 = 0x4551231950B75FC4ULL;
+constexpr uint64_t NF2 = 0x0000000000000001ULL;
+constexpr uint64_t NF3 = 0x0000000000000000ULL;
+
+inline void add_limb_at(uint64_t t[9], int idx, uint64_t val) {
+  u128 cur = (u128)t[idx] + val;
+  t[idx] = (uint64_t)cur;
+  uint64_t carry = (uint64_t)(cur >> 64);
+  for (int i = idx + 1; carry && i < 9; ++i) {
+    u128 c2 = (u128)t[i] + carry;
+    t[i] = (uint64_t)c2;
+    carry = (uint64_t)(c2 >> 64);
+  }
+}
+
+// Generic 512-bit -> 256-bit reduction given fold = 2^256 mod m (up to 3 limbs).
+inline Fe reduce512(const uint64_t t[8], const uint64_t fold[4],
+                    const uint64_t m[4]) {
+  // r = lo + hi * fold ; hi*fold <= (2^256)(2^130ish) so iterate twice.
+  uint64_t acc[9];
+  std::memcpy(acc, t, 8 * sizeof(uint64_t));
+  acc[8] = 0;
+  for (int round = 0; round < 2; ++round) {
+    uint64_t hi[5];
+    std::memcpy(hi, acc + 4, 4 * sizeof(uint64_t));
+    hi[4] = acc[8];
+    uint64_t lo[9];
+    std::memcpy(lo, acc, 4 * sizeof(uint64_t));
+    std::memset(lo + 4, 0, 5 * sizeof(uint64_t));
+    // lo += hi * fold
+    for (int i = 0; i < 5; ++i) {
+      if (hi[i] == 0) continue;
+      for (int j = 0; j < 4; ++j) {
+        if (fold[j] == 0) continue;
+        u128 prod = (u128)hi[i] * fold[j];
+        add_limb_at(lo, i + j, (uint64_t)prod);
+        if ((uint64_t)(prod >> 64)) add_limb_at(lo, i + j + 1, (uint64_t)(prod >> 64));
+      }
+    }
+    std::memcpy(acc, lo, 9 * sizeof(uint64_t));
+    acc[8] = lo[8];
+  }
+  Fe r{{acc[0], acc[1], acc[2], acc[3]}};
+  // after two folds the high limbs are tiny; fold remaining once more
+  uint64_t hi4 = acc[4];
+  if (hi4 | acc[5] | acc[6] | acc[7] | acc[8]) {
+    uint64_t lo[9] = {r.v[0], r.v[1], r.v[2], r.v[3], 0, 0, 0, 0, 0};
+    uint64_t hi[5] = {acc[4], acc[5], acc[6], acc[7], acc[8]};
+    for (int i = 0; i < 5; ++i) {
+      if (hi[i] == 0) continue;
+      for (int j = 0; j < 4; ++j) {
+        if (fold[j] == 0) continue;
+        u128 prod = (u128)hi[i] * fold[j];
+        add_limb_at(lo, i + j, (uint64_t)prod);
+        if ((uint64_t)(prod >> 64)) add_limb_at(lo, i + j + 1, (uint64_t)(prod >> 64));
+      }
+    }
+    r = Fe{{lo[0], lo[1], lo[2], lo[3]}};
+  }
+  while (ge(r, m)) sub_mod_raw(r, m);
+  return r;
+}
+
+struct Field {
+  uint64_t m[4];
+  uint64_t fold[4];
+
+  Fe add(const Fe &a, const Fe &b) const {
+    Fe r;
+    u128 carry = 0;
+    for (int i = 0; i < 4; ++i) {
+      u128 s = (u128)a.v[i] + b.v[i] + (uint64_t)carry;
+      r.v[i] = (uint64_t)s;
+      carry = s >> 64;
+    }
+    if (carry) {
+      // r += fold (2^256 mod m)
+      u128 c2 = 0;
+      for (int i = 0; i < 4; ++i) {
+        u128 s = (u128)r.v[i] + fold[i] + (uint64_t)c2;
+        r.v[i] = (uint64_t)s;
+        c2 = s >> 64;
+      }
+    }
+    while (ge(r, m)) sub_mod_raw(r, m);
+    return r;
+  }
+
+  Fe sub(const Fe &a, const Fe &b) const {
+    Fe r = a;
+    if (!ge(r, b.v)) {
+      // r += m first
+      u128 carry = 0;
+      for (int i = 0; i < 4; ++i) {
+        u128 s = (u128)r.v[i] + m[i] + (uint64_t)carry;
+        r.v[i] = (uint64_t)s;
+        carry = s >> 64;
+      }
+      // a < b <= m so a+m-b < m: carry out of 2^256 may happen; ignore since
+      // result computed with borrow below stays correct modulo 2^256 when
+      // carry==1 cancels the borrow.
+    }
+    sub_mod_raw(r, b.v);
+    return r;
+  }
+
+  Fe mul(const Fe &a, const Fe &b) const {
+    uint64_t t[8] = {0};
+    for (int i = 0; i < 4; ++i) {
+      uint64_t carry = 0;
+      for (int j = 0; j < 4; ++j) {
+        u128 cur = (u128)a.v[i] * b.v[j] + t[i + j] + carry;
+        t[i + j] = (uint64_t)cur;
+        carry = (uint64_t)(cur >> 64);
+      }
+      t[i + 4] = carry;
+    }
+    if (fold[1] == 0) {
+      // Single-limb fold constant (the field prime p): fast two-pass fold.
+      // r = lo + hi*PC where PC = 2^256 mod p fits one limb.
+      uint64_t c = fold[0];
+      uint64_t lo[5] = {t[0], t[1], t[2], t[3], 0};
+      uint64_t carry = 0;
+      for (int i = 0; i < 4; ++i) {
+        u128 cur = (u128)t[4 + i] * c + lo[i] + carry;
+        lo[i] = (uint64_t)cur;
+        carry = (uint64_t)(cur >> 64);
+      }
+      lo[4] = carry;
+      // second fold: lo[4] * c
+      u128 cur = (u128)lo[4] * c + lo[0];
+      Fe r{{(uint64_t)cur, lo[1], lo[2], lo[3]}};
+      uint64_t c2 = (uint64_t)(cur >> 64);
+      for (int i = 1; c2 && i < 4; ++i) {
+        u128 s2 = (u128)r.v[i] + c2;
+        r.v[i] = (uint64_t)s2;
+        c2 = (uint64_t)(s2 >> 64);
+      }
+      // c2 can only be nonzero if r wrapped; fold once more
+      if (c2) {
+        u128 s3 = (u128)r.v[0] + c;
+        r.v[0] = (uint64_t)s3;
+        uint64_t c3 = (uint64_t)(s3 >> 64);
+        for (int i = 1; c3 && i < 4; ++i) {
+          u128 s4 = (u128)r.v[i] + c3;
+          r.v[i] = (uint64_t)s4;
+          c3 = (uint64_t)(s4 >> 64);
+        }
+      }
+      while (ge(r, m)) sub_mod_raw(r, m);
+      return r;
+    }
+    return reduce512(t, fold, m);
+  }
+
+  Fe sqr(const Fe &a) const { return mul(a, a); }
+
+  Fe pow(const Fe &a, const uint64_t e[4]) const {
+    Fe result{{1, 0, 0, 0}};
+    Fe base = a;
+    for (int limb = 0; limb < 4; ++limb) {
+      uint64_t bits = e[limb];
+      for (int i = 0; i < 64; ++i) {
+        if (bits & 1) result = mul(result, base);
+        base = sqr(base);
+        bits >>= 1;
+      }
+    }
+    return result;
+  }
+
+  Fe inv(const Fe &a) const {
+    // Fermat: a^(m-2); both p and n are prime.
+    uint64_t e[4] = {m[0] - 2, m[1], m[2], m[3]};  // m odd, no borrow
+    return pow(a, e);
+  }
+};
+
+const Field FP = {{P0, P1, P2, P3}, {PC, 0, 0, 0}};
+const Field FN = {{N0, N1, N2, N3}, {NF0, NF1, NF2, NF3}};
+
+// ---------- Jacobian points, a = 0, b = 7 ----------
+
+struct Pt {
+  Fe x, y, z;  // z == 0 => infinity
+};
+
+inline bool pt_inf(const Pt &p) { return is_zero(p.z); }
+
+Pt pt_double(const Pt &p) {
+  if (pt_inf(p) || is_zero(p.y)) return Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
+  // dbl-2009-l: A=X^2, B=Y^2, C=B^2, D=2((X+B)^2-A-C), E=3A, F=E^2
+  Fe A = FP.sqr(p.x);
+  Fe B = FP.sqr(p.y);
+  Fe C = FP.sqr(B);
+  Fe t = FP.sqr(FP.add(p.x, B));
+  Fe D = FP.sub(FP.sub(t, A), C);
+  D = FP.add(D, D);
+  Fe E = FP.add(FP.add(A, A), A);
+  Fe F = FP.sqr(E);
+  Pt r;
+  r.x = FP.sub(F, FP.add(D, D));
+  Fe C8 = FP.add(C, C);
+  C8 = FP.add(C8, C8);
+  C8 = FP.add(C8, C8);
+  r.y = FP.sub(FP.mul(E, FP.sub(D, r.x)), C8);
+  r.z = FP.mul(FP.add(p.y, p.y), p.z);
+  return r;
+}
+
+Pt pt_add(const Pt &p, const Pt &q) {
+  if (pt_inf(p)) return q;
+  if (pt_inf(q)) return p;
+  // add-2007-bl
+  Fe Z1Z1 = FP.sqr(p.z);
+  Fe Z2Z2 = FP.sqr(q.z);
+  Fe U1 = FP.mul(p.x, Z2Z2);
+  Fe U2 = FP.mul(q.x, Z1Z1);
+  Fe S1 = FP.mul(FP.mul(p.y, q.z), Z2Z2);
+  Fe S2 = FP.mul(FP.mul(q.y, p.z), Z1Z1);
+  if (fe_eq(U1, U2)) {
+    if (fe_eq(S1, S2)) return pt_double(p);
+    return Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};  // P + (-P) = O
+  }
+  Fe H = FP.sub(U2, U1);
+  Fe I = FP.sqr(FP.add(H, H));
+  Fe J = FP.mul(H, I);
+  Fe rr = FP.sub(S2, S1);
+  rr = FP.add(rr, rr);
+  Fe V = FP.mul(U1, I);
+  Pt out;
+  out.x = FP.sub(FP.sub(FP.sqr(rr), J), FP.add(V, V));
+  Fe S1J = FP.mul(S1, J);
+  out.y = FP.sub(FP.mul(rr, FP.sub(V, out.x)), FP.add(S1J, S1J));
+  Fe z1z2 = FP.mul(p.z, q.z);
+  out.z = FP.mul(FP.add(z1z2, z1z2), H);  // add-2007-bl: Z3 = 2*Z1*Z2*H
+  return out;
+}
+
+Fe fe_from_be(const uint8_t *b) {
+  Fe r;
+  for (int i = 0; i < 4; ++i) {
+    uint64_t limb = 0;
+    for (int j = 0; j < 8; ++j) limb = (limb << 8) | b[(3 - i) * 8 + j];
+    r.v[i] = limb;
+  }
+  return r;
+}
+
+// Generator
+const Fe GX = {{0x59F2815B16F81798ULL, 0x029BFCDB2DCE28D9ULL,
+                0x55A06295CE870B07ULL, 0x79BE667EF9DCBBACULL}};
+const Fe GY = {{0x9C47D08FFB10D4B8ULL, 0xFD17B448A6855419ULL,
+                0x5DA4FBFC0E1108A8ULL, 0x483ADA7726A3C465ULL}};
+
+struct Tables {
+  Pt g[16];
+  Tables() {
+    g[0] = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
+    g[1] = Pt{GX, GY, {{1, 0, 0, 0}}};
+    for (int i = 2; i < 16; ++i) g[i] = pt_add(g[i - 1], g[1]);
+  }
+};
+const Tables TAB;
+
+// w = s^-1 mod n, precomputed by the caller (batch inversion).
+bool verify_one(const uint8_t *px, const uint8_t *py, const uint8_t *z32,
+                const uint8_t *r32, const Fe &w) {
+  Fe qx = fe_from_be(px), qy = fe_from_be(py);
+  Fe z = fe_from_be(z32);
+  while (ge(z, FN.m)) sub_mod_raw(z, FN.m);  // digest reduced mod n
+  Fe r = fe_from_be(r32);
+  if (is_zero(r) || ge(r, FN.m)) return false;
+  // curve membership: qy^2 == qx^3 + 7, coords < p
+  if (ge(qx, FP.m) || ge(qy, FP.m)) return false;
+  Fe lhs = FP.sqr(qy);
+  Fe rhs = FP.add(FP.mul(FP.sqr(qx), qx), Fe{{7, 0, 0, 0}});
+  if (!fe_eq(lhs, rhs)) return false;
+
+  Fe u1 = FN.mul(z, w);
+  Fe u2 = FN.mul(r, w);
+
+  // per-key table
+  Pt tq[16];
+  tq[0] = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
+  tq[1] = Pt{qx, qy, {{1, 0, 0, 0}}};
+  for (int i = 2; i < 16; ++i) tq[i] = pt_add(tq[i - 1], tq[1]);
+
+  // interleaved 4-bit windows, MSB first
+  Pt acc = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
+  for (int w4 = 63; w4 >= 0; --w4) {
+    if (!pt_inf(acc)) {
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+    }
+    int limb = w4 / 16, shift = (w4 % 16) * 4;
+    int d1 = (int)((u1.v[limb] >> shift) & 0xF);
+    int d2 = (int)((u2.v[limb] >> shift) & 0xF);
+    if (d1) acc = pt_add(acc, TAB.g[d1]);
+    if (d2) acc = pt_add(acc, tq[d2]);
+  }
+  if (pt_inf(acc)) return false;
+  // accept iff acc.X == (r + k*n) * acc.Z^2 mod p for k in {0,1} with r+kn < p
+  Fe zz = FP.sqr(acc.z);
+  Fe cand = r;  // r < n < p: valid candidate
+  if (fe_eq(FP.mul(cand, zz), acc.x)) return true;
+  // second candidate r + n if it fits below p
+  Fe rn = r;
+  u128 carry = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 s2 = (u128)rn.v[i] + FN.m[i] + (uint64_t)carry;
+    rn.v[i] = (uint64_t)s2;
+    carry = s2 >> 64;
+  }
+  if (!carry && !ge(rn, FP.m)) {
+    if (fe_eq(FP.mul(rn, zz), acc.x)) return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+namespace {
+void fe_to_be(const Fe &a, uint8_t *out) {
+  for (int i = 0; i < 4; ++i)
+    for (int j = 0; j < 8; ++j)
+      out[(3 - i) * 8 + j] = (uint8_t)(a.v[i] >> (8 * (7 - j)));
+}
+}  // namespace
+
+extern "C" {
+
+// Debug/test hooks: 32-byte big-endian in/out field operations.
+void secp_dbg_op(int op, const uint8_t *a32, const uint8_t *b32, uint8_t *out) {
+  Fe a = fe_from_be(a32), b = fe_from_be(b32);
+  Fe r{{0, 0, 0, 0}};
+  switch (op) {
+    case 0: r = FP.mul(a, b); break;
+    case 1: r = FP.add(a, b); break;
+    case 2: r = FP.sub(a, b); break;
+    case 3: r = FP.inv(a); break;
+    case 4: r = FN.mul(a, b); break;
+    case 5: r = FN.inv(a); break;
+  }
+  fe_to_be(r, out);
+}
+
+// Debug: kG via the window table path; writes affine x,y (inverts Z).
+void secp_dbg_mulg(const uint8_t *k32, uint8_t *x_out, uint8_t *y_out) {
+  Fe k = fe_from_be(k32);
+  Pt acc = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
+  for (int w4 = 63; w4 >= 0; --w4) {
+    if (!pt_inf(acc)) {
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+    }
+    int limb = w4 / 16, shift = (w4 % 16) * 4;
+    int d = (int)((k.v[limb] >> shift) & 0xF);
+    if (d) acc = pt_add(acc, TAB.g[d]);
+  }
+  Fe zi = FP.inv(acc.z);
+  Fe zi2 = FP.sqr(zi);
+  fe_to_be(FP.mul(acc.x, zi2), x_out);
+  fe_to_be(FP.mul(acc.y, FP.mul(zi2, zi)), y_out);
+}
+
+// Inputs: concatenated 32-byte big-endian arrays, one entry per signature.
+//   px, py: affine public key coordinates
+//   z: message digests; r, s: signature scalars
+// Output: out[i] = 1 if valid else 0.  Returns number of valid signatures.
+int secp_verify_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
+                      const uint8_t *r, const uint8_t *s, int count,
+                      uint8_t *out) {
+  // Montgomery batch inversion of all s scalars: one field inversion for the
+  // whole batch plus 3 multiplications per element.
+  Fe *sv = new Fe[count];
+  Fe *prefix = new Fe[count];
+  bool *s_ok = new bool[count];
+  Fe run{{1, 0, 0, 0}};
+  for (int i = 0; i < count; ++i) {
+    Fe si = fe_from_be(s + 32 * i);
+    s_ok[i] = !(is_zero(si) || ge(si, FN.m));
+    sv[i] = s_ok[i] ? si : Fe{{1, 0, 0, 0}};
+    run = FN.mul(run, sv[i]);
+    prefix[i] = run;
+  }
+  Fe inv_all = FN.inv(run);
+  Fe *w = new Fe[count];
+  for (int i = count - 1; i >= 0; --i) {
+    Fe before = (i == 0) ? Fe{{1, 0, 0, 0}} : prefix[i - 1];
+    w[i] = FN.mul(inv_all, before);
+    inv_all = FN.mul(inv_all, sv[i]);
+  }
+  int valid = 0;
+  for (int i = 0; i < count; ++i) {
+    bool ok = s_ok[i] && verify_one(px + 32 * i, py + 32 * i, z + 32 * i,
+                                    r + 32 * i, w[i]);
+    out[i] = ok ? 1 : 0;
+    valid += ok;
+  }
+  delete[] sv;
+  delete[] prefix;
+  delete[] s_ok;
+  delete[] w;
+  return valid;
+}
+
+}  // extern "C"

@@ -1,0 +1,124 @@
+import hashlib
+import random
+
+import pytest
+
+from tpunode.verify.ecdsa_cpu import (
+    CURVE_N,
+    CURVE_P,
+    GENERATOR,
+    INFINITY,
+    Point,
+    decode_pubkey,
+    parse_der_signature,
+    point_add,
+    point_double,
+    point_mul,
+    sign,
+    verify,
+    verify_batch_cpu,
+)
+
+rng = random.Random(1234)
+
+
+def test_generator_on_curve():
+    assert GENERATOR.on_curve()
+    assert point_mul(CURVE_N, GENERATOR).infinity  # n*G = O
+
+
+def test_point_arithmetic_consistency():
+    k = rng.getrandbits(256) % CURVE_N
+    p = point_mul(k, GENERATOR)
+    assert p.on_curve()
+    # (k+1)G == kG + G ; 2(kG) == kG + kG
+    assert point_mul(k + 1, GENERATOR) == point_add(p, GENERATOR)
+    assert point_double(p) == point_add(p, p)
+    # P + (-P) = O
+    assert point_add(p, Point(p.x, CURVE_P - p.y)).infinity
+
+
+def test_sign_verify_roundtrip():
+    for _ in range(8):
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        z = rng.getrandbits(256)
+        r, s = sign(priv, z, rng.getrandbits(256))
+        assert verify(pub, z, r, s)
+        assert not verify(pub, z + 1, r, s)  # wrong msg
+        assert not verify(pub, z, r, s + 1)  # tampered sig
+        other = point_mul(priv + 1, GENERATOR)
+        assert not verify(other, z, r, s)  # wrong key
+
+
+def test_verify_rejects_degenerate():
+    priv = 42
+    pub = point_mul(priv, GENERATOR)
+    assert not verify(pub, 1, 0, 1)  # r = 0
+    assert not verify(pub, 1, 1, 0)  # s = 0
+    assert not verify(pub, 1, CURVE_N, 1)  # r >= n
+    assert not verify(INFINITY, 1, 1, 1)  # pubkey at infinity
+    off_curve = Point(5, 5)
+    assert not verify(off_curve, 1, 1, 1)
+
+
+def test_against_openssl_cryptography():
+    # Cross-check with OpenSSL: signatures made by `cryptography` must verify,
+    # and our refusals must match (tamper cases).
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    for _ in range(10):
+        sk = ec.generate_private_key(ec.SECP256K1())
+        msg = rng.randbytes(50)
+        der = sk.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        z = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+        nums = sk.public_key().public_numbers()
+        pub = Point(nums.x, nums.y)
+        assert verify(pub, z, r, s)
+        assert not verify(pub, z ^ 1, r, s)
+
+
+def test_pubkey_codec():
+    priv = rng.getrandbits(256) % CURVE_N
+    pub = point_mul(priv, GENERATOR)
+    compressed = bytes([2 + (pub.y & 1)]) + pub.x.to_bytes(32, "big")
+    uncompressed = b"\x04" + pub.x.to_bytes(32, "big") + pub.y.to_bytes(32, "big")
+    assert decode_pubkey(compressed) == pub
+    assert decode_pubkey(uncompressed) == pub
+    assert decode_pubkey(b"\x02" + b"\xff" * 32) is None  # x >= p
+    assert decode_pubkey(b"\x05" + b"\x00" * 32) is None  # bad prefix
+    assert decode_pubkey(b"") is None
+
+
+def test_der_parse():
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    sk = ec.generate_private_key(ec.SECP256K1())
+    der = sk.sign(b"payload", ec.ECDSA(hashes.SHA256()))
+    want = decode_dss_signature(der)
+    assert parse_der_signature(der) == want
+    assert parse_der_signature(b"\x30\x00") is None
+    assert parse_der_signature(b"") is None
+
+
+def test_batch():
+    priv = 7
+    pub = point_mul(priv, GENERATOR)
+    items = []
+    expected = []
+    for i in range(6):
+        z = rng.getrandbits(256)
+        r, s = sign(priv, z, rng.getrandbits(256))
+        ok = i % 2 == 0
+        items.append((pub, z if ok else z ^ 1, r, s))
+        expected.append(ok)
+    assert verify_batch_cpu(items) == expected

@@ -1,0 +1,81 @@
+import random
+
+import pytest
+
+from tpunode.verify.cpu_native import load_native_verifier
+from tpunode.verify.ecdsa_cpu import (
+    CURVE_N,
+    GENERATOR,
+    INFINITY,
+    Point,
+    point_mul,
+    sign,
+    verify_batch_cpu,
+)
+
+rng = random.Random(99)
+
+native = load_native_verifier()
+pytestmark = pytest.mark.skipif(native is None, reason="native toolchain unavailable")
+
+
+def _random_items(count, tamper_every=3):
+    items = []
+    expected = []
+    for i in range(count):
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        z = rng.getrandbits(256)
+        r, s = sign(priv, z, rng.getrandbits(256))
+        if tamper_every and i % tamper_every == 1:
+            kind = i % 3
+            if kind == 0:
+                z ^= 1
+            else:
+                s = (s + 1) % CURVE_N
+            items.append((pub, z, r, s))
+            expected.append(False)
+        else:
+            items.append((pub, z, r, s))
+            expected.append(True)
+    return items, expected
+
+
+def test_native_matches_oracle_random():
+    items, expected = _random_items(24)
+    assert verify_batch_cpu(items) == expected  # oracle sanity
+    assert native.verify_batch(items) == expected
+
+
+def test_native_rejects_degenerate():
+    priv = 42
+    pub = point_mul(priv, GENERATOR)
+    z = rng.getrandbits(256)
+    r, s = sign(priv, z, 777)
+    items = [
+        (pub, z, 0, s),  # r = 0
+        (pub, z, r, 0),  # s = 0
+        (pub, z, CURVE_N, s),  # r >= n
+        (pub, z, r, CURVE_N + 5),  # s >= n
+        (INFINITY, z, r, s),  # infinity key
+        (Point(5, 5), z, r, s),  # off-curve key
+        (pub, z, r, s),  # the one valid entry
+    ]
+    assert native.verify_batch(items) == [False] * 6 + [True]
+
+
+def test_native_edge_scalars():
+    # u1 = 0 edge: z = 0 message digest
+    priv = 1337
+    pub = point_mul(priv, GENERATOR)
+    r, s = sign(priv, 0, 4242)
+    assert native.verify_batch([(pub, 0, r, s)]) == [True]
+    # large z gets reduced mod n identically to the oracle
+    z = CURVE_N + 12345
+    r2, s2 = sign(priv, z % CURVE_N, 979)
+    assert native.verify_batch([(pub, z, r2, s2)]) == [True]
+
+
+def test_native_big_batch_agreement():
+    items, expected = _random_items(128, tamper_every=5)
+    assert native.verify_batch(items) == expected

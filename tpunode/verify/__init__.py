@@ -1,0 +1,28 @@
+"""Batch secp256k1 ECDSA signature verification.
+
+The reference consumes libsecp256k1 (C) transitively through haskoin-core
+(reference stack.yaml:5,9; SURVEY.md C9).  This package is the TPU-native
+replacement of that capability — the north star of BASELINE.json:
+
+* :mod:`tpunode.verify.ecdsa_cpu` — pure-Python reference implementation
+  (the correctness oracle, cross-checked against OpenSSL via ``cryptography``).
+* ``native/secp256k1`` + :mod:`tpunode.verify.cpu_native` — C++ single-core
+  verifier: the CPU baseline and small-batch fallback.
+* :mod:`tpunode.verify.field` / :mod:`tpunode.verify.curve` /
+  :mod:`tpunode.verify.kernel` — the JAX batch kernel: 256-bit limb
+  arithmetic, Jacobian point ops and interleaved fixed-window double-and-add
+  (Shamir) for u1*G + u2*Q, vmapped over the batch and shardable over chips.
+* :mod:`tpunode.verify.engine` — async batch queue with CPU fallback, hooked
+  into the node's block/mempool ingest path.
+"""
+
+from .ecdsa_cpu import (
+    CURVE_N,
+    CURVE_P,
+    GENERATOR,
+    Point,
+    decode_pubkey,
+    parse_der_signature,
+    verify,
+    verify_batch_cpu,
+)

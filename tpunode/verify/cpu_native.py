@@ -1,0 +1,96 @@
+"""ctypes binding to the native C++ secp256k1 verifier.
+
+The CPU baseline / fallback engine (native/secp256k1/secp256k1.cpp) — the
+framework's equivalent of the reference's libsecp256k1 dependency
+(reference stack.yaml:5,9; SURVEY.md C9).  Builds on demand with ``make -C
+native`` when the shared library is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Sequence
+
+from .ecdsa_cpu import Point
+
+__all__ = ["NativeVerifier", "load_native_verifier"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libsecp_cpu.so")
+
+
+def _ensure_built() -> str:
+    if not os.path.exists(_LIB_PATH):
+        subprocess.run(
+            ["make", "-C", os.path.join(_REPO_ROOT, "native"), "build/libsecp_cpu.so"],
+            check=True,
+            capture_output=True,
+        )
+    return _LIB_PATH
+
+
+class NativeVerifier:
+    """Batch ECDSA verification through the C++ engine."""
+
+    def __init__(self, lib_path: Optional[str] = None):
+        path = lib_path or _ensure_built()
+        self._lib = ctypes.CDLL(path)
+        self._lib.secp_verify_batch.restype = ctypes.c_int
+        self._lib.secp_verify_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+        ]
+
+    def verify_batch(
+        self, items: Sequence[tuple[Point, int, int, int]]
+    ) -> list[bool]:
+        """items: (pubkey, z, r, s) tuples — same shape as the oracle's
+        ``verify_batch_cpu``."""
+        n = len(items)
+        if n == 0:
+            return []
+        px = bytearray()
+        py = bytearray()
+        zs = bytearray()
+        rs = bytearray()
+        ss = bytearray()
+        degenerate = [False] * n
+        for i, (q, z, r, s) in enumerate(items):
+            if q.infinity:
+                degenerate[i] = True
+                px += b"\x00" * 32
+                py += b"\x00" * 32
+            else:
+                px += q.x.to_bytes(32, "big")
+                py += q.y.to_bytes(32, "big")
+            zs += (z % (1 << 256)).to_bytes(32, "big")
+            rs += (r % (1 << 256)).to_bytes(32, "big")
+            ss += (s % (1 << 256)).to_bytes(32, "big")
+        out = ctypes.create_string_buffer(n)
+        self._lib.secp_verify_batch(
+            bytes(px), bytes(py), bytes(zs), bytes(rs), bytes(ss), n, out
+        )
+        return [
+            (not degenerate[i]) and out.raw[i] == 1 for i in range(n)
+        ]
+
+
+_cached: Optional[NativeVerifier] = None
+
+
+def load_native_verifier() -> Optional[NativeVerifier]:
+    """Build+load the native verifier; None if the toolchain is unavailable."""
+    global _cached
+    if _cached is None:
+        try:
+            _cached = NativeVerifier()
+        except Exception:
+            return None
+    return _cached

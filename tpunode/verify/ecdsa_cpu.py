@@ -1,0 +1,182 @@
+"""Pure-Python secp256k1 ECDSA: the correctness oracle.
+
+Implements the verification capability the reference obtains from
+libsecp256k1 (via haskoin-core -> secp256k1-haskell; reference
+stack.yaml:5,9).  This module favors clarity over speed — it is the ground
+truth the C++ baseline and the JAX TPU kernel are validated against, and is
+itself cross-checked against OpenSSL (the ``cryptography`` package) in tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+__all__ = [
+    "CURVE_P",
+    "CURVE_N",
+    "CURVE_B",
+    "GENERATOR",
+    "Point",
+    "decode_pubkey",
+    "parse_der_signature",
+    "sign",
+    "verify",
+    "verify_batch_cpu",
+]
+
+# Curve: y^2 = x^3 + 7 over F_p
+CURVE_P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+CURVE_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+CURVE_B = 7
+_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+@dataclass(frozen=True)
+class Point:
+    """Affine point; ``None`` coordinates encode the point at infinity."""
+
+    x: Optional[int]
+    y: Optional[int]
+
+    @property
+    def infinity(self) -> bool:
+        return self.x is None
+
+    def on_curve(self) -> bool:
+        if self.infinity:
+            return True
+        return (self.y * self.y - (self.x * self.x * self.x + CURVE_B)) % CURVE_P == 0
+
+
+INFINITY = Point(None, None)
+GENERATOR = Point(_GX, _GY)
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def point_add(p: Point, q: Point) -> Point:
+    if p.infinity:
+        return q
+    if q.infinity:
+        return p
+    if p.x == q.x:
+        if (p.y + q.y) % CURVE_P == 0:
+            return INFINITY
+        return point_double(p)
+    lam = (q.y - p.y) * _inv(q.x - p.x, CURVE_P) % CURVE_P
+    x = (lam * lam - p.x - q.x) % CURVE_P
+    y = (lam * (p.x - x) - p.y) % CURVE_P
+    return Point(x, y)
+
+
+def point_double(p: Point) -> Point:
+    if p.infinity or p.y == 0:
+        return INFINITY
+    lam = 3 * p.x * p.x * _inv(2 * p.y, CURVE_P) % CURVE_P
+    x = (lam * lam - 2 * p.x) % CURVE_P
+    y = (lam * (p.x - x) - p.y) % CURVE_P
+    return Point(x, y)
+
+
+def point_mul(k: int, p: Point) -> Point:
+    k %= CURVE_N
+    acc = INFINITY
+    addend = p
+    while k:
+        if k & 1:
+            acc = point_add(acc, addend)
+        addend = point_double(addend)
+        k >>= 1
+    return acc
+
+
+def decode_pubkey(data: bytes) -> Optional[Point]:
+    """SEC1 public key: compressed (33B, 02/03) or uncompressed (65B, 04).
+
+    Returns None for malformed keys or points not on the curve.
+    """
+    if len(data) == 33 and data[0] in (2, 3):
+        x = int.from_bytes(data[1:], "big")
+        if x >= CURVE_P:
+            return None
+        y2 = (x * x * x + CURVE_B) % CURVE_P
+        y = pow(y2, (CURVE_P + 1) // 4, CURVE_P)
+        if y * y % CURVE_P != y2:
+            return None
+        if (y & 1) != (data[0] & 1):
+            y = CURVE_P - y
+        return Point(x, y)
+    if len(data) == 65 and data[0] == 4:
+        x = int.from_bytes(data[1:33], "big")
+        y = int.from_bytes(data[33:], "big")
+        p = Point(x, y)
+        if x >= CURVE_P or y >= CURVE_P or not p.on_curve():
+            return None
+        return p
+    return None
+
+
+def parse_der_signature(sig: bytes) -> Optional[tuple[int, int]]:
+    """Parse a DER ECDSA signature into (r, s).
+
+    Accepts the (lax, pre-BIP66-ish) shapes found in historical Bitcoin
+    transactions as long as the basic TLV structure holds.
+    """
+    try:
+        if len(sig) < 8 or sig[0] != 0x30:
+            return None
+        if sig[1] != len(sig) - 2:
+            return None
+        if sig[2] != 0x02:
+            return None
+        rlen = sig[3]
+        r = int.from_bytes(sig[4 : 4 + rlen], "big")
+        pos = 4 + rlen
+        if sig[pos] != 0x02:
+            return None
+        slen = sig[pos + 1]
+        s = int.from_bytes(sig[pos + 2 : pos + 2 + slen], "big")
+        if pos + 2 + slen != len(sig):
+            return None
+        return r, s
+    except IndexError:
+        return None
+
+
+def sign(priv: int, z: int, nonce: int) -> tuple[int, int]:
+    """Deterministic-nonce test signing helper (NOT for production use)."""
+    k = nonce % CURVE_N
+    if k == 0:
+        k = 1
+    R = point_mul(k, GENERATOR)
+    r = R.x % CURVE_N
+    s = _inv(k, CURVE_N) * (z + r * priv) % CURVE_N
+    if r == 0 or s == 0:
+        return sign(priv, z, nonce + 1)
+    return r, s
+
+
+def verify(pubkey: Point, z: int, r: int, s: int) -> bool:
+    """Standard ECDSA verification: R = u1*G + u2*Q, accept iff R.x ≡ r (mod n)."""
+    if not (0 < r < CURVE_N and 0 < s < CURVE_N):
+        return False
+    if pubkey.infinity or not pubkey.on_curve():
+        return False
+    w = _inv(s, CURVE_N)
+    u1 = z * w % CURVE_N
+    u2 = r * w % CURVE_N
+    R = point_add(point_mul(u1, GENERATOR), point_mul(u2, pubkey))
+    if R.infinity:
+        return False
+    return R.x % CURVE_N == r
+
+
+def verify_batch_cpu(
+    items: Sequence[tuple[Point, int, int, int]],
+) -> list[bool]:
+    """Sequential batch verify: list of (pubkey, z, r, s)."""
+    return [verify(q, z, r, s) for q, z, r, s in items]
