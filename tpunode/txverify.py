@@ -1,0 +1,154 @@
+"""Turn raw transactions into batch-verifiable signature items.
+
+The ingest side of the north star (BASELINE.json): block and mempool
+transactions are scanned for the standard spend templates whose signatures
+can be checked without a UTXO set, yielding ``(pubkey, sighash, r, s)``
+tuples for the batch verify engine:
+
+* **P2PKH** — scriptSig is ``<DER-sig> <pubkey>``; the prevout's script is
+  by construction ``DUP HASH160 <h160(pubkey)> EQUALVERIFY CHECKSIG``, fully
+  derivable from the pubkey itself, so the legacy sighash is computable
+  standalone.
+* **P2WPKH** — witness is ``[DER-sig, pubkey]``; BIP143 needs the input
+  amount, so these become items only when the caller can supply amounts
+  (``prevout_amounts``).
+
+Inputs that don't match a computable template are counted, not verified —
+this engine is a streaming signature pre-verifier (the reference node doesn't
+validate scripts at all; SURVEY.md §3.3 "this is where the north star plugs
+in"), not a full script interpreter.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Optional
+
+from .sighash import SIGHASH_FORKID, bip143_sighash, legacy_sighash
+from .verify.ecdsa_cpu import Point, decode_pubkey, parse_der_signature
+from .wire import Tx
+
+__all__ = ["SigItem", "extract_sig_items", "ExtractStats"]
+
+
+def _hash160(b: bytes) -> bytes:
+    return hashlib.new("ripemd160", hashlib.sha256(b).digest()).digest()
+
+
+@dataclass(frozen=True)
+class SigItem:
+    """One verifiable signature: inputs to ECDSA verify."""
+
+    pubkey: Optional[Point]  # None = undecodable key (auto-invalid)
+    z: int  # sighash digest
+    r: int
+    s: int
+    txid: bytes
+    input_index: int
+
+
+@dataclass
+class ExtractStats:
+    total_inputs: int = 0
+    extracted: int = 0
+    coinbase: int = 0
+    unsupported: int = 0
+
+
+def _parse_pushes(script: bytes) -> Optional[list[bytes]]:
+    """Parse a script consisting only of plain data pushes (opcodes 1-75 and
+    PUSHDATA1/2); returns None if anything else appears."""
+    out = []
+    i = 0
+    n = len(script)
+    while i < n:
+        op = script[i]
+        i += 1
+        if 1 <= op <= 75:
+            ln = op
+        elif op == 76 and i < n:  # OP_PUSHDATA1
+            ln = script[i]
+            i += 1
+        elif op == 77 and i + 1 < n:  # OP_PUSHDATA2
+            ln = int.from_bytes(script[i : i + 2], "little")
+            i += 2
+        else:
+            return None
+        if i + ln > n:
+            return None
+        out.append(script[i : i + ln])
+        i += ln
+    return out
+
+
+def _p2pkh_script_code(pubkey: bytes) -> bytes:
+    return b"\x76\xa9\x14" + _hash160(pubkey) + b"\x88\xac"
+
+
+def extract_sig_items(
+    tx: Tx,
+    prevout_amounts: Optional[dict[int, int]] = None,
+    bch: bool = False,
+) -> tuple[list[SigItem], ExtractStats]:
+    """Extract batch-verifiable signatures from one transaction.
+
+    ``prevout_amounts`` maps input index -> satoshi amount (enables P2WPKH).
+    ``bch`` selects the FORKID (BIP143-style) digest for legacy templates.
+    """
+    items: list[SigItem] = []
+    stats = ExtractStats()
+    txid = tx.txid
+    for idx, txin in enumerate(tx.inputs):
+        stats.total_inputs += 1
+        if txin.prevout.txid == b"\x00" * 32:
+            stats.coinbase += 1
+            continue
+        # P2WPKH: empty scriptSig, two-element witness
+        wit = tx.witnesses[idx] if idx < len(tx.witnesses) else ()
+        if not txin.script and len(wit) == 2:
+            sig_blob, pub_blob = wit
+            parsed = _try_item(tx, idx, sig_blob, pub_blob, prevout_amounts, bch, segwit=True)
+            if parsed is not None:
+                items.append(parsed)
+                stats.extracted += 1
+                continue
+            stats.unsupported += 1
+            continue
+        # P2PKH: scriptSig = <sig> <pubkey>
+        pushes = _parse_pushes(txin.script)
+        if pushes and len(pushes) == 2 and len(pushes[1]) in (33, 65):
+            parsed = _try_item(tx, idx, pushes[0], pushes[1], prevout_amounts, bch, segwit=False)
+            if parsed is not None:
+                items.append(parsed)
+                stats.extracted += 1
+                continue
+        stats.unsupported += 1
+    return items, stats
+
+
+def _try_item(
+    tx: Tx,
+    idx: int,
+    sig_blob: bytes,
+    pub_blob: bytes,
+    prevout_amounts: Optional[dict[int, int]],
+    bch: bool,
+    segwit: bool,
+) -> Optional[SigItem]:
+    if len(sig_blob) < 9:
+        return None
+    hashtype = sig_blob[-1]
+    rs = parse_der_signature(sig_blob[:-1])
+    if rs is None:
+        return None
+    r, s = rs
+    script_code = _p2pkh_script_code(pub_blob)
+    if segwit or (bch and hashtype & SIGHASH_FORKID):
+        if prevout_amounts is None or idx not in prevout_amounts:
+            return None
+        z = bip143_sighash(tx, idx, script_code, prevout_amounts[idx], hashtype)
+    else:
+        z = legacy_sighash(tx, idx, script_code, hashtype)
+    pub = decode_pubkey(pub_blob)
+    return SigItem(pubkey=pub, z=z, r=r, s=s, txid=tx.txid, input_index=idx)

@@ -1,0 +1,189 @@
+"""Async batch verification engine: the queue between ingest and the TPU.
+
+The north-star integration point (BASELINE.json): block/mempool ingest
+submits (pubkey, z, r, s) items; the engine accumulates them into
+fixed-shape batches (static shapes = no XLA recompilation), dispatches to
+the TPU kernel — or the C++ CPU engine for small batches / no device — and
+resolves per-item futures.  Double-buffered by construction: device dispatch
+runs in a worker thread so the asyncio event loop (the P2P side) never
+blocks, and the next batch accumulates while the previous one runs.
+
+Mirrors the role the reference's synchronous libsecp256k1 callout plays, but
+asynchronous and batched (SURVEY.md §2.3: this IS the data-parallel north
+star path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..metrics import metrics
+from .ecdsa_cpu import Point, verify_batch_cpu
+
+__all__ = ["VerifyConfig", "VerifyEngine", "VerifyItem"]
+
+VerifyItem = tuple[Optional[Point], int, int, int]  # (pubkey, z, r, s)
+
+
+@dataclass
+class VerifyConfig:
+    """Knobs (gated behind NodeConfig like the reference's config surface,
+    Node.hs:74-96; see BASELINE.json north_star 'gated behind the existing
+    NodeConfig hooks')."""
+
+    backend: str = "auto"  # auto | tpu | cpu | oracle
+    batch_size: int = 4096  # fixed device batch shape
+    max_wait: float = 0.025  # seconds to linger for a fuller batch
+    min_tpu_batch: int = 128  # below this, CPU fallback is faster
+    cpu_threads: int = 1
+
+
+def _have_tpu() -> bool:
+    try:
+        import jax
+
+        return any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        return False
+
+
+class VerifyEngine:
+    """Submit items, await verdicts.
+
+    Usage::
+
+        engine = VerifyEngine(VerifyConfig())
+        async with engine:
+            ok = await engine.verify(items)   # list[bool]
+    """
+
+    def __init__(self, cfg: Optional[VerifyConfig] = None):
+        self.cfg = cfg or VerifyConfig()
+        self._queue: list[tuple[list[VerifyItem], asyncio.Future]] = []
+        self._kick: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+        self._backend = self._pick_backend()
+        self._cpu = None
+        if self._backend in ("auto", "cpu"):
+            from .cpu_native import load_native_verifier
+
+            self._cpu = load_native_verifier()
+
+    def _pick_backend(self) -> str:
+        if self.cfg.backend != "auto":
+            return self.cfg.backend
+        return "auto"  # decide per batch: tpu when big enough & available
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def __aenter__(self) -> "VerifyEngine":
+        self._kick = asyncio.Event()
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(), name="verify-engine"
+        )
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+        # fail any stragglers
+        for _, fut in self._queue:
+            if not fut.done():
+                fut.cancel()
+        self._queue.clear()
+
+    # -- API -----------------------------------------------------------------
+
+    async def verify(self, items: Sequence[VerifyItem]) -> list[bool]:
+        """Queue items; resolves when their batch has been verified."""
+        if not items:
+            return []
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.append((list(items), fut))
+        assert self._kick is not None, "engine not started"
+        self._kick.set()
+        return await fut
+
+    def verify_sync(self, items: Sequence[VerifyItem]) -> list[bool]:
+        """Blocking verification (benchmarks, scripts): no queueing."""
+        return self._dispatch(list(items))
+
+    # -- internals -----------------------------------------------------------
+
+    async def _run(self) -> None:
+        assert self._kick is not None
+        while True:
+            await self._kick.wait()
+            self._kick.clear()
+            # linger briefly to let a fuller batch accumulate
+            deadline = time.monotonic() + self.cfg.max_wait
+            while (
+                sum(len(i) for i, _ in self._queue) < self.cfg.batch_size
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.002)
+            while self._queue:
+                batch: list[tuple[list[VerifyItem], asyncio.Future]] = []
+                total = 0
+                while self._queue and total < self.cfg.batch_size:
+                    items, fut = self._queue.pop(0)
+                    batch.append((items, fut))
+                    total += len(items)
+                flat = [it for items, _ in batch for it in items]
+                metrics.inc("verify.batches")
+                metrics.inc("verify.items", len(flat))
+                metrics.set_gauge(
+                    "verify.batch_occupancy", total / self.cfg.batch_size
+                )
+                try:
+                    results = await asyncio.to_thread(self._dispatch, flat)
+                except Exception as e:  # engine errors fail the waiters
+                    for _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(e)
+                    continue
+                pos = 0
+                for items, fut in batch:
+                    if not fut.done():
+                        fut.set_result(results[pos : pos + len(items)])
+                    pos += len(items)
+
+    def _dispatch(self, items: list[VerifyItem]) -> list[bool]:
+        """Pick an execution engine and run the batch (worker thread)."""
+        backend = self.cfg.backend
+        if backend == "auto":
+            if len(items) >= self.cfg.min_tpu_batch and _have_tpu():
+                backend = "tpu"
+            elif self._cpu is not None:
+                backend = "cpu"
+            else:
+                backend = "oracle"
+        t0 = time.perf_counter()
+        if backend == "tpu":
+            from .kernel import verify_batch_tpu
+
+            out = verify_batch_tpu(items, pad_to=self._pad_size(len(items)))
+            metrics.inc("verify.tpu_items", len(items))
+        elif backend == "cpu" and self._cpu is not None:
+            out = self._cpu.verify_batch(items)
+            metrics.inc("verify.cpu_items", len(items))
+        else:
+            out = verify_batch_cpu(items)
+            metrics.inc("verify.oracle_items", len(items))
+        dt = time.perf_counter() - t0
+        metrics.inc("verify.seconds", dt)
+        return out
+
+    def _pad_size(self, n: int) -> int:
+        """Static shapes for XLA: pad to the fixed batch size (or the next
+        power of two below it for small batches)."""
+        size = 128
+        while size < n:
+            size *= 2
+        return min(max(size, 128), max(self.cfg.batch_size, n))

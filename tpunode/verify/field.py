@@ -1,0 +1,235 @@
+"""256-bit modular arithmetic for the secp256k1 field on TPU.
+
+TPUs have no wide integers, so field elements are vectors of NLIMBS=24 limbs
+of RADIX=11 bits in int32 lanes (shape ``(..., 24)``).  Everything is a
+fixed-shape, branch-free jnp program — what XLA fuses and tiles best — and
+batches via leading dimensions.
+
+Key design points (bounds are load-bearing):
+
+* **Loose limbs.** Between operations limbs may be loose — any int32 with
+  ``|limb| <= 2**17`` — and possibly negative: two's-complement ``& MASK`` /
+  arithmetic ``>> RADIX`` keep carry rounds exact for negatives, which makes
+  subtraction free (no borrow chains).
+* **Multiplication** internally tightens both inputs with one carry round
+  (bringing limbs to ``< 2**12``), then does the 24x24 limb convolution
+  (partials < 2**24, anti-diagonal sums of <= 24 terms < 2**28.6 — far inside
+  int32), then folds limbs >= 24 back using the sparse prime:
+  2^264 ≡ 256*(2^32+977) (mod p).
+* **No value is ever dropped**: carry rounds preserve the top limb's
+  overflow in place instead of discarding it, and every buffer that carries a
+  fat top limb is padded first.
+* **Canonicalization** (exact value in [0, p)) is only needed at equality
+  checks — once per verification, not per operation.
+
+Host<->device speaks Python ints via ``to_limbs``/``from_limbs``.
+
+This replaces the capability the reference gets from libsecp256k1's field
+module (reference stack.yaml:5,9; SURVEY.md C9), redesigned for vector/matrix
+units rather than translated from the C.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "RADIX",
+    "NLIMBS",
+    "P",
+    "N",
+    "to_limbs",
+    "from_limbs",
+    "mul",
+    "sqr",
+    "mul_small",
+    "tighten",
+    "canonical",
+    "is_zero",
+    "eq",
+    "select",
+    "ZERO",
+    "ONE",
+]
+
+RADIX = 11
+NLIMBS = 24
+MASK = (1 << RADIX) - 1
+TOTAL_BITS = RADIX * NLIMBS  # 264
+
+P = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEFFFFFC2F
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+FOLD_INT = (1 << TOTAL_BITS) % P  # 2^264 mod p = 256*(2^32+977), 4 limbs
+C_INT = (1 << 256) % P  # 2^32 + 977
+_FN = 4  # limb count of the fold constant
+
+
+def _limbs_list(v: int, n: int) -> list[int]:
+    return [(v >> (RADIX * i)) & MASK for i in range(n)]
+
+
+def to_limbs(v: int, n: int = NLIMBS) -> np.ndarray:
+    """Host: Python int -> little-endian limb vector (int32)."""
+    return np.array(_limbs_list(v, n), dtype=np.int32)
+
+
+def from_limbs(limbs) -> int:
+    """Host: limb vector (loose/negative limbs fine) -> Python int."""
+    out = 0
+    for i, l in enumerate(np.asarray(limbs).reshape(-1).tolist()):
+        out += int(l) << (RADIX * i)
+    return out
+
+
+FOLD = jnp.array(_limbs_list(FOLD_INT, _FN), dtype=jnp.int32)
+C_LIMBS = jnp.array(_limbs_list(C_INT, _FN), dtype=jnp.int32)
+P_LIMBS = jnp.array(_limbs_list(P, NLIMBS), dtype=jnp.int32)
+ZERO = jnp.zeros((NLIMBS,), dtype=jnp.int32)
+ONE = jnp.zeros((NLIMBS,), dtype=jnp.int32).at[0].set(1)
+
+# anti-diagonal one-hot: S[i, j, k] = [i + j == k], for the limb convolution
+_S = np.zeros((NLIMBS, NLIMBS, 2 * NLIMBS - 1), dtype=np.int32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _S[_i, _j, _i + _j] = 1
+S_CONV = jnp.array(_S)
+
+
+def _carry(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
+    """Carry-save rounds.  Exact for negative limbs (arithmetic shift), and
+    the top limb keeps its overflow in place — no value is ever dropped."""
+    for _ in range(rounds):
+        lo = x & MASK
+        hi = x >> RADIX
+        y = lo.at[..., 1:].add(hi[..., :-1])
+        x = y.at[..., -1].add(hi[..., -1] << RADIX)
+    return x
+
+
+def _pad(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    return jnp.concatenate(
+        [x, jnp.zeros(x.shape[:-1] + (n,), dtype=jnp.int32)], axis=-1
+    )
+
+
+def tighten(x: jnp.ndarray, rounds: int = 1) -> jnp.ndarray:
+    """Re-tighten loose limbs (|limb| <= 2^17 -> < 2^12 after one round)."""
+    return _carry(x, rounds)
+
+
+def _fold_once(wide: jnp.ndarray) -> jnp.ndarray:
+    """Fold limbs >= NLIMBS back via 2^264 ≡ FOLD (mod p).
+
+    Contract: |limb| <= 2^15 (so partials hi*FOLD <= 2^26, 4-term sums
+    <= 2^28).  Output: (..., NLIMBS) with |limb| <= 2^28-ish (loose; callers
+    carry right after).
+    """
+    lo = wide[..., :NLIMBS]
+    hi = wide[..., NLIMBS:]
+    k = hi.shape[-1]
+    out = _pad(lo, max(0, k + _FN - 1 - NLIMBS))
+    for i in range(_FN):
+        out = out.at[..., i : i + k].add(FOLD[i] * hi)
+    if out.shape[-1] > NLIMBS:
+        out = _carry(_pad(out, 1), 2)
+        return _fold_once(out)
+    return out
+
+
+def _tight24(a: jnp.ndarray) -> jnp.ndarray:
+    """Bring EVERY limb (including the top one) under ~2^12 without losing
+    value: carry into a 25th limb, fold it back via 2^264 ≡ FOLD, carry once
+    more.  Needed because plain carry rounds preserve (never shrink) the top
+    limb."""
+    a = _carry(_pad(a, 1), 1)
+    hi = a[..., NLIMBS]
+    a = a[..., :NLIMBS]
+    a = a.at[..., :_FN].add(FOLD * hi[..., None])
+    return _carry(a, 1)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Modular multiply mod p.
+
+    Inputs loose (|limb| <= 2^18); output loose with |limb| <= 2^12 and
+    value magnitude < 2^265.  Exact modulo p, sign-correct.
+    """
+    a = _tight24(a)  # all limbs < ~2^12
+    b = _tight24(b)
+    prod = a[..., :, None] * b[..., None, :]  # (..., 24, 24), |v| < 2^24
+    wide = jnp.einsum("...ij,ijk->...k", prod, S_CONV)  # 47 limbs, < 2^28.6
+    wide = _carry(_pad(wide, 1), 2)  # 48 limbs, |v| <= 2^12 (top <= 2^15)
+    x = _fold_once(wide)  # 24 limbs, loose <= 2^28
+    x = _carry(_pad(x, 1), 2)  # 25 limbs, <= 2^12, top small
+    # fold the residual 25th limb (value * 2^264)
+    hi = x[..., NLIMBS]
+    x = x[..., :NLIMBS]
+    x = x.at[..., :_FN].add(FOLD * hi[..., None])
+    return _carry(x, 1)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Scale by a small constant (|k| <= 32); result loose (needs |a| <= 2^12
+    to stay within the 2^17 loose contract)."""
+    return a * k
+
+
+# ---------- exact canonicalization & comparisons ----------
+
+# A comfortably large multiple of p added before canonicalizing so negative
+# values become positive: loose values are bounded by |v| < 2^266.
+_BIG_INT = ((1 << 267) // P + 1) * P
+_BIG = jnp.array(_limbs_list(_BIG_INT, NLIMBS + 1), dtype=jnp.int32)
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact canonical representative in [0, p), as nonnegative limbs.
+
+    Input: loose limbs (|limb| <= 2^13 -> |value| < 2^266).  Used only at
+    equality checks (once per verification), so the long carry chains here
+    are off the hot path.
+    """
+    x = _tight24(x)  # all limbs < ~2^12 -> |value| < 2^266
+    wide = _pad(x, 1) + _BIG  # nonnegative, < 2^268
+    wide = _carry(wide, NLIMBS + 4)  # canonical limbs (top limb <= 2^16)
+    # fold value at the 2^256 boundary: bits 256+ are limb23>>3 and limb24
+    hi = (wide[..., NLIMBS - 1] >> 3) + (wide[..., NLIMBS] << 8)
+    lo = wide[..., :NLIMBS].at[..., NLIMBS - 1].set(wide[..., NLIMBS - 1] & 7)
+    lo = lo.at[..., :_FN].add(C_LIMBS * hi[..., None])  # += hi * (2^256 mod p)
+    lo = _carry(lo, NLIMBS + 2)  # canonical, value < 2^256 + 2^47 < 2p
+    for _ in range(2):
+        ge_p = _ge(lo, P_LIMBS)
+        lo = lo - jnp.where(ge_p[..., None], P_LIMBS, 0)
+        lo = _carry(lo, NLIMBS + 1)  # resolve borrows (result nonnegative)
+    return lo
+
+
+def _ge(a: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic >= over canonical (nonnegative, in-range) limb vectors."""
+    diff = a - m
+    nz = diff != 0
+    idx = (NLIMBS - 1) - jnp.argmax(nz[..., ::-1], axis=-1)
+    top = jnp.take_along_axis(diff, idx[..., None], axis=-1)[..., 0]
+    return jnp.where(jnp.any(nz, axis=-1), top > 0, True)
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    """value ≡ 0 (mod p)?  Exact."""
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a ≡ b (mod p)?  Exact."""
+    return is_zero(a - b)
+
+
+def select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Branch-free ``mask ? a : b`` (mask broadcasts over the limb dim)."""
+    return jnp.where(mask[..., None], a, b)
