@@ -1,0 +1,124 @@
+"""Driver benchmark: batch ECDSA verify throughput on one chip.
+
+Measures the north-star metric (BASELINE.json): sig-verifies/sec/chip of
+the TPU kernel at the standard batch size (4096), against the single-core
+CPU baseline (the C++ batch verifier in native/secp256k1, the stand-in for
+single-core libsecp256k1).  Prints exactly ONE JSON line:
+
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+Run from the repo root: python bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import statistics
+import sys
+import time
+
+BATCH = int(os.environ.get("TPUNODE_BENCH_BATCH", 4096))
+UNIQUE = min(512, BATCH)  # unique sigs, tiled to BATCH (device work identical)
+TIMED_ITERS = 5
+CPU_SAMPLE = min(256, BATCH)
+
+
+def make_items(n: int):
+    from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+
+    rng = random.Random(0xBE5C)
+    items = []
+    for i in range(n):
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        z = rng.getrandbits(256)
+        r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+        if i % 16 == 15:
+            z ^= 1  # keep some invalid lanes honest
+        items.append((pub, z, r, s))
+    return items
+
+
+def bench_device(items) -> tuple[float, str]:
+    """Steady-state device throughput (sigs/sec) and device kind."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+    from tpunode.verify.kernel import prepare_batch, verify_device
+
+    dev = jax.devices()[0]
+    prep = prepare_batch(items, pad_to=BATCH)
+    args = tuple(
+        jax.device_put(jnp.asarray(a), dev)
+        for a in (
+            prep.u1_digits,
+            prep.u2_digits,
+            prep.qx,
+            prep.qy,
+            prep.r1,
+            prep.r2,
+            prep.r2_valid,
+            prep.host_valid,
+        )
+    )
+    out = verify_device(*args)  # compile + first run
+    got = [bool(b) for b in out][: len(items)]
+    expect = verify_batch_cpu(items)
+    if got != expect:
+        print(
+            json.dumps({"error": "device/oracle verdict mismatch"}),
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+    times = []
+    for _ in range(TIMED_ITERS):
+        t0 = time.perf_counter()
+        verify_device(*args).block_until_ready()
+        times.append(time.perf_counter() - t0)
+    dt = statistics.median(times)
+    return BATCH / dt, f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
+
+
+def bench_cpu_single_core(items) -> float:
+    """Single-core baseline (sigs/sec): C++ verifier, oracle fallback."""
+    from tpunode.verify.cpu_native import load_native_verifier
+
+    sample = items[:CPU_SAMPLE]
+    try:
+        v = load_native_verifier()
+        fn = v.verify_batch
+    except Exception:
+        from tpunode.verify.ecdsa_cpu import verify_batch_cpu as fn
+    fn(sample[:8])  # warm
+    t0 = time.perf_counter()
+    fn(sample)
+    dt = time.perf_counter() - t0
+    return len(sample) / dt
+
+
+def main() -> None:
+    base_items = make_items(UNIQUE)
+    items = (base_items * (BATCH // UNIQUE + 1))[:BATCH]
+    cpu_rate = bench_cpu_single_core(base_items)
+    tpu_rate, device = bench_device(items)
+    print(
+        json.dumps(
+            {
+                "metric": "sig_verify_throughput",
+                "value": round(tpu_rate, 1),
+                "unit": "sigs/sec/chip",
+                "vs_baseline": round(tpu_rate / cpu_rate, 2),
+                "device": device,
+                "baseline_cpu_single_core": round(cpu_rate, 1),
+                "batch": BATCH,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,241 @@
+// Native persistent KV store: the framework's analog of the reference's
+// RocksDB dependency (reference package.yaml:32-33, used by the header
+// chain at src/Haskoin/Node/Chain.hs:73-84,233-263,454-491).
+//
+// Design: append-only log + in-memory ordered index (std::map), replayed
+// on open with torn-tail truncation, compacted when dead bytes dominate.
+// The on-disk record format is IDENTICAL to the Python LogKV engine
+// (tpunode/store.py): op(u8) klen(u32le) vlen(u32le) key value — the two
+// engines can open each other's files, which the tests assert.
+//
+// Exposed as a C ABI for ctypes (tpunode/native.py).  Single-writer,
+// like the reference's usage of RocksDB (one Chain actor owns the DB).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#ifdef _WIN32
+#error "POSIX only"
+#endif
+#include <unistd.h>
+
+namespace {
+
+constexpr uint8_t OP_PUT = 1;
+constexpr uint8_t OP_DEL = 2;
+constexpr size_t REC_HDR = 9;  // 1 + 4 + 4
+
+struct Store {
+  std::string path;
+  std::map<std::string, std::string> data;
+  FILE* file = nullptr;
+  uint64_t dead_bytes = 0;
+  uint64_t live_bytes = 0;
+
+  ~Store() {
+    if (file) fclose(file);
+  }
+
+  void note_replace(const std::string& key) {
+    auto it = data.find(key);
+    if (it != data.end()) {
+      uint64_t dead = REC_HDR + key.size() + it->second.size();
+      dead_bytes += dead;
+      live_bytes -= dead;
+    }
+  }
+
+  static void put_rec(std::string& out, uint8_t op, const char* k,
+                      uint32_t klen, const char* v, uint32_t vlen) {
+    char hdr[REC_HDR];
+    hdr[0] = static_cast<char>(op);
+    memcpy(hdr + 1, &klen, 4);  // little-endian on every supported target
+    memcpy(hdr + 5, &vlen, 4);
+    out.append(hdr, REC_HDR);
+    out.append(k, klen);
+    if (vlen) out.append(v, vlen);
+  }
+
+  bool replay() {
+    FILE* f = fopen(path.c_str(), "rb");
+    if (!f) return true;  // fresh store
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    std::vector<char> raw(static_cast<size_t>(sz));
+    if (sz && fread(raw.data(), 1, raw.size(), f) != raw.size()) {
+      fclose(f);
+      return false;
+    }
+    fclose(f);
+    size_t pos = 0, good = 0;
+    while (pos + REC_HDR <= raw.size()) {
+      uint8_t op = static_cast<uint8_t>(raw[pos]);
+      uint32_t klen, vlen;
+      memcpy(&klen, raw.data() + pos + 1, 4);
+      memcpy(&vlen, raw.data() + pos + 5, 4);
+      size_t end = pos + REC_HDR + static_cast<size_t>(klen) + vlen;
+      if (end > raw.size() || (op != OP_PUT && op != OP_DEL)) break;
+      std::string key(raw.data() + pos + REC_HDR, klen);
+      note_replace(key);
+      if (op == OP_PUT) {
+        data[key] = std::string(raw.data() + pos + REC_HDR + klen, vlen);
+        live_bytes += end - pos;
+      } else {
+        data.erase(key);
+        dead_bytes += end - pos;
+      }
+      pos = end;
+      good = pos;
+    }
+    if (good < raw.size()) {  // torn/corrupt tail: truncate it away
+      if (truncate(path.c_str(), static_cast<off_t>(good)) != 0) return false;
+    }
+    return true;
+  }
+
+  bool commit(const std::string& blob, bool do_fsync) {
+    if (fwrite(blob.data(), 1, blob.size(), file) != blob.size()) return false;
+    if (fflush(file) != 0) return false;
+    if (do_fsync && fsync(fileno(file)) != 0) return false;
+    if (dead_bytes >= (1u << 20) && dead_bytes >= 3 * live_bytes)
+      compact();  // opportunistic: the write above is already durable, and
+                  // a failed compaction reopens the log and keeps going
+    return file != nullptr;
+  }
+
+  bool compact() {
+    // The old log handle is only closed after the new file is fully
+    // written; on ANY failure the handle is re-opened so the store stays
+    // writable (a failed compaction must degrade, not poison the Store).
+    std::string tmp = path + ".compact";
+    FILE* f = fopen(tmp.c_str(), "wb");
+    if (!f) return false;
+    std::string blob;
+    for (auto& [k, v] : data) {
+      blob.clear();
+      put_rec(blob, OP_PUT, k.data(), static_cast<uint32_t>(k.size()),
+              v.data(), static_cast<uint32_t>(v.size()));
+      if (fwrite(blob.data(), 1, blob.size(), f) != blob.size()) {
+        fclose(f);
+        remove(tmp.c_str());
+        return false;
+      }
+    }
+    if (fflush(f) != 0 || fsync(fileno(f)) != 0) {
+      fclose(f);
+      remove(tmp.c_str());
+      return false;
+    }
+    fclose(f);
+    fclose(file);
+    file = nullptr;
+    bool ok = rename(tmp.c_str(), path.c_str()) == 0;
+    file = fopen(path.c_str(), "ab");  // reopen whichever file now exists
+    if (!ok || !file) return false;
+    dead_bytes = 0;
+    live_bytes = 0;
+    for (auto& [k, v] : data) live_bytes += REC_HDR + k.size() + v.size();
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* path) {
+  auto* s = new Store();
+  s->path = path;
+  if (!s->replay()) {
+    delete s;
+    return nullptr;
+  }
+  s->file = fopen(path, "ab");
+  if (!s->file) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void kv_close(void* h) { delete static_cast<Store*>(h); }
+
+// 1 = found (out/outlen set, free with kv_buf_free), 0 = missing.
+int kv_get(void* h, const char* key, uint32_t klen, char** out,
+           uint64_t* outlen) {
+  auto* s = static_cast<Store*>(h);
+  auto it = s->data.find(std::string(key, klen));
+  if (it == s->data.end()) return 0;
+  *outlen = it->second.size();
+  *out = static_cast<char*>(malloc(it->second.size() ? it->second.size() : 1));
+  memcpy(*out, it->second.data(), it->second.size());
+  return 1;
+}
+
+// blob = concatenated records in the on-disk format. 0 = ok.
+int kv_write_batch(void* h, const char* blob, uint64_t len, int do_fsync) {
+  auto* s = static_cast<Store*>(h);
+  size_t pos = 0;
+  std::string out;
+  out.reserve(len);
+  while (pos + REC_HDR <= len) {
+    uint8_t op = static_cast<uint8_t>(blob[pos]);
+    uint32_t klen, vlen;
+    memcpy(&klen, blob + pos + 1, 4);
+    memcpy(&vlen, blob + pos + 5, 4);
+    size_t end = pos + REC_HDR + static_cast<size_t>(klen) + vlen;
+    if (end > len || (op != OP_PUT && op != OP_DEL)) return -1;
+    std::string key(blob + pos + REC_HDR, klen);
+    s->note_replace(key);
+    if (op == OP_PUT) {
+      s->data[key] = std::string(blob + pos + REC_HDR + klen, vlen);
+      s->live_bytes += end - pos;
+    } else {
+      s->data.erase(key);
+      s->dead_bytes += end - pos;
+    }
+    pos = end;
+  }
+  if (pos != len) return -1;
+  return s->commit(std::string(blob, len), do_fsync != 0) ? 0 : -2;
+}
+
+// Serialize every (key, value) with key starting with prefix, in key order,
+// as klen(u32le) vlen(u32le) key value records.  Free with kv_buf_free.
+int kv_scan_prefix(void* h, const char* prefix, uint32_t plen, char** out,
+                   uint64_t* outlen) {
+  auto* s = static_cast<Store*>(h);
+  std::string pfx(prefix, plen);
+  std::string buf;
+  for (auto it = s->data.lower_bound(pfx); it != s->data.end(); ++it) {
+    if (it->first.compare(0, pfx.size(), pfx) != 0) break;
+    uint32_t klen = static_cast<uint32_t>(it->first.size());
+    uint32_t vlen = static_cast<uint32_t>(it->second.size());
+    char hdr[8];
+    memcpy(hdr, &klen, 4);
+    memcpy(hdr + 4, &vlen, 4);
+    buf.append(hdr, 8);
+    buf.append(it->first);
+    buf.append(it->second);
+  }
+  *outlen = buf.size();
+  *out = static_cast<char*>(malloc(buf.size() ? buf.size() : 1));
+  memcpy(*out, buf.data(), buf.size());
+  return 0;
+}
+
+int kv_compact(void* h) {
+  return static_cast<Store*>(h)->compact() ? 0 : -1;
+}
+
+uint64_t kv_count(void* h) { return static_cast<Store*>(h)->data.size(); }
+
+void kv_buf_free(char* p) { free(p); }
+
+}  // extern "C"

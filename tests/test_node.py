@@ -247,3 +247,37 @@ def test_pong_window_keeps_newest_samples():
     # a slow new sample must displace the oldest, not be discarded
     o.pings = ([5.0] + o.pings)[:11]
     assert 5.0 in o.pings and len(o.pings) == 11
+
+
+@pytest.mark.asyncio
+async def test_tx_ingest_verify_hook():
+    """North-star hook: an inbound tx streams through the verify engine and
+    a TxVerdict lands on the user bus (no reference analog — the reference
+    never validates scripts; BASELINE.json north_star)."""
+    from tests.test_sighash import make_signed_tx
+    from tpunode import TxVerdict
+    from tpunode.peer import PeerMessage
+    from tpunode.verify.engine import VerifyConfig
+    from tpunode.wire import MsgTx
+
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:17486"],
+        connect=lambda sa: dummy_peer_connect(NET, all_blocks()),
+        verify=VerifyConfig(backend="oracle", max_wait=0.0),
+    )
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(10):
+                peer = await wait_for_peer(events)
+                good = make_signed_tx(0xC0FFEE, n_inputs=2)
+                node._peer_pub.publish(PeerMessage(peer, MsgTx(good)))
+                v = await events.receive_match(
+                    lambda ev: ev if isinstance(ev, TxVerdict) else None
+                )
+                assert v.txid == good.txid
+                assert v.valid and v.verdicts == (True, True)
+                assert v.stats.extracted == 2

@@ -35,7 +35,7 @@ from .headers import (
     next_work_required,
     split_point,
 )
-from .node import Node, NodeConfig, tcp_connect
+from .node import Node, NodeConfig, TxVerdict, tcp_connect
 from .params import (
     BCH,
     BCH_REGTEST,

@@ -1,0 +1,166 @@
+"""ctypes binding to the native C++ KV store (native/kvstore).
+
+Implements the same :class:`tpunode.store.KVStore` protocol as the Python
+engines; ``open_store(path)`` prefers this engine when the shared library
+builds.  The on-disk format is shared with :class:`tpunode.store.LogKV`,
+so either engine can open a store written by the other (the reference's
+analogous component is RocksDB behind rocksdb-haskell-jprupp,
+package.yaml:32-33).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Iterator, Optional, Sequence
+
+from .store import BatchOp, delete_op, put_op
+
+__all__ = ["NativeKV", "load_kvstore_lib"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libkvstore.so")
+
+_REC = struct.Struct("<BII")
+_SCAN_HDR = struct.Struct("<II")
+_OP_PUT = 1
+_OP_DEL = 2
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def load_kvstore_lib() -> ctypes.CDLL:
+    """Build (if needed) and load the shared library, once per process."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", os.path.join(_REPO_ROOT, "native"),
+                 "build/libkvstore.so"],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_get.restype = ctypes.c_int
+        lib.kv_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.kv_write_batch.restype = ctypes.c_int
+        lib.kv_write_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_int,
+        ]
+        lib.kv_scan_prefix.restype = ctypes.c_int
+        lib.kv_scan_prefix.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.kv_compact.restype = ctypes.c_int
+        lib.kv_compact.argtypes = [ctypes.c_void_p]
+        lib.kv_count.restype = ctypes.c_uint64
+        lib.kv_count.argtypes = [ctypes.c_void_p]
+        lib.kv_buf_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class NativeKV:
+    """C++ append-log KV store behind the KVStore protocol."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lib = load_kvstore_lib()
+        self._h = self._lib.kv_open(path.encode())
+        if not self._h:
+            raise OSError(f"kv_open failed for {path!r}")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.c_void_p()
+        outlen = ctypes.c_uint64()
+        found = self._lib.kv_get(
+            self._h, key, len(key), ctypes.byref(out), ctypes.byref(outlen)
+        )
+        if not found:
+            return None
+        try:
+            return ctypes.string_at(out.value, outlen.value)
+        finally:
+            self._lib.kv_buf_free(out)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.write_batch([put_op(key, value)])
+
+    def delete(self, key: bytes) -> None:
+        self.write_batch([delete_op(key)])
+
+    def write_batch(self, ops: Sequence[BatchOp]) -> None:
+        blob = bytearray()
+        for op, k, v in ops:
+            if op == "put":
+                blob += _REC.pack(_OP_PUT, len(k), len(v)) + k + v
+            elif op == "del":
+                blob += _REC.pack(_OP_DEL, len(k), 0) + k
+            else:
+                raise ValueError(f"unknown batch op {op!r}")
+        rc = self._lib.kv_write_batch(
+            self._h, bytes(blob), len(blob), 1 if self.fsync else 0
+        )
+        if rc != 0:
+            raise OSError(f"kv_write_batch failed ({rc})")
+
+    def scan_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        out = ctypes.c_void_p()
+        outlen = ctypes.c_uint64()
+        rc = self._lib.kv_scan_prefix(
+            self._h, prefix, len(prefix), ctypes.byref(out), ctypes.byref(outlen)
+        )
+        if rc != 0:
+            raise OSError(f"kv_scan_prefix failed ({rc})")
+        try:
+            raw = ctypes.string_at(out.value, outlen.value)
+        finally:
+            self._lib.kv_buf_free(out)
+        pos = 0
+        while pos + _SCAN_HDR.size <= len(raw):
+            klen, vlen = _SCAN_HDR.unpack_from(raw, pos)
+            pos += _SCAN_HDR.size
+            yield raw[pos : pos + klen], raw[pos + klen : pos + klen + vlen]
+            pos += klen + vlen
+
+    def compact(self) -> None:
+        if self._lib.kv_compact(self._h) != 0:
+            raise OSError("kv_compact failed")
+
+    def count(self) -> int:
+        return int(self._lib.kv_count(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None
+
+    def __del__(self):  # best-effort; owners should close() explicitly
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -16,11 +16,15 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import logging
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from .actors import LinkedTasks, Publisher
+from .actors import LinkedTasks, Publisher, Supervisor
 from .chain import Chain, ChainBestBlock, ChainConfig, ChainEvent
+from .metrics import metrics
+from .txverify import ExtractStats, extract_sig_items
+from .verify.engine import VerifyConfig, VerifyEngine
 from .params import NODE_NETWORK, Network
 from .peer import (
     Connection,
@@ -35,15 +39,32 @@ from .peermgr import PeerMgr, PeerMgrConfig, SockAddr
 from .store import KVStore
 from .wire import (
     MsgAddr,
+    MsgBlock,
     MsgHeaders,
     MsgPing,
     MsgPong,
+    MsgTx,
     MsgVerAck,
     MsgVersion,
     NetworkAddress,
+    Tx,
 )
 
-__all__ = ["NodeConfig", "Node", "tcp_connect"]
+__all__ = ["NodeConfig", "Node", "TxVerdict", "tcp_connect"]
+
+
+@dataclass(frozen=True)
+class TxVerdict:
+    """Published to the user bus for every tx that went through the verify
+    engine — the north-star ingest hook's output (BASELINE.json north_star;
+    the reference has no script validation, SURVEY.md §3.3)."""
+
+    peer: object  # the Peer the tx arrived from
+    txid: bytes
+    valid: bool  # every extracted signature verified
+    verdicts: tuple[bool, ...]  # per extracted signature
+    stats: ExtractStats  # how many inputs were extractable at all
+    error: Optional[str] = None  # engine failure: verdict is indeterminate
 
 
 @dataclass
@@ -66,6 +87,9 @@ class NodeConfig:
     max_peer_life: float = 48 * 3600.0
     # transport hook; defaults to real TCP (reference Node.hs:95,108-128)
     connect: Callable[[SockAddr], WithConnection] = None  # type: ignore[assignment]
+    # north-star hook: when set, inbound tx/block signatures stream through
+    # the batch verify engine and TxVerdict events reach the user bus
+    verify: Optional[VerifyConfig] = None
 
     def __post_init__(self):
         if self.connect is None:
@@ -111,6 +135,23 @@ class Node:
         self._stack = contextlib.AsyncExitStack()
         self._owner: Optional[asyncio.Task] = None
         self._failure: Optional[BaseException] = None
+        self.verify_engine: Optional[VerifyEngine] = (
+            VerifyEngine(cfg.verify) if cfg.verify is not None else None
+        )
+        self._verify_tasks = Supervisor(
+            name="verify-ingest", on_death=self._verify_task_died
+        )
+        self._verify_pending = 0
+
+    @staticmethod
+    def _verify_task_died(task, exc) -> None:
+        """An ingest task crashed outside its own error handling: record it
+        (verdicts for its txs were already published or are indeterminate)."""
+        if exc is not None and not isinstance(exc, asyncio.CancelledError):
+            metrics.inc("node.verify_task_crashes")
+            logging.getLogger("tpunode.node").warning(
+                "verify ingest task crashed: %r", exc
+            )
 
     def _component_failed(self, exc: BaseException) -> None:
         """An internal actor crashed: abort the embedding scope, the analog of
@@ -133,6 +174,9 @@ class Node:
         peer_sub = await self._stack.enter_async_context(
             self._peer_pub.subscription()
         )
+        if self.verify_engine is not None:
+            await self._stack.enter_async_context(self.verify_engine)
+            await self._stack.enter_async_context(self._verify_tasks)
         await self._stack.enter_async_context(self.chain)
         await self._stack.enter_async_context(self.peer_mgr)
         self._tasks.link(self._chain_events(chain_sub), name="glue-chain")
@@ -184,9 +228,82 @@ class Node:
                     mgr.addrs(p, [na for _, na in msg.addrs])
                 elif isinstance(msg, MsgHeaders):
                     chain.headers(p, [h for h, _ in msg.headers])
+                elif self.verify_engine is not None and isinstance(msg, MsgTx):
+                    self._submit_verify(p, [msg.tx])
+                elif self.verify_engine is not None and isinstance(msg, MsgBlock):
+                    self._submit_verify(p, msg.block.txs)
                 # every message refreshes liveness (reference Node.hs:173)
                 mgr.tickle(p)
             self.cfg.pub.publish(event)
+
+    # Backpressure bound on in-flight ingest submissions (peer-facing DoS
+    # guard: a flooding peer gets its excess dropped, mirroring how the
+    # connect loop bounds the peer fleet rather than growing it).
+    MAX_VERIFY_PENDING = 64
+
+    def _submit_verify(self, peer, txs: list[Tx]) -> None:
+        """Fan inbound transactions into the batch verify engine without
+        blocking the event-routing loop; one TxVerdict per tx lands on the
+        user bus when its batch completes (or fails: ``error`` set)."""
+        if self._verify_pending >= self.MAX_VERIFY_PENDING:
+            metrics.inc("node.verify_dropped", len(txs))
+            return
+        self._verify_pending += 1
+        self._verify_tasks.add_child(
+            self._verify_txs(peer, txs), name="verify-txs"
+        )
+
+    async def _verify_txs(self, peer, txs: list[Tx]) -> None:
+        """Verify every tx of one message.  All txs' signatures are submitted
+        to the engine CONCURRENTLY so a whole block coalesces into full
+        device batches (awaiting per tx would degrade a 150k-sig block into
+        sequential tiny batches)."""
+        assert self.verify_engine is not None
+        per_tx: list[tuple[Tx, ExtractStats, Optional[asyncio.Task]]] = []
+        try:
+            for tx in txs:
+                try:
+                    items, stats = extract_sig_items(tx, bch=self.cfg.net.bch)
+                except Exception as e:
+                    metrics.inc("node.verify_errors")
+                    self.cfg.pub.publish(
+                        TxVerdict(peer, tx.txid, False, (), ExtractStats(),
+                                  error=f"extract: {e}")
+                    )
+                    continue
+                metrics.inc("node.verify_txs")
+                metrics.inc("node.verify_inputs", stats.total_inputs)
+                task = None
+                if items:
+                    task = asyncio.ensure_future(
+                        self.verify_engine.verify(
+                            [(i.pubkey, i.z, i.r, i.s) for i in items]
+                        )
+                    )
+                per_tx.append((tx, stats, task))
+            for tx, stats, task in per_tx:
+                if task is None:
+                    self.cfg.pub.publish(TxVerdict(peer, tx.txid, True, (), stats))
+                    continue
+                try:
+                    verdicts = await task
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    metrics.inc("node.verify_errors")
+                    self.cfg.pub.publish(
+                        TxVerdict(peer, tx.txid, False, (), stats,
+                                  error=f"engine: {e}")
+                    )
+                    continue
+                self.cfg.pub.publish(
+                    TxVerdict(peer, tx.txid, all(verdicts), tuple(verdicts), stats)
+                )
+        finally:
+            self._verify_pending -= 1
+            for _, _, task in per_tx:
+                if task is not None and not task.done():
+                    task.cancel()
 
 
 class _TCPConnection:

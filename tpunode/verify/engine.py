@@ -16,6 +16,7 @@ star path).
 from __future__ import annotations
 
 import asyncio
+import collections
 import contextlib
 import time
 from dataclasses import dataclass, field
@@ -63,7 +64,9 @@ class VerifyEngine:
 
     def __init__(self, cfg: Optional[VerifyConfig] = None):
         self.cfg = cfg or VerifyConfig()
-        self._queue: list[tuple[list[VerifyItem], asyncio.Future]] = []
+        self._queue: collections.deque[tuple[list[VerifyItem], asyncio.Future]] = (
+            collections.deque()
+        )
         self._kick: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._backend = self._pick_backend()
@@ -132,7 +135,7 @@ class VerifyEngine:
                 batch: list[tuple[list[VerifyItem], asyncio.Future]] = []
                 total = 0
                 while self._queue and total < self.cfg.batch_size:
-                    items, fut = self._queue.pop(0)
+                    items, fut = self._queue.popleft()
                     batch.append((items, fut))
                     total += len(items)
                 flat = [it for items, _ in batch for it in items]

@@ -24,7 +24,6 @@ oracle (tested property-style in tests/test_kernel.py).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Sequence
 
 import numpy as np
@@ -41,6 +40,7 @@ __all__ = [
     "WINDOWS",
     "WINDOW_BITS",
     "prepare_batch",
+    "verify_core",
     "verify_device",
     "verify_batch_tpu",
     "PreparedBatch",
@@ -202,8 +202,7 @@ def _select_entry(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("bt,btcl->bcl", onehot, table)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def verify_device(
+def verify_core(
     u1_digits: jnp.ndarray,  # (B, 64) int32, MSB-first base-16
     u2_digits: jnp.ndarray,  # (B, 64)
     qx: jnp.ndarray,  # (B, L)
@@ -213,7 +212,8 @@ def verify_device(
     r2_valid: jnp.ndarray,  # (B,) bool
     host_valid: jnp.ndarray,  # (B,) bool
 ) -> jnp.ndarray:
-    """The jitted device program: returns a (B,) bool validity vector."""
+    """The device program (un-jitted: reused by the shard_map multi-chip
+    wrapper in multichip.py): returns a (B,) bool validity vector."""
     q_table = _build_q_table(qx, qy)  # (B, 16, 3, L)
 
     acc0 = jnp.broadcast_to(INFINITY, (qx.shape[0], 3, F.NLIMBS))
@@ -238,6 +238,9 @@ def verify_device(
     # pubkey must satisfy the curve equation: qy^2 = qx^3 + 7
     on_curve = F.eq(F.sqr(qy), F.mul(F.sqr(qx), qx) + _SEVEN)
     return host_valid & on_curve & not_inf & (m1 | m2)
+
+
+verify_device = jax.jit(verify_core)
 
 
 def verify_batch_tpu(

@@ -1,0 +1,132 @@
+"""Multi-chip batch ECDSA verification: shard_map over a device mesh.
+
+The BCH 32 MB-block stress config (BASELINE.json configs[4], ~150k sigs in
+one block) wants more than one chip.  Signature verification has no
+cross-item dependencies (SURVEY.md §2.3: data parallelism IS the north-star
+axis; ring/Ulysses-style sequence parallelism is deliberately unnecessary
+here and documented as such), so the multi-chip design is pure DP:
+
+* a 1-D ``Mesh`` over all chips, axis ``"batch"``;
+* every input array sharded along its leading batch dimension
+  (``PartitionSpec("batch")``) — host→device transfer is split per chip;
+* ``shard_map`` runs the same single-chip program :func:`kernel.verify_core`
+  on each shard — zero inter-chip traffic in the hot loop;
+* one ``psum`` over ICI reduces the per-shard valid-counts so every chip
+  (and the host, reading one scalar) agrees on the batch verdict count —
+  the only collective the algorithm needs.
+
+Replaces the capability of the reference's process-parallel verification
+(one libsecp256k1 call per tx input across peer threads) at chip scale.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from .ecdsa_cpu import Point
+from .kernel import prepare_batch, verify_core
+
+__all__ = ["make_mesh", "sharded_verify_fn", "verify_batch_sharded"]
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` local devices (all, if None)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), ("batch",))
+
+
+_FN_CACHE: dict = {}
+
+
+def sharded_verify_fn(mesh: Mesh):
+    """Jitted verify step sharded over ``mesh``: same signature as
+    :func:`kernel.verify_core`, returns ``(ok: (B,) bool, total: int32)``.
+
+    ``B`` must be a multiple of the mesh size (callers pad; static shapes
+    also keep XLA from recompiling across batches).  Cached per mesh so
+    repeated batches reuse the compiled executable.
+    """
+    cached = _FN_CACHE.get(mesh)
+    if cached is not None:
+        return cached
+    spec_b = P("batch")
+
+    def step(u1, u2, qx, qy, r1, r2, r2v, hv):
+        ok = verify_core(u1, u2, qx, qy, r1, r2, r2v, hv)
+        total = lax.psum(jnp.sum(ok.astype(jnp.int32)), "batch")
+        return ok, total
+
+    # check_vma off: verify_core's scan carry starts from a broadcast
+    # constant (INFINITY), which the varying-manual-axes analysis rejects
+    # even though the program is shard-correct (pure DP + one psum).
+    try:
+        sharded = _shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(spec_b,) * 8,
+            out_specs=(spec_b, P()),
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        sharded = _shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(spec_b,) * 8,
+            out_specs=(spec_b, P()),
+            check_rep=False,
+        )
+    fn = jax.jit(sharded)
+    _FN_CACHE[mesh] = fn
+    return fn
+
+
+def verify_batch_sharded(
+    items: Sequence[tuple[Optional[Point], int, int, int]],
+    mesh: Optional[Mesh] = None,
+    pad_to: Optional[int] = None,
+) -> list[bool]:
+    """End-to-end multi-chip verify: host prep, shard over the mesh, run.
+
+    Pads the batch to a multiple of the mesh size (lanes padded with
+    ``host_valid=False`` are rejected for free).
+    """
+    if not items:
+        return []
+    mesh = mesh or make_mesh()
+    n = mesh.devices.size
+    size = pad_to or len(items)
+    size = max(size, len(items))
+    size = (size + n - 1) // n * n
+    prep = prepare_batch(items, pad_to=size)
+
+    fn = sharded_verify_fn(mesh)
+    shard = NamedSharding(mesh, P("batch"))
+    args = [
+        jax.device_put(np.asarray(a), shard)
+        for a in (
+            prep.u1_digits,
+            prep.u2_digits,
+            prep.qx,
+            prep.qy,
+            prep.r1,
+            prep.r2,
+            prep.r2_valid,
+            prep.host_valid,
+        )
+    ]
+    ok, _total = fn(*args)
+    return [bool(b) for b in np.asarray(ok)[: prep.count]]
