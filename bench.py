@@ -52,17 +52,7 @@ def bench_device(items) -> tuple[float, str]:
     dev = jax.devices()[0]
     prep = prepare_batch(items, pad_to=BATCH)
     args = tuple(
-        jax.device_put(jnp.asarray(a), dev)
-        for a in (
-            prep.u1_digits,
-            prep.u2_digits,
-            prep.qx,
-            prep.qy,
-            prep.r1,
-            prep.r2,
-            prep.r2_valid,
-            prep.host_valid,
-        )
+        jax.device_put(jnp.asarray(a), dev) for a in prep.device_args
     )
     out = verify_device(*args)  # compile + first run
     got = [bool(b) for b in out][: len(items)]

@@ -1,4 +1,8 @@
-"""Property tests for the TPU limb field arithmetic vs Python ints."""
+"""Property tests for the TPU limb field arithmetic vs Python ints.
+
+Layout convention under test (see tpunode/verify/field.py): limb-major —
+an element batch is shape ``(NLIMBS, B)``, a single element ``(NLIMBS, 1)``.
+"""
 
 import random
 
@@ -18,14 +22,16 @@ def rand_fe():
 
 
 def limbs(*vals):
-    return jnp.stack([jnp.array(F.to_limbs(v)) for v in vals])
+    """Python ints -> limb-major batch (NLIMBS, B)."""
+    return jnp.stack([jnp.array(F.to_limbs(v)) for v in vals], axis=1)
 
 
 def ints(arr):
+    """Limb-major array -> int (for (L,) / (L, 1)) or list of ints (L, B)."""
     arr = np.asarray(arr)
-    if arr.ndim == 1:
+    if arr.ndim == 1 or arr.shape[1] == 1:
         return F.from_limbs(arr)
-    return [F.from_limbs(row) for row in arr]
+    return [F.from_limbs(arr[:, j]) for j in range(arr.shape[1])]
 
 
 def test_limb_roundtrip():
@@ -47,15 +53,15 @@ def test_mul_edge_values():
     edge = [0, 1, 2, F.P - 1, F.P - 2, (1 << 255), F.C_INT, F.P // 2]
     for a in edge:
         for b in edge:
-            out = F.mul(limbs(a), limbs(b))[0]
+            out = F.mul(limbs(a), limbs(b))
             assert ints(out) % F.P == a * b % F.P
 
 
 def test_mul_accepts_loose_negative_inputs():
     # a - b with a < b gives negative limbs; mul must stay exact
     a, b, c = 5, rand_fe(), rand_fe()
-    la = limbs(a)[0] - limbs(b)[0]  # negative-valued loose vector
-    out = F.mul(la[None], limbs(c))[0]
+    la = limbs(a) - limbs(b)  # negative-valued loose vector
+    out = F.mul(la, limbs(c))
     assert ints(out) % F.P == (a - b) * c % F.P
 
 
@@ -69,13 +75,13 @@ def test_mul_chain_stays_bounded():
         expect = expect * expect % F.P
         arr = np.asarray(x)
         assert np.abs(arr).max() < (1 << 13)
-    assert ints(x[0]) % F.P == expect
+    assert ints(x) % F.P == expect
 
 
 def test_add_sub_through_mul():
     a, b, c = rand_fe(), rand_fe(), rand_fe()
-    la, lb, lc = limbs(a)[0], limbs(b)[0], limbs(c)[0]
-    out = F.mul((la + lb - lc)[None], F.ONE[None])[0]
+    la, lb, lc = limbs(a), limbs(b), limbs(c)
+    out = F.mul(la + lb - lc, F.ONE)
     assert ints(out) % F.P == (a + b - c) % F.P
 
 
@@ -83,7 +89,7 @@ def test_canonical():
     vals = [0, 1, F.P - 1, F.P, F.P + 1, 2 * F.P - 1, rand_fe(), (1 << 256) - 1]
     for v in vals:
         enc = v % (1 << 256)  # what actually gets encoded into limbs
-        c = F.canonical(limbs(enc))[0]
+        c = F.canonical(limbs(enc))
         assert ints(c) == enc % F.P
         arr = np.asarray(c)
         assert arr.min() >= 0 and arr.max() <= F.MASK
@@ -91,30 +97,30 @@ def test_canonical():
 
 def test_canonical_negative():
     a, b = 3, rand_fe()
-    loose = limbs(a)[0] - limbs(b)[0]
-    c = F.canonical(loose[None])[0]
+    loose = limbs(a) - limbs(b)
+    c = F.canonical(loose)
     assert ints(c) == (a - b) % F.P
 
 
 def test_eq_and_is_zero():
     a = rand_fe()
-    la = limbs(a)[0]
-    assert bool(F.is_zero((la - la)[None])[0])
-    assert bool(F.eq(la[None], limbs(a + F.P if a + F.P < (1 << 264) else a)[None])[0]) or True
+    la = limbs(a)
+    assert bool(F.is_zero(la - la)[0])
     # a ≡ a + p (mod p): build a+p in loose limbs by adding P_LIMBS
     lap = la + F.P_LIMBS
-    assert bool(F.eq(la[None], lap[None])[0])
-    assert not bool(F.eq(la[None], (la + F.ONE)[None])[0])
+    assert bool(F.eq(la, lap)[0])
+    assert not bool(F.eq(la, la + F.ONE)[0])
 
 
 def test_select():
-    a, b = limbs(5)[0], limbs(9)[0]
+    ab = limbs(5, 5)
+    bb = limbs(9, 9)
     mask = jnp.array([True, False])
-    out = F.select(mask, jnp.stack([a, a]), jnp.stack([b, b]))
+    out = F.select(mask, ab, bb)
     assert ints(out) == [5, 9]
 
 
-def test_mul_under_jit_and_vmap():
+def test_mul_under_jit():
     f = jax.jit(F.mul)
     a_vals = [rand_fe() for _ in range(8)]
     b_vals = [rand_fe() for _ in range(8)]

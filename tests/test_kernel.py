@@ -26,19 +26,20 @@ rng = random.Random(31337)
 
 
 def to_proj(p: Point):
+    """Affine oracle point -> limb-major projective batch of one (3, L, 1)."""
     if p.infinity:
-        return INFINITY[None]
+        return INFINITY
     return make_point(
-        jnp.array(F.to_limbs(p.x))[None],
-        jnp.array(F.to_limbs(p.y))[None],
-        jnp.array(F.ONE)[None],
+        jnp.array(F.to_limbs(p.x))[:, None],
+        jnp.array(F.to_limbs(p.y))[:, None],
+        jnp.asarray(F.ONE),
     )
 
 
 def to_affine(proj) -> Point:
-    x = F.from_limbs(F.canonical(proj[..., 0, :])[0])
-    y = F.from_limbs(F.canonical(proj[..., 1, :])[0])
-    z = F.from_limbs(F.canonical(proj[..., 2, :])[0])
+    x = F.from_limbs(F.canonical(proj[0]))
+    y = F.from_limbs(F.canonical(proj[1]))
+    z = F.from_limbs(F.canonical(proj[2]))
     if z == 0:
         return Point(None, None)
     zi = pow(z, -1, F.P)
@@ -63,19 +64,19 @@ def test_pt_add_complete_cases():
     # P + (-P) = O
     assert to_affine(pt_add(to_proj(a), to_proj(neg))).infinity
     # P + O = P ; O + P = P
-    assert to_affine(pt_add(to_proj(a), INFINITY[None])) == a
-    assert to_affine(pt_add(INFINITY[None], to_proj(a))) == a
+    assert to_affine(pt_add(to_proj(a), INFINITY)) == a
+    assert to_affine(pt_add(INFINITY, to_proj(a))) == a
     # P + P (degenerate for incomplete formulas) = 2P
     assert to_affine(pt_add(to_proj(a), to_proj(a))) == point_double(a)
     # O + O = O
-    assert to_affine(pt_add(INFINITY[None], INFINITY[None])).infinity
+    assert to_affine(pt_add(INFINITY, INFINITY)).infinity
 
 
 def test_pt_double_matches_oracle():
     for _ in range(3):
         a = rand_point()
         assert to_affine(pt_double(to_proj(a))) == point_double(a)
-    assert to_affine(pt_double(INFINITY[None])).infinity
+    assert to_affine(pt_double(INFINITY)).infinity
 
 
 def _random_batch(count, tamper_every=3):
@@ -136,3 +137,26 @@ def test_kernel_z_zero_signature():
 def test_kernel_padding():
     items, expected = _random_batch(5)
     assert verify_batch_tpu(items, pad_to=8) == expected
+
+
+def test_glv_split_properties():
+    from tpunode.verify.kernel import LAMBDA, WINDOWS, WINDOW_BITS, glv_split
+
+    bound = 1 << (WINDOW_BITS * WINDOWS)
+    for _ in range(200):
+        k = rng.getrandbits(256) % CURVE_N
+        k1, k2 = glv_split(k)
+        assert (k1 + k2 * LAMBDA - k) % CURVE_N == 0
+        assert abs(k1) < bound and abs(k2) < bound
+        # halves really are half-width (the point of the decomposition)
+        assert abs(k1) < 1 << 129 and abs(k2) < 1 << 129
+
+
+def test_beta_endomorphism_is_lambda_mul():
+    from tpunode.verify.ecdsa_cpu import CURVE_P
+    from tpunode.verify.kernel import BETA, LAMBDA
+
+    for _ in range(5):
+        p = rand_point()
+        phi = Point(BETA * p.x % CURVE_P, p.y)
+        assert phi == point_mul(LAMBDA, p)

@@ -1,7 +1,9 @@
 """secp256k1 group operations on TPU: complete projective formulas.
 
 Points are projective ``(X : Y : Z)`` triples of limb vectors, stored as one
-array of shape ``(..., 3, NLIMBS)``; infinity is ``(0 : 1 : 0)``.
+array of shape ``(3, NLIMBS, B)`` — limb-major layout (see field.py): the
+batch axis is minor-most so it lands in TPU lanes.  Infinity is
+``(0 : 1 : 0)``, shape ``(3, NLIMBS, 1)``, broadcasting over the batch.
 
 We use the Renes–Costello–Batina *complete* addition/doubling formulas for
 prime-order short-Weierstrass curves with a = 0 (RCB'16, Algorithms 7 and 9,
@@ -36,7 +38,7 @@ B3 = 21  # 3 * b for y^2 = x^3 + 7
 
 
 def make_point(x: jnp.ndarray, y: jnp.ndarray, z: jnp.ndarray) -> jnp.ndarray:
-    return jnp.stack([x, y, z], axis=-2)
+    return jnp.stack([x, y, z], axis=0)
 
 
 INFINITY = make_point(F.ZERO, F.ONE, F.ZERO)
@@ -44,23 +46,26 @@ INFINITY = make_point(F.ZERO, F.ONE, F.ZERO)
 
 def is_infinity(p: jnp.ndarray) -> jnp.ndarray:
     """Z ≡ 0 (mod p) — exact; a finite point can never have Z ≡ 0."""
-    return F.is_zero(p[..., 2, :])
+    return F.is_zero(p[2])
 
 
 def pt_select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Branch-free ``mask ? a : b`` over whole points."""
-    return jnp.where(mask[..., None, None], a, b)
+    return jnp.where(mask, a, b)
 
 
 def pt_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     """Complete addition (RCB'16 Algorithm 7, a = 0): 12 muls, no exceptions.
 
-    Limb-bound audit (field.mul accepts |limb| <= 2^18 and returns <= 2^12):
-    every operand below is a mul output (<= 2^12), a 2-term sum (<= 2^13) or
-    a B3 scaling (<= 21 * 2^13 < 2^18) — all inside the contract.
+    Limb-bound audit against field.mul's contract (|non-top limb| <= 2^19,
+    |top limb| <= 2^15, pairwise top(a)*top(b) <= 2^30): every mul operand
+    below is a mul output (every limb <= 2^12), a 2-3-term sum of mul
+    outputs (<= 2^13.6, top included), or a mul_small_red result (non-top
+    <= 2^19, top <= 2^12) — the raw B3 scalings that used to exceed the
+    top-limb bound now go through mul_small_red.
     """
-    X1, Y1, Z1 = p[..., 0, :], p[..., 1, :], p[..., 2, :]
-    X2, Y2, Z2 = q[..., 0, :], q[..., 1, :], q[..., 2, :]
+    X1, Y1, Z1 = p[0], p[1], p[2]
+    X2, Y2, Z2 = q[0], q[1], q[2]
     mul = F.mul
 
     t0 = mul(X1, X2)
@@ -73,10 +78,10 @@ def pt_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     t5 = mul(X1 + Z1, X2 + Z2)
     t5 = t5 - (t0 + t2)  # = X1*Z2 + X2*Z1
     t0_3 = t0 + t0 + t0  # 3*X1*X2
-    t2_b3 = F.mul_small(t2, B3)
+    t2_b3 = F.mul_small_red(t2, B3)  # reduced: keeps z3/t1m inside mul's contract
     z3 = t1 + t2_b3
     t1m = t1 - t2_b3
-    y3 = F.mul_small(t5, B3)
+    y3 = F.mul_small_red(t5, B3)  # reduced: y3 feeds two muls below
     x3 = mul(t4, y3)
     t2b = mul(t3, t1m)
     x3 = t2b - x3
@@ -91,14 +96,14 @@ def pt_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
 
 def pt_double(p: jnp.ndarray) -> jnp.ndarray:
     """Complete doubling (RCB'16 Algorithm 9, a = 0): 6 muls + 2 squarings."""
-    X, Y, Z = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+    X, Y, Z = p[0], p[1], p[2]
     mul = F.mul
 
     t0 = mul(Y, Y)
     z3 = t0 * 8  # 8Y^2, |limb| <= 2^15
     t1 = mul(Y, Z)
     t2 = mul(Z, Z)
-    t2 = F.mul_small(t2, B3)  # b3*Z^2, <= 21*2^12
+    t2 = F.mul_small_red(t2, B3)  # b3*Z^2, reduced (mul-input safe)
     x3 = mul(t2, z3)
     y3 = t0 + t2
     z3 = mul(t1, z3)

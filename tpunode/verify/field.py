@@ -1,9 +1,16 @@
 """256-bit modular arithmetic for the secp256k1 field on TPU.
 
 TPUs have no wide integers, so field elements are vectors of NLIMBS=24 limbs
-of RADIX=11 bits in int32 lanes (shape ``(..., 24)``).  Everything is a
-fixed-shape, branch-free jnp program — what XLA fuses and tiles best — and
-batches via leading dimensions.
+of RADIX=11 bits in int32 lanes.  **Layout is limb-major**: an element batch
+has shape ``(NLIMBS, B)`` — the limb axis is axis 0 (sublanes: 24 = 3x8,
+zero padding) and the batch axis is minor-most (lanes: B a multiple of 128
+tiles perfectly).  The transposed layout ``(B, NLIMBS)`` would pad the
+24-limb minor dim to 128 lanes (~19% utilization); limb-major is the single
+biggest throughput lever on this kernel.
+
+Everything is a fixed-shape, branch-free jnp program — what XLA fuses and
+tiles best.  Constants are shape ``(NLIMBS, 1)`` so they broadcast over the
+trailing batch axis.
 
 Key design points (bounds are load-bearing):
 
@@ -12,10 +19,10 @@ Key design points (bounds are load-bearing):
   arithmetic ``>> RADIX`` keep carry rounds exact for negatives, which makes
   subtraction free (no borrow chains).
 * **Multiplication** internally tightens both inputs with one carry round
-  (bringing limbs to ``< 2**12``), then does the 24x24 limb convolution
-  (partials < 2**24, anti-diagonal sums of <= 24 terms < 2**28.6 — far inside
-  int32), then folds limbs >= 24 back using the sparse prime:
-  2^264 ≡ 256*(2^32+977) (mod p).
+  (bringing limbs to ``< 2**12``), then does the 24x24 limb convolution in
+  direct shift-add form (partials < 2**24, anti-diagonal sums of <= 24 terms
+  < 2**28.6 — far inside int32), then folds limbs >= 24 back using the
+  sparse prime: 2^264 ≡ 256*(2^32+977) (mod p).
 * **No value is ever dropped**: carry rounds preserve the top limb's
   overflow in place instead of discarding it, and every buffer that carries a
   fat top limb is padded first.
@@ -44,7 +51,7 @@ __all__ = [
     "from_limbs",
     "mul",
     "sqr",
-    "mul_small",
+    "mul_small_red",
     "tighten",
     "canonical",
     "is_zero",
@@ -72,12 +79,15 @@ def _limbs_list(v: int, n: int) -> list[int]:
 
 
 def to_limbs(v: int, n: int = NLIMBS) -> np.ndarray:
-    """Host: Python int -> little-endian limb vector (int32)."""
+    """Host: Python int -> little-endian limb vector (int32), shape (n,)."""
     return np.array(_limbs_list(v, n), dtype=np.int32)
 
 
 def from_limbs(limbs) -> int:
-    """Host: limb vector (loose/negative limbs fine) -> Python int."""
+    """Host: limb vector (loose/negative limbs fine) -> Python int.
+
+    Accepts shape (L,) or (L, 1); the limb axis must be axis 0.
+    """
     out = 0
     for i, l in enumerate(np.asarray(limbs).reshape(-1).tolist()):
         out += int(l) << (RADIX * i)
@@ -86,16 +96,22 @@ def from_limbs(limbs) -> int:
 
 FOLD = jnp.array(_limbs_list(FOLD_INT, _FN), dtype=jnp.int32)
 C_LIMBS = jnp.array(_limbs_list(C_INT, _FN), dtype=jnp.int32)
-P_LIMBS = jnp.array(_limbs_list(P, NLIMBS), dtype=jnp.int32)
-ZERO = jnp.zeros((NLIMBS,), dtype=jnp.int32)
-ONE = jnp.zeros((NLIMBS,), dtype=jnp.int32).at[0].set(1)
+P_LIMBS = jnp.array(_limbs_list(P, NLIMBS), dtype=jnp.int32)[:, None]
+ZERO = jnp.zeros((NLIMBS, 1), dtype=jnp.int32)
+ONE = jnp.zeros((NLIMBS, 1), dtype=jnp.int32).at[0].set(1)
 
-# anti-diagonal one-hot: S[i, j, k] = [i + j == k], for the limb convolution
-_S = np.zeros((NLIMBS, NLIMBS, 2 * NLIMBS - 1), dtype=np.int32)
-for _i in range(NLIMBS):
-    for _j in range(NLIMBS):
-        _S[_i, _j, _i + _j] = 1
-S_CONV = jnp.array(_S)
+
+def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Limb convolution: (24, B) x (24, B) -> (47, B).
+
+    Direct shift-add form (24 broadcast multiplies + static slice-adds):
+    exactly the 24*24 partial products, nothing more — XLA fuses the
+    whole chain into vector code with no materialized outer product.
+    """
+    out = jnp.zeros((2 * NLIMBS - 1,) + a.shape[1:], dtype=jnp.int32)
+    for i in range(NLIMBS):
+        out = out.at[i : i + NLIMBS].add(a[i] * b)
+    return out
 
 
 def _carry(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
@@ -104,14 +120,14 @@ def _carry(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
     for _ in range(rounds):
         lo = x & MASK
         hi = x >> RADIX
-        y = lo.at[..., 1:].add(hi[..., :-1])
-        x = y.at[..., -1].add(hi[..., -1] << RADIX)
+        y = lo.at[1:].add(hi[:-1])
+        x = y.at[-1].add(hi[-1] << RADIX)
     return x
 
 
 def _pad(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.concatenate(
-        [x, jnp.zeros(x.shape[:-1] + (n,), dtype=jnp.int32)], axis=-1
+        [x, jnp.zeros((n,) + x.shape[1:], dtype=jnp.int32)], axis=0
     )
 
 
@@ -124,61 +140,79 @@ def _fold_once(wide: jnp.ndarray) -> jnp.ndarray:
     """Fold limbs >= NLIMBS back via 2^264 ≡ FOLD (mod p).
 
     Contract: |limb| <= 2^15 (so partials hi*FOLD <= 2^26, 4-term sums
-    <= 2^28).  Output: (..., NLIMBS) with |limb| <= 2^28-ish (loose; callers
+    <= 2^28).  Output: (NLIMBS, ...) with |limb| <= 2^28-ish (loose; callers
     carry right after).
     """
-    lo = wide[..., :NLIMBS]
-    hi = wide[..., NLIMBS:]
-    k = hi.shape[-1]
+    lo = wide[:NLIMBS]
+    hi = wide[NLIMBS:]
+    k = hi.shape[0]
     out = _pad(lo, max(0, k + _FN - 1 - NLIMBS))
     for i in range(_FN):
-        out = out.at[..., i : i + k].add(FOLD[i] * hi)
-    if out.shape[-1] > NLIMBS:
+        out = out.at[i : i + k].add(FOLD[i] * hi)
+    if out.shape[0] > NLIMBS:
         out = _carry(_pad(out, 1), 2)
         return _fold_once(out)
     return out
 
 
+def _fold_top(x: jnp.ndarray) -> jnp.ndarray:
+    """Carry into a 25th limb, then fold it back via 2^264 ≡ FOLD (mod p):
+    (NLIMBS, ...) in, (NLIMBS, ...) out with the top limb's overflow folded
+    into the low _FN limbs.  The shared tail of _tight24 / mul /
+    mul_small_red — the most bound-sensitive snippet in the module, so it
+    lives in exactly one place."""
+    x = _carry(_pad(x, 1), 1)
+    hi = x[NLIMBS]
+    x = x[:NLIMBS]
+    return x.at[:_FN].add(FOLD[:, None] * hi[None])
+
+
 def _tight24(a: jnp.ndarray) -> jnp.ndarray:
     """Bring EVERY limb (including the top one) under ~2^12 without losing
-    value: carry into a 25th limb, fold it back via 2^264 ≡ FOLD, carry once
-    more.  Needed because plain carry rounds preserve (never shrink) the top
-    limb."""
-    a = _carry(_pad(a, 1), 1)
-    hi = a[..., NLIMBS]
-    a = a[..., :NLIMBS]
-    a = a.at[..., :_FN].add(FOLD * hi[..., None])
-    return _carry(a, 1)
+    value.  Needed because plain carry rounds preserve (never shrink) the
+    top limb."""
+    return _carry(_fold_top(a), 1)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Modular multiply mod p.
 
-    Inputs loose (|limb| <= 2^18); output loose with |limb| <= 2^12 and
-    value magnitude < 2^265.  Exact modulo p, sign-correct.
+    Input contract (audited at every call site in curve.py/kernel.py):
+    |non-top limbs| <= 2^19, |top limb| <= 2^15, and for the PAIR
+    top(a)*top(b) <= 2^30.  One internal carry round then brings non-top
+    limbs under 2^11.3 while preserving each top limb, so every
+    anti-diagonal convolution sum stays below 2^31 (int32-exact):
+    mid diagonals <= 24*2^22.6, the single top*top term <= 2^30, mixed
+    top terms <= 2*2^15*2^11.3.  Output loose with |limb| <= 2^12, non-top
+    <= 2^11.2, and value magnitude < 2^265.  Exact modulo p, sign-correct.
+
+    (Operands that are sums of a few mul outputs satisfy this trivially:
+    mul outputs have every limb <= 2^12.  The B3/8 scalings are the only
+    spots that need care — see mul_small_red and the audit notes in
+    curve.py.)
     """
-    a = _tight24(a)  # all limbs < ~2^12
-    b = _tight24(b)
-    prod = a[..., :, None] * b[..., None, :]  # (..., 24, 24), |v| < 2^24
-    wide = jnp.einsum("...ij,ijk->...k", prod, S_CONV)  # 47 limbs, < 2^28.6
+    a = _carry(a, 1)
+    b = _carry(b, 1)
+    wide = _conv(a, b)  # 47 limbs, anti-diagonal sums < 2^28.6
     wide = _carry(_pad(wide, 1), 2)  # 48 limbs, |v| <= 2^12 (top <= 2^15)
     x = _fold_once(wide)  # 24 limbs, loose <= 2^28
-    x = _carry(_pad(x, 1), 2)  # 25 limbs, <= 2^12, top small
-    # fold the residual 25th limb (value * 2^264)
-    hi = x[..., NLIMBS]
-    x = x[..., :NLIMBS]
-    x = x.at[..., :_FN].add(FOLD * hi[..., None])
-    return _carry(x, 1)
+    x = _carry(x, 1)  # <= 2^12, top <= 2^17-ish
+    return _carry(_fold_top(x), 1)  # fold residual top overflow; <= 2^12
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
     return mul(a, a)
 
 
-def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Scale by a small constant (|k| <= 32); result loose (needs |a| <= 2^12
-    to stay within the 2^17 loose contract)."""
-    return a * k
+def mul_small_red(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Scale by a small constant AND reduce so the result is a valid
+    ``mul`` input even though |value| grows past 2^268: carry into a 25th
+    limb, fold it back via 2^264 ≡ FOLD (mod p).
+
+    Contract: |a limbs| <= 2^15, |k| <= 32.  Output: value < 2^265,
+    |non-top limbs| <= 2^19, |top limb| <= 2^12 — inside mul's contract.
+    """
+    return _fold_top(a * k)
 
 
 # ---------- exact canonicalization & comparisons ----------
@@ -186,7 +220,7 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
 # A comfortably large multiple of p added before canonicalizing so negative
 # values become positive: loose values are bounded by |v| < 2^266.
 _BIG_INT = ((1 << 267) // P + 1) * P
-_BIG = jnp.array(_limbs_list(_BIG_INT, NLIMBS + 1), dtype=jnp.int32)
+_BIG = jnp.array(_limbs_list(_BIG_INT, NLIMBS + 1), dtype=jnp.int32)[:, None]
 
 
 def canonical(x: jnp.ndarray) -> jnp.ndarray:
@@ -200,13 +234,13 @@ def canonical(x: jnp.ndarray) -> jnp.ndarray:
     wide = _pad(x, 1) + _BIG  # nonnegative, < 2^268
     wide = _carry(wide, NLIMBS + 4)  # canonical limbs (top limb <= 2^16)
     # fold value at the 2^256 boundary: bits 256+ are limb23>>3 and limb24
-    hi = (wide[..., NLIMBS - 1] >> 3) + (wide[..., NLIMBS] << 8)
-    lo = wide[..., :NLIMBS].at[..., NLIMBS - 1].set(wide[..., NLIMBS - 1] & 7)
-    lo = lo.at[..., :_FN].add(C_LIMBS * hi[..., None])  # += hi * (2^256 mod p)
+    hi = (wide[NLIMBS - 1] >> 3) + (wide[NLIMBS] << 8)
+    lo = wide[:NLIMBS].at[NLIMBS - 1].set(wide[NLIMBS - 1] & 7)
+    lo = lo.at[:_FN].add(C_LIMBS[:, None] * hi[None])  # += hi * (2^256 mod p)
     lo = _carry(lo, NLIMBS + 2)  # canonical, value < 2^256 + 2^47 < 2p
     for _ in range(2):
         ge_p = _ge(lo, P_LIMBS)
-        lo = lo - jnp.where(ge_p[..., None], P_LIMBS, 0)
+        lo = lo - jnp.where(ge_p, P_LIMBS, 0)
         lo = _carry(lo, NLIMBS + 1)  # resolve borrows (result nonnegative)
     return lo
 
@@ -215,14 +249,14 @@ def _ge(a: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
     """Lexicographic >= over canonical (nonnegative, in-range) limb vectors."""
     diff = a - m
     nz = diff != 0
-    idx = (NLIMBS - 1) - jnp.argmax(nz[..., ::-1], axis=-1)
-    top = jnp.take_along_axis(diff, idx[..., None], axis=-1)[..., 0]
-    return jnp.where(jnp.any(nz, axis=-1), top > 0, True)
+    idx = (NLIMBS - 1) - jnp.argmax(nz[::-1], axis=0)
+    top = jnp.take_along_axis(diff, idx[None], axis=0)[0]
+    return jnp.where(jnp.any(nz, axis=0), top > 0, True)
 
 
 def is_zero(x: jnp.ndarray) -> jnp.ndarray:
     """value ≡ 0 (mod p)?  Exact."""
-    return jnp.all(canonical(x) == 0, axis=-1)
+    return jnp.all(canonical(x) == 0, axis=0)
 
 
 def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -231,5 +265,6 @@ def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Branch-free ``mask ? a : b`` (mask broadcasts over the limb dim)."""
-    return jnp.where(mask[..., None], a, b)
+    """Branch-free ``mask ? a : b`` (mask (B,) broadcasts over the leading
+    limb axis)."""
+    return jnp.where(mask, a, b)

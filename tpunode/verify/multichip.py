@@ -7,8 +7,9 @@ axis; ring/Ulysses-style sequence parallelism is deliberately unnecessary
 here and documented as such), so the multi-chip design is pure DP:
 
 * a 1-D ``Mesh`` over all chips, axis ``"batch"``;
-* every input array sharded along its leading batch dimension
-  (``PartitionSpec("batch")``) — host→device transfer is split per chip;
+* every input array sharded along its batch dimension — the minor-most
+  axis for limb-major arrays (see field.py), the only axis for the masks —
+  so host→device transfer is split per chip;
 * ``shard_map`` runs the same single-chip program :func:`kernel.verify_core`
   on each shard — zero inter-chip traffic in the hot loop;
 * one ``psum`` over ICI reduces the per-shard valid-counts so every chip
@@ -36,7 +37,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from .ecdsa_cpu import Point
-from .kernel import prepare_batch, verify_core
+from .kernel import ARG_IS_2D, prepare_batch, verify_core
 
 __all__ = ["make_mesh", "sharded_verify_fn", "verify_batch_sharded"]
 
@@ -63,10 +64,13 @@ def sharded_verify_fn(mesh: Mesh):
     cached = _FN_CACHE.get(mesh)
     if cached is not None:
         return cached
-    spec_b = P("batch")
+    # limb-major layout: batch is the trailing axis of the 2-D arrays
+    spec_2d = P(None, "batch")
+    spec_1d = P("batch")
+    in_specs = tuple(spec_2d if is2d else spec_1d for is2d in ARG_IS_2D)
 
-    def step(u1, u2, qx, qy, r1, r2, r2v, hv):
-        ok = verify_core(u1, u2, qx, qy, r1, r2, r2v, hv)
+    def step(*args):
+        ok = verify_core(*args)
         total = lax.psum(jnp.sum(ok.astype(jnp.int32)), "batch")
         return ok, total
 
@@ -77,16 +81,16 @@ def sharded_verify_fn(mesh: Mesh):
         sharded = _shard_map(
             step,
             mesh=mesh,
-            in_specs=(spec_b,) * 8,
-            out_specs=(spec_b, P()),
+            in_specs=in_specs,
+            out_specs=(spec_1d, P()),
             check_vma=False,
         )
     except TypeError:  # pragma: no cover - older jax spells it check_rep
         sharded = _shard_map(
             step,
             mesh=mesh,
-            in_specs=(spec_b,) * 8,
-            out_specs=(spec_b, P()),
+            in_specs=in_specs,
+            out_specs=(spec_1d, P()),
             check_rep=False,
         )
     fn = jax.jit(sharded)
@@ -114,19 +118,11 @@ def verify_batch_sharded(
     prep = prepare_batch(items, pad_to=size)
 
     fn = sharded_verify_fn(mesh)
-    shard = NamedSharding(mesh, P("batch"))
+    shard_2d = NamedSharding(mesh, P(None, "batch"))
+    shard_1d = NamedSharding(mesh, P("batch"))
     args = [
-        jax.device_put(np.asarray(a), shard)
-        for a in (
-            prep.u1_digits,
-            prep.u2_digits,
-            prep.qx,
-            prep.qy,
-            prep.r1,
-            prep.r2,
-            prep.r2_valid,
-            prep.host_valid,
-        )
+        jax.device_put(np.asarray(a), shard_2d if is2d else shard_1d)
+        for a, is2d in zip(prep.device_args, ARG_IS_2D)
     ]
     ok, _total = fn(*args)
     return [bool(b) for b in np.asarray(ok)[: prep.count]]
