@@ -1,0 +1,396 @@
+"""The five BASELINE.json benchmark configurations.
+
+Usage::
+
+    python -m benchmarks.run config2          # one config
+    python -m benchmarks.run all              # everything runnable here
+
+Each config prints exactly one JSON line (driver bench.py schema plus
+detail fields).  Workloads are synthetic but shaped like the targets
+(BASELINE.md: zero-egress environment, no real mainnet data), generated
+deterministically by benchmarks.txgen and cached under benchmarks/data.
+
+Environment knobs:
+    TPUNODE_BENCH_SMALL=1   shrink every config (CI / CPU-jax smoke runs)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import statistics
+import sys
+import time
+
+SMALL = os.environ.get("TPUNODE_BENCH_SMALL") == "1"
+
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+def _make_triples(n: int, seed: int = 0xBE5C, invalid_every: int = 16):
+    from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+
+    rng = random.Random(seed)
+    items = []
+    for i in range(n):
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        z = rng.getrandbits(256)
+        r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+        if invalid_every and i % invalid_every == invalid_every - 1:
+            z ^= 1
+        items.append((pub, z, r, s))
+    return items
+
+
+def _tile(items, n):
+    return (items * (n // len(items) + 1))[:n]
+
+
+def _device_kind():
+    import jax
+
+    d = jax.devices()[0]
+    return f"{d.platform}:{getattr(d, 'device_kind', '?')}"
+
+
+# --- config 1: block-800000-shaped tx set, CPU single-core baseline -------
+
+
+def config1() -> None:
+    """Single big-block tx set through the C++ CPU verifier (single core).
+    This IS the baseline reference point (BASELINE.md config 1): mainnet
+    block 800000 carried ~3,700 inputs; we use a 4,096-signature stand-in."""
+    from tpunode.txverify import extract_sig_items
+    from tpunode.verify.cpu_native import load_native_verifier
+    from benchmarks.txgen import gen_signed_txs
+
+    n_txs = 64 if SMALL else 2048  # 2 sigs each -> 4096 sigs
+    txs = gen_signed_txs(n_txs, inputs_per_tx=2, seed=0x800000, invalid_every=0)
+    items = []
+    for tx in txs:
+        its, _ = extract_sig_items(tx)
+        items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
+    v = load_native_verifier()
+    v.verify_batch(items[:16])  # warm
+    t0 = time.perf_counter()
+    out = v.verify_batch(items)
+    dt = time.perf_counter() - t0
+    assert all(out), "baseline block must verify fully"
+    _emit(
+        {
+            "metric": "config1_block800k_cpu_verify",
+            "value": round(len(items) / dt, 1),
+            "unit": "sigs/sec/core",
+            "vs_baseline": 1.0,
+            "sigs": len(items),
+            "wall_s": round(dt, 4),
+        }
+    )
+
+
+# --- config 2: synthetic 10k batch on the device --------------------------
+
+
+def config2() -> None:
+    """10k random triples through the device kernel at batch 4096
+    (BASELINE.md config 2; the repo-root bench.py is this config's
+    single-batch steady-state variant)."""
+    import jax.numpy as jnp
+
+    from tpunode.verify.cpu_native import load_native_verifier
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+    from tpunode.verify.kernel import prepare_batch, verify_batch_tpu
+
+    total = 640 if SMALL else 10_240
+    batch = 128 if SMALL else 4096
+    uniq = _make_triples(min(total, 512))
+    items = _tile(uniq, total)
+    # correctness first: one chunk vs oracle
+    assert verify_batch_tpu(items[:64], pad_to=batch) == verify_batch_cpu(
+        items[:64]
+    )
+    # steady state: time chunked dispatch
+    t0 = time.perf_counter()
+    n = 0
+    for off in range(0, total, batch):
+        chunk = items[off : off + batch]
+        verify_batch_tpu(chunk, pad_to=batch)
+        n += len(chunk)
+    dt = time.perf_counter() - t0
+
+    v = load_native_verifier()
+    sample = uniq[:256]
+    v.verify_batch(sample[:8])
+    t1 = time.perf_counter()
+    v.verify_batch(sample)
+    cpu_rate = len(sample) / (time.perf_counter() - t1)
+    _emit(
+        {
+            "metric": "config2_synthetic10k_device_verify",
+            "value": round(n / dt, 1),
+            "unit": "sigs/sec/chip",
+            "vs_baseline": round(n / dt / cpu_rate, 2),
+            "device": _device_kind(),
+            "sigs": n,
+            "batch": batch,
+            "wall_s": round(dt, 4),
+            "note": "includes host prep each batch (end-to-end dispatch)",
+        }
+    )
+
+
+# --- config 3: IBD replay from a header-store snapshot --------------------
+
+
+def config3() -> None:
+    """IBD replay (BASELINE.md config 3): parse stored blocks, extract
+    signatures, stream through the verify engine in fixed 4096 batches;
+    consensus (header connect) runs alongside, and TPU verdicts are checked
+    against the CPU oracle on a sample."""
+    from tpunode.headers import MemoryHeaderStore, connect_blocks
+    from tpunode.params import BCH_REGTEST
+    from tpunode.txverify import extract_sig_items
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+    from tpunode.verify.engine import VerifyConfig, VerifyEngine
+    from benchmarks.txgen import gen_chain
+
+    n_blocks = 50 if SMALL else 1000
+    txs_per_block = 2 if SMALL else 8  # 8 txs x 2 sigs = 16 sigs/block
+    batch = 128 if SMALL else 4096
+    blocks = gen_chain(
+        BCH_REGTEST,
+        n_blocks,
+        txs_per_block,
+        cache=f"ibd_{n_blocks}x{txs_per_block}.bin",
+    )
+
+    async def replay() -> tuple[int, float, int]:
+        engine = VerifyEngine(VerifyConfig(batch_size=batch, max_wait=0.002))
+        store = MemoryHeaderStore(BCH_REGTEST)
+        sigs = 0
+        t0 = time.perf_counter()
+        async with engine:
+            pending = []
+            now = int(time.time())
+            for b in blocks:
+                nodes, best = connect_blocks(store, BCH_REGTEST, now, [b.header])
+                store.add_headers(nodes)
+                store.set_best(best)
+                items = []
+                for tx in b.txs:
+                    its, _ = extract_sig_items(tx)
+                    items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
+                if items:
+                    sigs += len(items)
+                    pending.append(asyncio.ensure_future(engine.verify(items)))
+            results = await asyncio.gather(*pending)
+            dt = time.perf_counter() - t0
+            flat = [v for r in results for v in r]
+            assert all(flat), "IBD replay signatures must all verify"
+            # consensus-identical check on a sample vs the oracle
+            sample_items = []
+            for b in blocks[:2]:
+                for tx in b.txs:
+                    its, _ = extract_sig_items(tx)
+                    sample_items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
+            assert verify_batch_cpu(sample_items) == [True] * len(sample_items)
+            return sigs, dt, store.get_best().height
+
+    sigs, dt, height = asyncio.run(replay())
+    _emit(
+        {
+            "metric": "config3_ibd_replay",
+            "value": round(dt, 3),
+            "unit": "seconds_wall",
+            "vs_baseline": round(sigs / dt, 1),
+            "blocks": len(blocks),
+            "height": height,
+            "sigs": sigs,
+            "sigs_per_sec": round(sigs / dt, 1),
+            "device": _device_kind(),
+        }
+    )
+
+
+# --- config 4: mempool firehose via 8 fake peers --------------------------
+
+
+def config4() -> None:
+    """Mempool firehose (BASELINE.md config 4): a full Node with the verify
+    hook enabled, 8 in-process wire-speaking peers streaming tx gossip;
+    measures end-to-end TxVerdict throughput through the event bus."""
+    from tpunode.actors import Publisher
+    from tpunode.node import Node, NodeConfig, TxVerdict
+    from tpunode.params import BCH_REGTEST
+    from tpunode.store import MemoryKV
+    from tpunode.verify.engine import VerifyConfig
+    from tpunode.wire import MsgTx, encode_message
+    from benchmarks.txgen import gen_signed_txs
+    from tests.fakenet import QueueConnection, _fake_remote
+
+    import contextlib
+
+    n_peers = 2 if SMALL else 8
+    n_txs = 40 if SMALL else 1024  # unique; tiled across peers
+    duration = 3.0 if SMALL else 15.0
+    batch = 128 if SMALL else 4096
+    txs = gen_signed_txs(n_txs, inputs_per_tx=2, seed=0xF12E, invalid_every=64)
+
+    async def run() -> tuple[int, int, float]:
+        from tests import fixtures
+
+        blocks = fixtures.all_blocks()
+        net = BCH_REGTEST
+
+        def firehose_connect():
+            @contextlib.asynccontextmanager
+            async def factory():
+                to_node: asyncio.Queue = asyncio.Queue()
+                from_node: asyncio.Queue = asyncio.Queue()
+                remote = asyncio.ensure_future(
+                    _fake_remote(net, blocks, to_node, from_node)
+                )
+
+                async def pump():
+                    await asyncio.sleep(0.25)  # let the handshake finish first
+                    i = 0
+                    while True:
+                        msg = MsgTx(txs[i % len(txs)])
+                        to_node.put_nowait(encode_message(net, msg))
+                        i += 1
+                        if i % 64 == 0:
+                            await asyncio.sleep(0.001)
+
+                pumper = asyncio.ensure_future(pump())
+                try:
+                    yield QueueConnection(to_node, from_node)
+                finally:
+                    pumper.cancel()
+                    remote.cancel()
+                    for t in (pumper, remote):
+                        with contextlib.suppress(
+                            asyncio.CancelledError, Exception
+                        ):
+                            await t
+
+            return factory
+
+        pub = Publisher(name="firehose")
+        cfg = NodeConfig(
+            net=net,
+            store=MemoryKV(),
+            pub=pub,
+            peers=[f"192.0.2.{i}:8333" for i in range(1, n_peers + 1)],
+            discover=False,
+            max_peers=n_peers,
+            connect=lambda sa: firehose_connect(),
+            verify=VerifyConfig(batch_size=batch, max_wait=0.005),
+        )
+        verdicts = 0
+        sigs = 0
+        async with pub.subscription() as events:
+            async with Node(cfg):
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < duration:
+                    try:
+                        ev = await asyncio.wait_for(events.receive(), 2.0)
+                    except asyncio.TimeoutError:
+                        continue
+                    if isinstance(ev, TxVerdict):
+                        verdicts += 1
+                        sigs += len(ev.verdicts)
+                dt = time.perf_counter() - t0
+        return verdicts, sigs, dt
+
+    verdicts, sigs, dt = asyncio.run(run())
+    _emit(
+        {
+            "metric": "config4_mempool_firehose",
+            "value": round(sigs / dt, 1),
+            "unit": "sigs/sec_end_to_end",
+            "vs_baseline": round(verdicts / dt, 1),
+            "peers": n_peers,
+            "tx_verdicts": verdicts,
+            "sigs": sigs,
+            "wall_s": round(dt, 2),
+            "device": _device_kind(),
+        }
+    )
+
+
+# --- config 5: BCH 32 MB-block stress, multi-chip -------------------------
+
+
+def config5() -> None:
+    """32 MB-block stress (BASELINE.md config 5): ~150k signatures (tiled
+    from a unique pool — device work is identical) verified via shard_map
+    over every available chip; on the single-chip dev box the mesh has one
+    device, on CPU-jax runs set XLA_FLAGS=--xla_force_host_platform_device_count=8."""
+    import jax
+
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+    from tpunode.verify.multichip import make_mesh, verify_batch_sharded
+
+    total = 1024 if SMALL else 153_600
+    uniq = _make_triples(512 if not SMALL else 64, seed=0x32B)
+    items = _tile(uniq, total)
+    mesh = make_mesh()
+    n_dev = mesh.devices.size
+    # correctness on a slice
+    assert verify_batch_sharded(items[: 4 * n_dev], mesh=mesh) == verify_batch_cpu(
+        items[: 4 * n_dev]
+    )
+    t0 = time.perf_counter()
+    out = verify_batch_sharded(items, mesh=mesh)
+    dt = time.perf_counter() - t0
+    expected = _tile([bool(b) for b in verify_batch_cpu(uniq)], total)
+    assert out == expected
+    _emit(
+        {
+            "metric": "config5_32mb_block_multichip",
+            "value": round(total / dt, 1),
+            "unit": "sigs/sec_total",
+            "vs_baseline": round(total / dt / max(1, n_dev), 1),
+            "devices": n_dev,
+            "device": _device_kind(),
+            "sigs": total,
+            "wall_s": round(dt, 3),
+        }
+    )
+
+
+CONFIGS = {
+    "config1": config1,
+    "config2": config2,
+    "config3": config3,
+    "config4": config4,
+    "config5": config5,
+}
+
+
+def main(argv: list[str]) -> None:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    # Honor JAX_PLATFORMS even where a sitecustomize shim force-sets the
+    # platform list (this box's TPU tunnel does): pin it via jax.config
+    # before the first device use.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+    which = argv[0] if argv else "all"
+    names = list(CONFIGS) if which == "all" else [which]
+    for name in names:
+        CONFIGS[name]()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

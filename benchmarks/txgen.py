@@ -1,0 +1,160 @@
+"""Deterministic generation of realistic signed-transaction workloads.
+
+Builds P2PKH-spending transactions signed with the CPU oracle and packs
+them into consensus-valid regtest blocks (headers connect under
+tpunode.headers.connect_blocks: correct prev-links, merkle roots, and
+regtest PoW by nonce grinding against the trivial target).  Everything is
+seeded and cached on disk, so benchmark runs are reproducible and the
+pure-Python signing cost is paid once.
+
+The reference has no benchmark data generator (SURVEY.md §6: no benchmarks
+anywhere); this is the stand-in for its real-world inputs (mainnet block
+800000, IBD replay, mempool firehose) in a zero-egress environment —
+shaped like the real thing, labelled synthetic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+import time
+from typing import Optional
+
+from tpunode.headers import genesis_node
+from tpunode.util import bits_to_target
+from tpunode.params import Network
+from tpunode.sighash import SIGHASH_ALL, legacy_sighash
+from tpunode.txverify import _p2pkh_script_code
+from tpunode.util import Reader, double_sha256
+from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+from tpunode.wire import (
+    Block,
+    BlockHeader,
+    OutPoint,
+    Tx,
+    TxIn,
+    TxOut,
+    build_merkle_root,
+)
+
+__all__ = ["gen_signed_txs", "gen_chain", "cache_path"]
+
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "data")
+
+
+def cache_path(name: str) -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    return os.path.join(_CACHE_DIR, name)
+
+
+def _der(r: int, s: int) -> bytes:
+    def enc_int(v: int) -> bytes:
+        b = v.to_bytes((v.bit_length() + 8) // 8 or 1, "big")
+        return b"\x02" + bytes([len(b)]) + b
+
+    body = enc_int(r) + enc_int(s)
+    return b"\x30" + bytes([len(body)]) + body
+
+
+def _pub_blob(pub) -> bytes:
+    return bytes([2 + (pub.y & 1)]) + pub.x.to_bytes(32, "big")
+
+
+def gen_signed_txs(
+    count: int,
+    inputs_per_tx: int = 2,
+    seed: int = 0xB10C,
+    invalid_every: int = 0,
+) -> list[Tx]:
+    """``count`` P2PKH-spending txs, each with ``inputs_per_tx`` signed
+    inputs.  ``invalid_every`` > 0 corrupts every Nth tx's first signature
+    (to keep verifiers honest)."""
+    rng = random.Random(seed)
+    priv = rng.getrandbits(256) % CURVE_N or 1
+    pub = point_mul(priv, GENERATOR)
+    blob = _pub_blob(pub)
+    script_code = _p2pkh_script_code(blob)
+    out_script = script_code  # pay back to the same key
+    txs = []
+    for t in range(count):
+        inputs = tuple(
+            TxIn(OutPoint(rng.randbytes(32), i), b"", 0xFFFFFFFF)
+            for i in range(inputs_per_tx)
+        )
+        outputs = (TxOut(50_000 + t, out_script),)
+        unsigned = Tx(1, inputs, outputs, 0)
+        signed = []
+        for i in range(inputs_per_tx):
+            z = legacy_sighash(unsigned, i, script_code, SIGHASH_ALL)
+            r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+            if invalid_every and t % invalid_every == invalid_every - 1 and i == 0:
+                s = (s + 1) % CURVE_N or 1
+            sig_blob = _der(r, s) + bytes([SIGHASH_ALL])
+            script_sig = (
+                bytes([len(sig_blob)]) + sig_blob + bytes([len(blob)]) + blob
+            )
+            signed.append(TxIn(inputs[i].prevout, script_sig, 0xFFFFFFFF))
+        txs.append(Tx(1, tuple(signed), outputs, 0))
+    return txs
+
+
+def _coinbase(height: int) -> Tx:
+    sig = bytes([4]) + height.to_bytes(4, "little")
+    return Tx(
+        1,
+        (TxIn(OutPoint(b"\x00" * 32, 0xFFFFFFFF), sig, 0xFFFFFFFF),),
+        (TxOut(50 * 100_000_000, b"\x51"),),
+        0,
+    )
+
+
+def gen_chain(
+    net: Network,
+    n_blocks: int,
+    txs_per_block: int,
+    inputs_per_tx: int = 2,
+    seed: int = 0x1BD,
+    cache: Optional[str] = None,
+) -> list[Block]:
+    """A consensus-valid chain of ``n_blocks`` regtest blocks on top of the
+    genesis, each carrying signed P2PKH txs.  Cached to ``cache`` (under
+    benchmarks/data) when given."""
+    if cache is not None:
+        path = cache_path(cache)
+        if os.path.exists(path):
+            data = open(path, "rb").read()
+            r = Reader(data)
+            return [Block.deserialize(r) for _ in range(n_blocks)]
+
+    gen = genesis_node(net)
+    target = bits_to_target(net.genesis.bits)
+    prev = gen.header.hash
+    t0 = net.genesis.timestamp
+    all_txs = gen_signed_txs(
+        n_blocks * txs_per_block, inputs_per_tx=inputs_per_tx, seed=seed
+    )
+    blocks = []
+    for h in range(n_blocks):
+        txs = [_coinbase(h + 1)] + all_txs[h * txs_per_block : (h + 1) * txs_per_block]
+        merkle = build_merkle_root([t.txid for t in txs])
+        nonce = 0
+        while True:
+            hdr = BlockHeader(
+                version=0x20000000,
+                prev=prev,
+                merkle=merkle,
+                timestamp=t0 + 600 * (h + 1),
+                bits=net.genesis.bits,
+                nonce=nonce,
+            )
+            if int.from_bytes(hdr.hash, "little") <= target:
+                break
+            nonce += 1
+        blocks.append(Block(hdr, tuple(txs)))
+        prev = hdr.hash
+    if cache is not None:
+        with open(cache_path(cache), "wb") as f:
+            for b in blocks:
+                f.write(b.serialize())
+    return blocks

@@ -1,0 +1,1 @@
+"""Test package (importable so benchmarks can reuse the fake network)."""

@@ -1,0 +1,76 @@
+"""Smoke tests for the benchmark harness (BASELINE configs).
+
+Runs the CPU-fast configs in SMALL mode so the harness can't rot; the
+device-heavy configs (2, 5) are exercised through their building blocks in
+test_kernel/test_multichip instead (compile cost).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(config: str) -> dict:
+    env = dict(os.environ)
+    env.update(TPUNODE_BENCH_SMALL="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "benchmarks.run", config],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = out.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def test_config1_block_cpu_baseline():
+    res = _run("config1")
+    assert res["metric"] == "config1_block800k_cpu_verify"
+    assert res["value"] > 0 and res["sigs"] == 128
+
+
+def test_config3_ibd_replay():
+    res = _run("config3")
+    assert res["metric"] == "config3_ibd_replay"
+    assert res["blocks"] == 50 and res["height"] == 50
+    assert res["sigs"] == 50 * 2 * 2  # blocks x txs x inputs
+
+
+def test_config4_mempool_firehose():
+    res = _run("config4")
+    assert res["metric"] == "config4_mempool_firehose"
+    assert res["tx_verdicts"] > 0 and res["sigs"] > 0
+
+
+def test_txgen_chain_is_consensus_valid():
+    import time
+
+    from benchmarks.txgen import gen_chain
+    from tpunode.headers import MemoryHeaderStore, connect_blocks
+    from tpunode.params import BCH_REGTEST
+
+    blocks = gen_chain(BCH_REGTEST, 5, 2, cache=None)
+    store = MemoryHeaderStore(BCH_REGTEST)
+    nodes, best = connect_blocks(
+        store, BCH_REGTEST, int(time.time()), [b.header for b in blocks]
+    )
+    assert best.height == 5
+    # every non-coinbase signature in the chain verifies
+    from tpunode.txverify import extract_sig_items
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+
+    items = []
+    for b in blocks:
+        for tx in b.txs:
+            its, _ = extract_sig_items(tx)
+            items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
+    assert len(items) == 5 * 2 * 2
+    assert verify_batch_cpu(items) == [True] * len(items)

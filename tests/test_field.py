@@ -127,3 +127,15 @@ def test_mul_under_jit():
     out = f(limbs(*a_vals), limbs(*b_vals))
     for a, b, g in zip(a_vals, b_vals, ints(out)):
         assert g % F.P == a * b % F.P
+
+
+def test_mul_under_vmap():
+    # kernel._lambda_table maps F.mul over a table axis prepended to the
+    # limb-major (L, B) layout; keep that batching path covered here
+    a_vals = [rand_fe() for _ in range(6)]
+    b = rand_fe()
+    stacked = jnp.stack([limbs(v, v) for v in a_vals])  # (6, L, 2)
+    f = jax.vmap(lambda x: F.mul(x, limbs(b, b)))
+    out = f(stacked)  # (6, L, 2)
+    for i, a in enumerate(a_vals):
+        assert ints(out[i])[0] % F.P == a * b % F.P

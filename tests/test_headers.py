@@ -219,3 +219,67 @@ def test_asert_eases_when_slow():
     store = MemoryHeaderStore(net)
     bits = next_work_required(store, net, parent, nxt)
     assert bits_to_target(bits) > bits_to_target(anchor_bits)
+
+
+def _mine_on(parent, n, t_step=600, nonce_start=0):
+    """Mine n trivial-PoW regtest headers on top of ``parent``."""
+    from tpunode.util import bits_to_target
+
+    net = BCH_REGTEST
+    target = bits_to_target(net.genesis.bits)
+    out = []
+    prev, ts = parent.hash, parent.header.timestamp
+    for i in range(n):
+        nonce = nonce_start
+        while True:
+            hdr = BlockHeader(
+                version=0x20000000,
+                prev=prev,
+                merkle=bytes([i % 251] * 32),
+                timestamp=ts + t_step * (i + 1),
+                bits=net.genesis.bits,
+                nonce=nonce,
+            )
+            if int.from_bytes(hdr.hash, "little") <= target:
+                break
+            nonce += 1
+        out.append(hdr)
+        prev = hdr.hash
+    return out
+
+
+def test_reorg_switches_to_more_work_branch():
+    """A longer side branch from a common ancestor must take over the best
+    pointer (chain-work comparison, reference haskoin-core chain selection)."""
+    store, nodes, best = _synced_store()
+    fork_point = nodes[9]  # height 10
+    # side branch: 7 headers on top of height 10 -> height 17 > 15
+    branch = _mine_on(fork_point, 7, nonce_start=100_000)
+    new_nodes, new_best = connect_blocks(store, BCH_REGTEST, NOW, branch)
+    store.add_headers(new_nodes)
+    store.set_best(new_best)
+    assert new_best.height == 17
+    assert new_best.work > best.work
+    # old tip is still present but no longer best
+    assert store.get_header(best.hash) is not None
+    assert store.get_best().hash == new_best.hash
+
+
+def test_shorter_branch_does_not_take_over():
+    store, nodes, best = _synced_store()
+    fork_point = nodes[9]
+    branch = _mine_on(fork_point, 3, nonce_start=200_000)  # height 13
+    new_nodes, new_best = connect_blocks(store, BCH_REGTEST, NOW, branch)
+    assert new_best.hash == best.hash  # best unchanged
+    assert all(n.height <= 13 for n in new_nodes)
+
+
+def test_batch_spanning_fork_connects_via_overlay():
+    """Headers whose parents are earlier entries of the same batch connect
+    without intermediate persistence (the _Overlay view)."""
+    store, nodes, best = _synced_store()
+    branch = _mine_on(best, 5, nonce_start=300_000)
+    # one batch, nothing persisted in between
+    new_nodes, new_best = connect_blocks(store, BCH_REGTEST, NOW, branch)
+    assert [n.height for n in new_nodes] == [16, 17, 18, 19, 20]
+    assert new_best.height == 20

@@ -103,11 +103,11 @@ def pt_double(p: jnp.ndarray) -> jnp.ndarray:
     z3 = t0 * 8  # 8Y^2, |limb| <= 2^15
     t1 = mul(Y, Z)
     t2 = mul(Z, Z)
-    t2 = F.mul_small_red(t2, B3)  # b3*Z^2, reduced (mul-input safe)
+    t2 = F.mul_small_red(t2, B3)  # b3*Z^2: non-top <= 2^16.6, top <= 2^12
     x3 = mul(t2, z3)
     y3 = t0 + t2
     z3 = mul(t1, z3)
-    t2_3 = t2 + t2 + t2  # 3*b3*Z^2, <= 2^17
+    t2_3 = t2 + t2 + t2  # 3*b3*Z^2: <= 3*2^16.6 = 2^18.3 (mul-input safe)
     t0 = t0 - t2_3
     y3 = mul(t0, y3)
     y3 = x3 + y3

@@ -209,8 +209,12 @@ def mul_small_red(a: jnp.ndarray, k: int) -> jnp.ndarray:
     ``mul`` input even though |value| grows past 2^268: carry into a 25th
     limb, fold it back via 2^264 ≡ FOLD (mod p).
 
-    Contract: |a limbs| <= 2^15, |k| <= 32.  Output: value < 2^265,
-    |non-top limbs| <= 2^19, |top limb| <= 2^12 — inside mul's contract.
+    Contract: |a limbs| <= 2^15, |k| <= 32.  Output: value < 2^265 and
+    |top limb| <= 2^12 always; non-top limbs <= 2^11 + 2^11*(value(a*k)>>264).
+    At the actual call sites (a is a mul output: every limb <= 2^12; k = B3
+    = 21) that is <= 2^16.6 — so 3-term sums of such outputs (<= 2^18.3)
+    still sit inside mul's |non-top| <= 2^19 input contract (the pt_double
+    audit relies on this).
     """
     return _fold_top(a * k)
 
