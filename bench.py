@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import json
 import os
-import random
 import statistics
 import sys
 import time
@@ -26,19 +25,9 @@ CPU_SAMPLE = min(256, BATCH)
 
 
 def make_items(n: int):
-    from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+    from benchmarks.common import make_triples
 
-    rng = random.Random(0xBE5C)
-    items = []
-    for i in range(n):
-        priv = rng.getrandbits(256) % CURVE_N or 1
-        pub = point_mul(priv, GENERATOR)
-        z = rng.getrandbits(256)
-        r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
-        if i % 16 == 15:
-            z ^= 1  # keep some invalid lanes honest
-        items.append((pub, z, r, s))
-    return items
+    return make_triples(n)
 
 
 def bench_device(items) -> tuple[float, str]:
@@ -64,35 +53,32 @@ def bench_device(items) -> tuple[float, str]:
         )
         sys.exit(1)
 
+    from tpunode.trace import profile_to
+
     times = []
-    for _ in range(TIMED_ITERS):
-        t0 = time.perf_counter()
-        verify_device(*args).block_until_ready()
-        times.append(time.perf_counter() - t0)
+    with profile_to(os.environ.get("TPUNODE_PROFILE")):
+        for _ in range(TIMED_ITERS):
+            t0 = time.perf_counter()
+            verify_device(*args).block_until_ready()
+            times.append(time.perf_counter() - t0)
     dt = statistics.median(times)
-    return BATCH / dt, f"{dev.platform}:{getattr(dev, 'device_kind', '?')}"
+    from benchmarks.common import device_kind
+
+    return BATCH / dt, device_kind()
 
 
 def bench_cpu_single_core(items) -> float:
     """Single-core baseline (sigs/sec): C++ verifier, oracle fallback."""
-    from tpunode.verify.cpu_native import load_native_verifier
+    from benchmarks.common import cpu_single_core_rate
 
-    sample = items[:CPU_SAMPLE]
-    try:
-        v = load_native_verifier()
-        fn = v.verify_batch
-    except Exception:
-        from tpunode.verify.ecdsa_cpu import verify_batch_cpu as fn
-    fn(sample[:8])  # warm
-    t0 = time.perf_counter()
-    fn(sample)
-    dt = time.perf_counter() - t0
-    return len(sample) / dt
+    return cpu_single_core_rate(items[:CPU_SAMPLE])
 
 
 def main() -> None:
     base_items = make_items(UNIQUE)
-    items = (base_items * (BATCH // UNIQUE + 1))[:BATCH]
+    from benchmarks.common import tile
+
+    items = tile(base_items, BATCH)
     cpu_rate = bench_cpu_single_core(base_items)
     tpu_rate, device = bench_device(items)
     print(

@@ -1,0 +1,65 @@
+"""Workload helpers shared by the driver headline bench (repo-root
+bench.py) and the full config harness (benchmarks/run.py) — one generator,
+so the two can't drift apart."""
+
+from __future__ import annotations
+
+import random
+import time
+
+__all__ = [
+    "make_triples",
+    "tile",
+    "device_kind",
+    "cpu_single_core_rate",
+]
+
+
+def make_triples(n: int, seed: int = 0xBE5C, invalid_every: int = 16):
+    """Deterministic (pubkey, z, r, s) items; every ``invalid_every``-th has
+    a corrupted message to keep verifiers honest."""
+    from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+
+    rng = random.Random(seed)
+    items = []
+    for i in range(n):
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        z = rng.getrandbits(256)
+        r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+        if invalid_every and i % invalid_every == invalid_every - 1:
+            z ^= 1
+        items.append((pub, z, r, s))
+    return items
+
+
+def tile(items, n):
+    """Repeat a unique pool out to ``n`` items (device work is identical)."""
+    return (items * (n // len(items) + 1))[:n]
+
+
+def device_kind() -> str:
+    import jax
+
+    d = jax.devices()[0]
+    return f"{d.platform}:{getattr(d, 'device_kind', '?')}"
+
+
+def cpu_single_core_rate(sample) -> float:
+    """Single-core CPU baseline (sigs/sec): the C++ verifier, falling back
+    to the Python oracle where the native toolchain is unavailable."""
+    from tpunode.verify.cpu_native import load_native_verifier
+
+    fn = None
+    try:
+        v = load_native_verifier()
+        if v is not None:
+            fn = v.verify_batch
+    except Exception:
+        pass
+    if fn is None:
+        from tpunode.verify.ecdsa_cpu import verify_batch_cpu as fn
+    fn(sample[:8])  # warm
+    t0 = time.perf_counter()
+    fn(sample)
+    return len(sample) / (time.perf_counter() - t0)

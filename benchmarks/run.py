@@ -19,43 +19,21 @@ from __future__ import annotations
 import asyncio
 import json
 import os
-import random
-import statistics
 import sys
 import time
+
+from benchmarks.common import (
+    cpu_single_core_rate,
+    device_kind as _device_kind,
+    make_triples as _make_triples,
+    tile as _tile,
+)
 
 SMALL = os.environ.get("TPUNODE_BENCH_SMALL") == "1"
 
 
 def _emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
-
-
-def _make_triples(n: int, seed: int = 0xBE5C, invalid_every: int = 16):
-    from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
-
-    rng = random.Random(seed)
-    items = []
-    for i in range(n):
-        priv = rng.getrandbits(256) % CURVE_N or 1
-        pub = point_mul(priv, GENERATOR)
-        z = rng.getrandbits(256)
-        r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
-        if invalid_every and i % invalid_every == invalid_every - 1:
-            z ^= 1
-        items.append((pub, z, r, s))
-    return items
-
-
-def _tile(items, n):
-    return (items * (n // len(items) + 1))[:n]
-
-
-def _device_kind():
-    import jax
-
-    d = jax.devices()[0]
-    return f"{d.platform}:{getattr(d, 'device_kind', '?')}"
 
 
 # --- config 1: block-800000-shaped tx set, CPU single-core baseline -------
@@ -66,7 +44,6 @@ def config1() -> None:
     This IS the baseline reference point (BASELINE.md config 1): mainnet
     block 800000 carried ~3,700 inputs; we use a 4,096-signature stand-in."""
     from tpunode.txverify import extract_sig_items
-    from tpunode.verify.cpu_native import load_native_verifier
     from benchmarks.txgen import gen_signed_txs
 
     n_txs = 64 if SMALL else 2048  # 2 sigs each -> 4096 sigs
@@ -75,16 +52,13 @@ def config1() -> None:
     for tx in txs:
         its, _ = extract_sig_items(tx)
         items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
-    v = load_native_verifier()
-    v.verify_batch(items[:16])  # warm
     t0 = time.perf_counter()
-    out = v.verify_batch(items)
+    rate = cpu_single_core_rate(items)
     dt = time.perf_counter() - t0
-    assert all(out), "baseline block must verify fully"
     _emit(
         {
             "metric": "config1_block800k_cpu_verify",
-            "value": round(len(items) / dt, 1),
+            "value": round(rate, 1),
             "unit": "sigs/sec/core",
             "vs_baseline": 1.0,
             "sigs": len(items),
@@ -100,11 +74,8 @@ def config2() -> None:
     """10k random triples through the device kernel at batch 4096
     (BASELINE.md config 2; the repo-root bench.py is this config's
     single-batch steady-state variant)."""
-    import jax.numpy as jnp
-
-    from tpunode.verify.cpu_native import load_native_verifier
     from tpunode.verify.ecdsa_cpu import verify_batch_cpu
-    from tpunode.verify.kernel import prepare_batch, verify_batch_tpu
+    from tpunode.verify.kernel import verify_batch_tpu
 
     total = 640 if SMALL else 10_240
     batch = 128 if SMALL else 4096
@@ -123,12 +94,7 @@ def config2() -> None:
         n += len(chunk)
     dt = time.perf_counter() - t0
 
-    v = load_native_verifier()
-    sample = uniq[:256]
-    v.verify_batch(sample[:8])
-    t1 = time.perf_counter()
-    v.verify_batch(sample)
-    cpu_rate = len(sample) / (time.perf_counter() - t1)
+    cpu_rate = cpu_single_core_rate(uniq[:256])
     _emit(
         {
             "metric": "config2_synthetic10k_device_verify",

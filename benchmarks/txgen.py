@@ -154,7 +154,11 @@ def gen_chain(
         blocks.append(Block(hdr, tuple(txs)))
         prev = hdr.hash
     if cache is not None:
-        with open(cache_path(cache), "wb") as f:
+        # atomic: a killed run must not leave a truncated cache behind
+        path = cache_path(cache)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             for b in blocks:
                 f.write(b.serialize())
+        os.replace(tmp, path)
     return blocks

@@ -1,0 +1,46 @@
+"""Span/profiler hook tests."""
+
+import asyncio
+
+from tpunode.metrics import metrics
+from tpunode.trace import profile_to, span
+
+
+def test_span_records_metrics():
+    before = metrics.get("span.unit-test.count")
+    with span("unit-test"):
+        pass
+    assert metrics.get("span.unit-test.count") == before + 1
+    assert metrics.get("span.unit-test.seconds") >= 0
+
+
+def test_span_records_on_exception():
+    before = metrics.get("span.unit-err.count")
+    try:
+        with span("unit-err"):
+            raise ValueError("boom")
+    except ValueError:
+        pass
+    assert metrics.get("span.unit-err.count") == before + 1
+
+
+def test_profile_to_none_is_noop():
+    with profile_to(None):
+        pass
+
+
+def test_engine_dispatch_is_spanned():
+    from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+    from tpunode.verify.engine import VerifyConfig, VerifyEngine
+
+    priv = 1234567
+    pub = point_mul(priv, GENERATOR)
+    r, s = sign(priv, 999, 4242)
+
+    async def go():
+        async with VerifyEngine(VerifyConfig(backend="oracle")) as eng:
+            return await eng.verify([(pub, 999, r, s)])
+
+    before = metrics.get("span.verify.dispatch.count")
+    assert asyncio.run(go()) == [True]
+    assert metrics.get("span.verify.dispatch.count") > before

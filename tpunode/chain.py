@@ -32,7 +32,9 @@ from .headers import (
 )
 from .params import Network, PROTOCOL_VERSION
 from .peer import Peer, PeerSentBadHeaders, PeerTimeout
+from .metrics import metrics
 from .store import KVStore, put_op
+from .trace import span
 from .wire import BlockHeader, MsgGetHeaders, MsgSendHeaders
 
 __all__ = [
@@ -216,14 +218,16 @@ class Chain:
         """Validate/persist one batch (reference ``processHeaders``
         Chain.hs:323-350 + ``importHeaders`` Chain.hs:496-520)."""
         prev_best = self.db.get_best()
-        try:
-            nodes, best = connect_blocks(
-                self.db, self.cfg.net, int(time.time()), headers
-            )
-        except BadHeaders as e:
-            p.kill(PeerSentBadHeaders(str(e)))
-            return
-        self.db.put_headers(nodes, best if best.hash != prev_best.hash else None)
+        with span("chain.import_headers"):
+            try:
+                nodes, best = connect_blocks(
+                    self.db, self.cfg.net, int(time.time()), headers
+                )
+            except BadHeaders as e:
+                p.kill(PeerSentBadHeaders(str(e)))
+                return
+            self.db.put_headers(nodes, best if best.hash != prev_best.hash else None)
+        metrics.inc("chain.headers", len(nodes))
         if self._syncing is not None:
             self._syncing.timestamp = time.monotonic()
             if nodes:

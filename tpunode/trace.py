@@ -1,0 +1,66 @@
+"""Tracing/profiling hooks: spans + optional on-device profiler capture.
+
+The reference has no tracing at all (SURVEY.md §5 "Tracing / profiling:
+absent"); this module supplies what the TPU build needs to report the
+BASELINE metrics honestly:
+
+* :func:`span` — a context manager that times a region into the metrics
+  registry (``span.<name>.seconds`` / ``.count``) and, when JAX is
+  importable, also emits a ``jax.profiler.TraceAnnotation`` so the region
+  shows up named on the TensorBoard/perfetto timeline of a device trace.
+* :func:`profile_to` — wraps ``jax.profiler.trace``: capture a full device
+  profile into a directory (``TPUNODE_PROFILE=<dir>`` in bench.py).
+
+Spans are deliberately cheap (two ``perf_counter`` calls and a dict update)
+so they can wrap the per-batch hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+from .metrics import metrics
+
+__all__ = ["span", "profile_to"]
+
+
+def _annotation(name: str):
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # jax absent or profiler unavailable: spans still time
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def span(name: str) -> Iterator[None]:
+    """Time a region into metrics (and the device profile timeline)."""
+    t0 = time.perf_counter()
+    with _annotation(name):
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            metrics.inc(f"span.{name}.seconds", dt)
+            metrics.inc(f"span.{name}.count")
+
+
+@contextlib.contextmanager
+def profile_to(directory: Optional[str]) -> Iterator[None]:
+    """Capture a JAX device profile into ``directory`` (no-op when None or
+    the profiler is unavailable)."""
+    if not directory:
+        yield
+        return
+    try:
+        import jax.profiler
+
+        cm = jax.profiler.trace(directory)
+    except Exception:
+        yield
+        return
+    with cm:
+        yield

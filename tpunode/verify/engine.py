@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from ..metrics import metrics
+from ..trace import span
 from .ecdsa_cpu import Point, verify_batch_cpu
 
 __all__ = ["VerifyConfig", "VerifyEngine", "VerifyItem"]
@@ -159,6 +160,10 @@ class VerifyEngine:
 
     def _dispatch(self, items: list[VerifyItem]) -> list[bool]:
         """Pick an execution engine and run the batch (worker thread)."""
+        with span("verify.dispatch"):
+            return self._dispatch_inner(items)
+
+    def _dispatch_inner(self, items: list[VerifyItem]) -> list[bool]:
         backend = self.cfg.backend
         if backend == "auto":
             if len(items) >= self.cfg.min_tpu_batch and _have_tpu():
