@@ -11,6 +11,7 @@ __all__ = [
     "make_triples",
     "tile",
     "device_kind",
+    "cpu_single_core_bench",
     "cpu_single_core_rate",
 ]
 
@@ -45,21 +46,34 @@ def device_kind() -> str:
     return f"{d.platform}:{getattr(d, 'device_kind', '?')}"
 
 
-def cpu_single_core_rate(sample) -> float:
-    """Single-core CPU baseline (sigs/sec): the C++ verifier, falling back
-    to the Python oracle where the native toolchain is unavailable."""
+def cpu_single_core_bench(sample) -> tuple[float, str, list]:
+    """Single-core CPU baseline: returns (sigs/sec, engine_name, verdicts).
+
+    Engine load (which may compile the C++ extension on first use) and the
+    warm-up batch happen OUTSIDE the timed window.  ``engine_name`` is
+    "native-cpp" or "python-oracle" so emitted baselines say which engine
+    defined them (the oracle is orders of magnitude slower — a silent
+    fallback would corrupt every downstream speedup ratio)."""
     from tpunode.verify.cpu_native import load_native_verifier
 
     fn = None
+    engine = "python-oracle"
     try:
         v = load_native_verifier()
         if v is not None:
             fn = v.verify_batch
+            engine = "native-cpp"
     except Exception:
         pass
     if fn is None:
         from tpunode.verify.ecdsa_cpu import verify_batch_cpu as fn
-    fn(sample[:8])  # warm
+    fn(sample[:8])  # warm (outside the timed window)
     t0 = time.perf_counter()
-    fn(sample)
-    return len(sample) / (time.perf_counter() - t0)
+    out = fn(sample)
+    rate = len(sample) / (time.perf_counter() - t0)
+    return rate, engine, out
+
+
+def cpu_single_core_rate(sample) -> float:
+    """Back-compat shim: just the rate."""
+    return cpu_single_core_bench(sample)[0]

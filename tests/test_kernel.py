@@ -160,3 +160,23 @@ def test_beta_endomorphism_is_lambda_mul():
         p = rand_point()
         phi = Point(BETA * p.x % CURVE_P, p.y)
         assert phi == point_mul(LAMBDA, p)
+
+
+def test_np_conversions_match_scalar():
+    from tpunode.verify.kernel import (
+        WINDOWS,
+        _digits_base16,
+        _ints_to_digits_np,
+        _ints_to_limbs_np,
+    )
+
+    vals = [0, 1, F.P - 1, CURVE_N, (1 << 256) - 1] + [
+        rng.getrandbits(256) for _ in range(50)
+    ]
+    got = _ints_to_limbs_np(vals)
+    for v, row in zip(vals, got):
+        assert (row == F.to_limbs(v)).all()
+    dvals = [0, 1, (1 << 132) - 1] + [rng.getrandbits(132) for _ in range(50)]
+    gotd = _ints_to_digits_np(dvals)
+    for v, row in zip(dvals, gotd):
+        assert row.tolist() == _digits_base16(v)

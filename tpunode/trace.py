@@ -25,13 +25,20 @@ from .metrics import metrics
 
 __all__ = ["span", "profile_to"]
 
+# Resolve the profiler ONCE at import (a failed import is not cached by
+# Python, so retrying per span would pay a sys.path scan on the hot path).
+try:
+    import jax.profiler as _jax_profiler
+except Exception:  # jax absent: spans still time into metrics
+    _jax_profiler = None
+
 
 def _annotation(name: str):
+    if _jax_profiler is None:
+        return contextlib.nullcontext()
     try:
-        import jax.profiler
-
-        return jax.profiler.TraceAnnotation(name)
-    except Exception:  # jax absent or profiler unavailable: spans still time
+        return _jax_profiler.TraceAnnotation(name)
+    except Exception:  # profiler unavailable on this backend
         return contextlib.nullcontext()
 
 

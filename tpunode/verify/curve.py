@@ -68,9 +68,10 @@ def pt_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     X2, Y2, Z2 = q[0], q[1], q[2]
     mul = F.mul
 
-    t0 = mul(X1, X2)
-    t1 = mul(Y1, Y2)
-    t2 = mul(Z1, Z2)
+    # coords are <= 2^13 (sums of <= 2 mul outputs): inside mul_t's contract
+    t0 = F.mul_t(X1, X2)
+    t1 = F.mul_t(Y1, Y2)
+    t2 = F.mul_t(Z1, Z2)
     t3 = mul(X1 + Y1, X2 + Y2)
     t3 = t3 - (t0 + t1)
     t4 = mul(Y1 + Z1, Y2 + Z2)
@@ -99,10 +100,11 @@ def pt_double(p: jnp.ndarray) -> jnp.ndarray:
     X, Y, Z = p[0], p[1], p[2]
     mul = F.mul
 
-    t0 = mul(Y, Y)
+    # coords are <= 2^13: inside mul_t's contract
+    t0 = F.mul_t(Y, Y)
     z3 = t0 * 8  # 8Y^2, |limb| <= 2^15
-    t1 = mul(Y, Z)
-    t2 = mul(Z, Z)
+    t1 = F.mul_t(Y, Z)
+    t2 = F.mul_t(Z, Z)
     t2 = F.mul_small_red(t2, B3)  # b3*Z^2: non-top <= 2^16.6, top <= 2^12
     x3 = mul(t2, z3)
     y3 = t0 + t2
@@ -111,7 +113,7 @@ def pt_double(p: jnp.ndarray) -> jnp.ndarray:
     t0 = t0 - t2_3
     y3 = mul(t0, y3)
     y3 = x3 + y3
-    t1 = mul(X, Y)
+    t1 = F.mul_t(X, Y)
     x3 = mul(t0, t1)
     x3 = x3 + x3
     return make_point(x3, y3, z3)

@@ -50,6 +50,7 @@ __all__ = [
     "to_limbs",
     "from_limbs",
     "mul",
+    "mul_t",
     "sqr",
     "mul_small_red",
     "tighten",
@@ -175,7 +176,8 @@ def _tight24(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Modular multiply mod p.
+    """Modular multiply mod p (general loose inputs; see mul_t for the
+    pre-tight fast path).
 
     Input contract (audited at every call site in curve.py/kernel.py):
     |non-top limbs| <= 2^19, |top limb| <= 2^15, and for the PAIR
@@ -198,6 +200,22 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     x = _fold_once(wide)  # 24 limbs, loose <= 2^28
     x = _carry(x, 1)  # <= 2^12, top <= 2^17-ish
     return _carry(_fold_top(x), 1)  # fold residual top overflow; <= 2^12
+
+
+def mul_t(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """``mul`` for pre-tight operands: skips the two input carry rounds.
+
+    Contract (stricter than mul's, audited per call site in curve.py):
+    EVERY limb of both inputs |<= 2^13| — raw mul outputs (<= 2^12) and
+    single point coordinates (sums of <= 2 mul outputs) qualify; wider sums
+    and mul_small_red outputs do NOT.  Convolution bound: 24 * 2^13 * 2^13
+    = 2^30.6 < 2^31.  Output identical contract to mul's.
+    """
+    wide = _conv(a, b)
+    wide = _carry(_pad(wide, 1), 2)
+    x = _fold_once(wide)
+    x = _carry(x, 1)
+    return _carry(_fold_top(x), 1)
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:

@@ -179,6 +179,42 @@ def _digits_base16(v: int) -> list[int]:
     ]
 
 
+def _ints_to_limbs_np(vals: list[int]) -> np.ndarray:
+    """Vectorized ``F.to_limbs``: 256-bit ints -> (len, NLIMBS) int32.
+
+    Python-loop limb extraction dominates host prep at batch 4096 (~15 ms
+    per array x 4 arrays); this does one ``to_bytes`` per int and then
+    numpy uint64 shifts — ~10x faster.  Bit-identical to F.to_limbs
+    (tested in tests/test_kernel.py::test_np_conversions_match_scalar).
+    """
+    n = len(vals)
+    buf = b"".join(v.to_bytes(32, "little") for v in vals)
+    words = np.frombuffer(buf, dtype="<u8").reshape(n, 4)
+    out = np.zeros((n, F.NLIMBS), dtype=np.int32)
+    for i in range(F.NLIMBS):
+        w, off = divmod(F.RADIX * i, 64)
+        lo = words[:, w] >> np.uint64(off)
+        if off > 64 - F.RADIX and w + 1 < 4:  # limb straddles a word edge
+            lo = lo | (words[:, w + 1] << np.uint64(64 - off))
+        out[:, i] = (lo & np.uint64(F.MASK)).astype(np.int32)
+    return out
+
+
+def _ints_to_digits_np(vals: list[int]) -> np.ndarray:
+    """Vectorized ``_digits_base16``: ints < 2^(4*WINDOWS) -> (len, WINDOWS)
+    int32, MSB-first.  4-bit digits never straddle 64-bit word edges."""
+    n = len(vals)
+    buf = b"".join(v.to_bytes(24, "little") for v in vals)
+    words = np.frombuffer(buf, dtype="<u8").reshape(n, 3)
+    out = np.zeros((n, WINDOWS), dtype=np.int32)
+    for j in range(WINDOWS):
+        w, off = divmod(WINDOW_BITS * (WINDOWS - 1 - j), 64)
+        out[:, j] = ((words[:, w] >> np.uint64(off)) & np.uint64(0xF)).astype(
+            np.int32
+        )
+    return out
+
+
 def prepare_batch(
     items: Sequence[tuple[Optional[Point], int, int, int]], pad_to: Optional[int] = None
 ) -> PreparedBatch:
@@ -219,9 +255,19 @@ def prepare_batch(
 
     digit_arrays = (d1a, d1b, d2a, d2b)
     bound = 1 << (WINDOW_BITS * WINDOWS)
+    # Gather per-valid-lane scalars, then convert in bulk with numpy
+    # (the per-int Python limb/digit loops dominate prep otherwise).
+    idxs: list[int] = []
+    half_abs: tuple[list[int], ...] = ([], [], [], [])
+    gx: list[int] = []
+    gy: list[int] = []
+    gr1: list[int] = []
+    r2_idx: list[int] = []
+    gr2: list[int] = []
     for i, (q, z, r, s) in enumerate(items):
         if not hv[i]:
             continue
+        idxs.append(i)
         w = inv_by_idx[i]
         u1 = (z % CURVE_N) * w % CURVE_N
         u2 = r * w % CURVE_N
@@ -229,13 +275,24 @@ def prepare_batch(
         for j, k in enumerate(halves):
             assert abs(k) < bound, "GLV half-scalar out of window range"
             negs[j, i] = k < 0
-            digit_arrays[j][i] = _digits_base16(abs(k))
-        qx[i] = F.to_limbs(q.x)
-        qy[i] = F.to_limbs(q.y)
-        r1[i] = F.to_limbs(r)
+            half_abs[j].append(abs(k))
+        gx.append(q.x)
+        gy.append(q.y)
+        gr1.append(r)
         if r + CURVE_N < CURVE_P:
-            r2[i] = F.to_limbs(r + CURVE_N)
-            r2v[i] = True
+            r2_idx.append(i)
+            gr2.append(r + CURVE_N)
+    if idxs:
+        ii = np.array(idxs)
+        for j, dst in enumerate(digit_arrays):
+            dst[ii] = _ints_to_digits_np(half_abs[j])
+        qx[ii] = _ints_to_limbs_np(gx)
+        qy[ii] = _ints_to_limbs_np(gy)
+        r1[ii] = _ints_to_limbs_np(gr1)
+    if r2_idx:
+        jj = np.array(r2_idx)
+        r2[jj] = _ints_to_limbs_np(gr2)
+        r2v[jj] = True
 
     t = np.ascontiguousarray
     return PreparedBatch(
