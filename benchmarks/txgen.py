@@ -119,13 +119,26 @@ def gen_chain(
 ) -> list[Block]:
     """A consensus-valid chain of ``n_blocks`` regtest blocks on top of the
     genesis, each carrying signed P2PKH txs.  Cached to ``cache`` (under
-    benchmarks/data) when given."""
+    benchmarks/data) when given.  The on-disk name embeds every workload
+    parameter (net magic, block/tx counts, inputs_per_tx, seed) so changing
+    any of them can never silently reuse a stale workload, and the load
+    path re-verifies the block count byte-for-byte."""
     if cache is not None:
+        key = (
+            f"{net.magic:08x}-{n_blocks}x{txs_per_block}"
+            f"-i{inputs_per_tx}-s{seed:x}"
+        )
+        cache = f"{os.path.splitext(cache)[0]}-{key}.bin"
         path = cache_path(cache)
         if os.path.exists(path):
             data = open(path, "rb").read()
-            r = Reader(data)
-            return [Block.deserialize(r) for _ in range(n_blocks)]
+            try:
+                r = Reader(data)
+                blocks = [Block.deserialize(r) for _ in range(n_blocks)]
+                if r.remaining() == 0:
+                    return blocks
+            except Exception:
+                pass  # short/corrupt cache — regenerate below
 
     gen = genesis_node(net)
     target = bits_to_target(net.genesis.bits)

@@ -20,14 +20,19 @@ if "xla_force_host_platform_device_count" not in flags:
 # in every process, which would make even CPU-only tests initialize (and
 # block on) the remote TPU backend.  Pin the platform list back to cpu —
 # must happen before the first jax operation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 try:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Persistent compilation cache: kernel compiles dominate test wall-clock
+    # when every pytest process recompiles from scratch; share one cache.
+    from tpunode.verify.engine import enable_compile_cache
+
+    enable_compile_cache()
 except Exception:
     pass
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Minimal async test support (pytest-asyncio is not in the image): run any
 # coroutine test function on a fresh event loop.

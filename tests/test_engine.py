@@ -70,3 +70,87 @@ def test_engine_sync_path():
     items, expected = make_items(6, tamper_every=3)
     eng = VerifyEngine(VerifyConfig(backend="cpu"))
     assert eng.verify_sync(items) == expected
+
+
+def _mixed_none_batch():
+    """A batch mixing valid items with None-pubkey ('undecodable key',
+    txverify auto-invalid) and infinity-pubkey items."""
+    from tpunode.verify.ecdsa_cpu import Point
+
+    items, expected = make_items(5, tamper_every=5)
+    items.insert(1, (None, 123, 45, 67))
+    expected.insert(1, False)
+    items.insert(3, (Point(None, None), 123, 45, 67))
+    expected.insert(3, False)
+    return items, expected
+
+
+def test_none_pubkey_verdicts_agree_across_backends():
+    """VERDICT r2 weak#2: a None pubkey must yield valid=False per-item on
+    every backend — not an exception that poisons the whole batch."""
+    from tpunode.verify.cpu_native import load_native_verifier
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+    from tpunode.verify.kernel import verify_batch_tpu
+
+    items, expected = _mixed_none_batch()
+    assert verify_batch_cpu(items) == expected
+    native = load_native_verifier()
+    if native is not None:
+        assert native.verify_batch(items) == expected
+    assert verify_batch_tpu(items, pad_to=16) == expected
+
+
+@pytest.mark.asyncio
+async def test_engine_mixed_none_batch_per_item_verdicts():
+    items, expected = _mixed_none_batch()
+    for backend in ("cpu", "oracle"):
+        async with VerifyEngine(
+            VerifyConfig(backend=backend, max_wait=0.0)
+        ) as eng:
+            assert await eng.verify(items) == expected
+
+
+@pytest.mark.asyncio
+async def test_engine_survives_stalled_device_warmup(monkeypatch):
+    """VERDICT r2 item 4: backend=auto on a box whose device backend hangs
+    must still produce verdicts promptly via the CPU engine."""
+    import threading
+
+    hang = threading.Event()
+    monkeypatch.setattr(
+        VerifyEngine, "_warmup_fn", staticmethod(lambda bs: hang.wait(30) or "x")
+    )
+    cfg = VerifyConfig(backend="auto", max_wait=0.0, min_tpu_batch=1)
+    async with VerifyEngine(cfg) as eng:
+        assert eng.device_state == "warming"
+        items, expected = make_items(4, tamper_every=2)
+        got = await asyncio.wait_for(eng.verify(items), timeout=10)
+        assert got == expected
+    hang.set()
+
+
+@pytest.mark.asyncio
+async def test_engine_failed_warmup_falls_back(monkeypatch):
+    def boom(bs):
+        raise RuntimeError("no TPU device visible")
+
+    monkeypatch.setattr(VerifyEngine, "_warmup_fn", staticmethod(boom))
+    cfg = VerifyConfig(backend="auto", max_wait=0.0, min_tpu_batch=1)
+    async with VerifyEngine(cfg) as eng:
+        eng._warmup_done.wait(5)
+        assert eng.device_state == "failed"
+        items, expected = make_items(3)
+        assert await eng.verify(items) == expected
+
+
+@pytest.mark.asyncio
+async def test_engine_forced_tpu_errors_when_unavailable(monkeypatch):
+    def boom(bs):
+        raise RuntimeError("no TPU device visible")
+
+    monkeypatch.setattr(VerifyEngine, "_warmup_fn", staticmethod(boom))
+    cfg = VerifyConfig(backend="tpu", max_wait=0.0, warmup_timeout=5)
+    async with VerifyEngine(cfg) as eng:
+        items, _ = make_items(2)
+        with pytest.raises(RuntimeError, match="tpu backend unavailable"):
+            await eng.verify(items)

@@ -49,10 +49,11 @@ class NativeVerifier:
         ]
 
     def verify_batch(
-        self, items: Sequence[tuple[Point, int, int, int]]
+        self, items: Sequence[tuple[Optional[Point], int, int, int]]
     ) -> list[bool]:
-        """items: (pubkey, z, r, s) tuples — same shape as the oracle's
-        ``verify_batch_cpu``."""
+        """items: (pubkey|None, z, r, s) tuples — same shape as the oracle's
+        ``verify_batch_cpu``.  ``None`` pubkeys are auto-invalid (matching
+        the oracle and kernel.prepare_batch's host_valid mask)."""
         n = len(items)
         if n == 0:
             return []
@@ -63,7 +64,7 @@ class NativeVerifier:
         ss = bytearray()
         degenerate = [False] * n
         for i, (q, z, r, s) in enumerate(items):
-            if q.infinity:
+            if q is None or q.infinity:
                 degenerate[i] = True
                 px += b"\x00" * 32
                 py += b"\x00" * 32

@@ -160,11 +160,16 @@ def sign(priv: int, z: int, nonce: int) -> tuple[int, int]:
     return r, s
 
 
-def verify(pubkey: Point, z: int, r: int, s: int) -> bool:
-    """Standard ECDSA verification: R = u1*G + u2*Q, accept iff R.x ≡ r (mod n)."""
+def verify(pubkey: Optional[Point], z: int, r: int, s: int) -> bool:
+    """Standard ECDSA verification: R = u1*G + u2*Q, accept iff R.x ≡ r (mod n).
+
+    ``pubkey=None`` (undecodable key, see txverify.extract_sig_items) is
+    auto-invalid — all three backends agree on this (kernel.prepare_batch
+    masks None host-side the same way).
+    """
     if not (0 < r < CURVE_N and 0 < s < CURVE_N):
         return False
-    if pubkey.infinity or not pubkey.on_curve():
+    if pubkey is None or pubkey.infinity or not pubkey.on_curve():
         return False
     w = _inv(s, CURVE_N)
     u1 = z * w % CURVE_N
@@ -176,7 +181,7 @@ def verify(pubkey: Point, z: int, r: int, s: int) -> bool:
 
 
 def verify_batch_cpu(
-    items: Sequence[tuple[Point, int, int, int]],
+    items: Sequence[tuple[Optional[Point], int, int, int]],
 ) -> list[bool]:
-    """Sequential batch verify: list of (pubkey, z, r, s)."""
+    """Sequential batch verify: list of (pubkey|None, z, r, s)."""
     return [verify(q, z, r, s) for q, z, r, s in items]

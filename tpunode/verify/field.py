@@ -14,10 +14,12 @@ trailing batch axis.
 
 Key design points (bounds are load-bearing):
 
-* **Loose limbs.** Between operations limbs may be loose — any int32 with
-  ``|limb| <= 2**17`` — and possibly negative: two's-complement ``& MASK`` /
-  arithmetic ``>> RADIX`` keep carry rounds exact for negatives, which makes
-  subtraction free (no borrow chains).
+* **Loose limbs.** Between operations limbs may be loose — up to the
+  per-function input contracts (``mul`` admits |non-top limb| <= 2**19,
+  |top limb| <= 2**15; ``mul_t`` requires every |limb| <= 2**13 — see their
+  docstrings, which are the load-bearing bounds) — and possibly negative:
+  two's-complement ``& MASK`` / arithmetic ``>> RADIX`` keep carry rounds
+  exact for negatives, which makes subtraction free (no borrow chains).
 * **Multiplication** internally tightens both inputs with one carry round
   (bringing limbs to ``< 2**12``), then does the 24x24 limb convolution in
   direct shift-add form (partials < 2**24, anti-diagonal sums of <= 24 terms

@@ -273,7 +273,11 @@ def prepare_batch(
         u2 = r * w % CURVE_N
         halves = glv_split(u1) + glv_split(u2)
         for j, k in enumerate(halves):
-            assert abs(k) < bound, "GLV half-scalar out of window range"
+            if abs(k) >= bound:  # not assert: -O must not strip a consensus guard
+                raise ValueError(
+                    f"GLV half-scalar out of window range: |{k}| >= 2^"
+                    f"{WINDOW_BITS * WINDOWS} (item {i}, half {j})"
+                )
             negs[j, i] = k < 0
             half_abs[j].append(abs(k))
         gx.append(q.x)
