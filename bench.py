@@ -25,7 +25,7 @@ import subprocess
 import sys
 import time
 
-BATCH = int(os.environ.get("TPUNODE_BENCH_BATCH", 4096))
+BATCH = int(os.environ.get("TPUNODE_BENCH_BATCH", 32768))
 UNIQUE = min(512, BATCH)  # unique sigs, tiled to BATCH (device work identical)
 TIMED_ITERS = int(os.environ.get("TPUNODE_BENCH_ITERS", 5))
 CPU_SAMPLE = min(256, BATCH)
@@ -63,20 +63,32 @@ def _worker() -> None:
 
         from benchmarks.common import device_kind, make_triples, tile
         from tpunode.verify.ecdsa_cpu import verify_batch_cpu
-        from tpunode.verify.kernel import prepare_batch, verify_device
+        from tpunode.verify.kernel import (
+            _pallas_usable,
+            prepare_batch,
+            verify_device,
+        )
 
         t0 = time.perf_counter()
         dev = jax.devices()[0]  # first backend touch — may block
         init_s = time.perf_counter() - t0
         progress(f"backend up: {dev} in {init_s:.1f}s")
 
+        if _pallas_usable(BATCH):
+            from tpunode.verify.pallas_kernel import verify_blocked as device_fn
+
+            kernel_name = "pallas"
+        else:
+            device_fn = verify_device
+            kernel_name = "xla"
+
         base = make_triples(UNIQUE)
         items = tile(base, BATCH)
         prep = prepare_batch(items, pad_to=BATCH)
         args = tuple(jax.device_put(jnp.asarray(a), dev) for a in prep.device_args)
-        progress(f"host prep done, compiling at batch {BATCH}...")
+        progress(f"host prep done, compiling {kernel_name} at batch {BATCH}...")
         t0 = time.perf_counter()
-        out = verify_device(*args)  # compile + first run
+        out = device_fn(*args)  # compile + first run
         got = [bool(b) for b in out][: len(base)]
         compile_s = time.perf_counter() - t0
         progress(f"compiled+ran in {compile_s:.1f}s, checking oracle...")
@@ -98,7 +110,7 @@ def _worker() -> None:
         with profile_to(os.environ.get("TPUNODE_PROFILE")):
             for _ in range(TIMED_ITERS):
                 t0 = time.perf_counter()
-                verify_device(*args).block_until_ready()
+                device_fn(*args).block_until_ready()
                 times.append(time.perf_counter() - t0)
         dt = statistics.median(times)
         print(
@@ -107,6 +119,7 @@ def _worker() -> None:
                     "ok": True,
                     "rate": BATCH / dt,
                     "device": device_kind(),
+                    "kernel": kernel_name,
                     "step_ms": round(dt * 1e3, 3),
                     "compile_s": round(compile_s, 1),
                     "init_s": round(init_s, 1),

@@ -484,3 +484,249 @@ int secp_verify_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// Host-side batch preparation for the TPU kernel (tpunode/verify/kernel.py
+// prepare_batch): range checks, Montgomery batch inversion of s, u1/u2,
+// GLV decomposition, 4-bit window digits and radix-11 limb conversion —
+// the per-item big-int work that dominates Python prep.  Layouts match
+// PreparedBatch exactly (limb-major / batch-minor int32).
+// ===========================================================================
+
+#include <atomic>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// GLV lattice constants (standard public secp256k1 endomorphism basis;
+// same values as tpunode/verify/kernel.py:71-74, verified bit-exact against
+// kernel.glv_split in tests/test_native_verify.py).
+const uint64_t GLV_A1[2] = {0xE86C90E49284EB15ULL, 0x3086D221A7D46BCDULL};
+const uint64_t GLV_B1N[2] = {0x6F547FA90ABFE4C3ULL, 0xE4437ED6010E8828ULL};
+const uint64_t GLV_A2[3] = {0x57C1108D9D44CFD8ULL, 0x14CA50F7A8E2F3F6ULL, 1ULL};
+// b2 == a1
+
+constexpr int PREP_RADIX = 11;
+constexpr int PREP_NLIMBS = 24;
+constexpr int PREP_WINDOWS = 33;
+
+// ---- fixed-width helpers on little-endian u64 arrays ----------------------
+
+// out[no] = a[na] * b[nb] (no >= na+nb)
+inline void mp_mul(const uint64_t *a, int na, const uint64_t *b, int nb,
+                   uint64_t *out, int no) {
+  for (int i = 0; i < no; ++i) out[i] = 0;
+  for (int i = 0; i < na; ++i) {
+    uint64_t carry = 0;
+    for (int j = 0; j < nb; ++j) {
+      u128 cur = (u128)a[i] * b[j] + out[i + j] + carry;
+      out[i + j] = (uint64_t)cur;
+      carry = (uint64_t)(cur >> 64);
+    }
+    int k = i + nb;
+    while (carry && k < no) {
+      u128 cur = (u128)out[k] + carry;
+      out[k] = (uint64_t)cur;
+      carry = (uint64_t)(cur >> 64);
+      ++k;
+    }
+  }
+}
+
+// a[n] += b[nb]; returns carry out
+inline uint64_t mp_add(uint64_t *a, int n, const uint64_t *b, int nb) {
+  uint64_t carry = 0;
+  for (int i = 0; i < n; ++i) {
+    u128 cur = (u128)a[i] + (i < nb ? b[i] : 0) + carry;
+    a[i] = (uint64_t)cur;
+    carry = (uint64_t)(cur >> 64);
+  }
+  return carry;
+}
+
+// a[n] -= b[nb]; returns borrow out
+inline uint64_t mp_sub(uint64_t *a, int n, const uint64_t *b, int nb) {
+  uint64_t borrow = 0;
+  for (int i = 0; i < n; ++i) {
+    uint64_t bi = i < nb ? b[i] : 0;
+    u128 d = (u128)a[i] - bi - borrow;
+    a[i] = (uint64_t)d;
+    borrow = (d >> 64) ? 1 : 0;
+  }
+  return borrow;
+}
+
+// Barrett reciprocals round(2^384 * b / n) for b = b2(=a1) and |b1| —
+// the same constants as libsecp256k1's scalar_split_lambda g1/g2 and
+// kernel.py's _G1/_G2 (bit-identical digits across all three).
+const uint64_t GLV_G1[4] = {0xE893209A45DBB031ULL, 0x3DAA8A1471E8CA7FULL,
+                            0xE86C90E49284EB15ULL, 0x3086D221A7D46BCDULL};
+const uint64_t GLV_G2[4] = {0x1571B4AE8AC47F71ULL, 0x221208AC9DF506C6ULL,
+                            0x6F547FA90ABFE4C4ULL, 0xE4437ED6010E8828ULL};
+
+// c = round(k * g / 2^384): one 4x4 multiply + a shifted rounding add.
+inline void glv_c(const uint64_t g[4], const Fe &k, uint64_t c[3]) {
+  uint64_t t[8];
+  mp_mul(k.v, 4, g, 4, t, 8);
+  uint64_t half[6] = {0, 0, 0, 0, 0, 0x8000000000000000ULL};  // 2^383
+  mp_add(t, 8, half, 6);
+  c[0] = t[6];
+  c[1] = t[7];
+  c[2] = 0;
+}
+
+// signed k1/k2 halves: value = (-1)^neg * abs[3]
+struct Half {
+  uint64_t abs[3];
+  bool neg;
+};
+
+// k1 = k - c1*a1 - c2*a2 ; k2 = c1*b1n - c2*b2  (b1 = -b1n, b2 = a1),
+// computed in 448-bit two's complement.
+inline void glv_halves(const Fe &k, const uint64_t c1[3], const uint64_t c2[3],
+                       Half &h1, Half &h2) {
+  uint64_t acc[7] = {k.v[0], k.v[1], k.v[2], k.v[3], 0, 0, 0};
+  uint64_t t[7];
+  mp_mul(c1, 3, GLV_A1, 2, t, 7);
+  mp_sub(acc, 7, t, 7);
+  mp_mul(c2, 3, GLV_A2, 3, t, 7);
+  mp_sub(acc, 7, t, 7);
+  h1.neg = (acc[6] >> 63) != 0;
+  if (h1.neg) {  // negate two's complement
+    for (int i = 0; i < 7; ++i) acc[i] = ~acc[i];
+    uint64_t one[1] = {1};
+    mp_add(acc, 7, one, 1);
+  }
+  h1.abs[0] = acc[0]; h1.abs[1] = acc[1]; h1.abs[2] = acc[2];
+
+  uint64_t acc2[7] = {0, 0, 0, 0, 0, 0, 0};
+  mp_mul(c1, 3, GLV_B1N, 2, acc2, 7);
+  mp_mul(c2, 3, GLV_A1, 2, t, 7);  // b2 == a1
+  mp_sub(acc2, 7, t, 7);
+  h2.neg = (acc2[6] >> 63) != 0;
+  if (h2.neg) {
+    for (int i = 0; i < 7; ++i) acc2[i] = ~acc2[i];
+    uint64_t one[1] = {1};
+    mp_add(acc2, 7, one, 1);
+  }
+  h2.abs[0] = acc2[0]; h2.abs[1] = acc2[1]; h2.abs[2] = acc2[2];
+}
+
+// MSB-first 4-bit window digits of abs into out[w * size + lane].
+inline void write_digits(const uint64_t abs[3], int32_t *out, int size,
+                         int lane) {
+  for (int w = 0; w < PREP_WINDOWS; ++w) {
+    int sh = 4 * (PREP_WINDOWS - 1 - w);
+    uint64_t limb = abs[sh / 64];
+    out[w * size + lane] = (int32_t)((limb >> (sh % 64)) & 0xF);
+  }
+}
+
+// radix-11 little-endian limbs of a (canonical) into out[j * size + lane].
+inline void write_limbs(const Fe &a, int32_t *out, int size, int lane) {
+  for (int j = 0; j < PREP_NLIMBS; ++j) {
+    int sh = PREP_RADIX * j;
+    int w = sh / 64, off = sh % 64;
+    uint64_t lo = a.v[w] >> off;
+    if (off > 64 - PREP_RADIX && w + 1 < 4) lo |= a.v[w + 1] << (64 - off);
+    out[j * size + lane] = (int32_t)(lo & ((1u << PREP_RADIX) - 1));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Host prep for one device batch.  All byte inputs are 32-byte big-endian,
+// one entry per item; ``present[i]`` nonzero means the pubkey decoded to a
+// finite point and r/s passed Python-side range checks.  int32 outputs are
+// (rows, size) C-contiguous, zero-initialized by the caller; lanes >= count
+// stay zero.  Returns the number of GLV bound violations (0 = success;
+// cannot occur for in-range scalars — a nonzero return means a bug and the
+// caller must refuse the batch).
+int secp_prepare_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
+                       const uint8_t *r, const uint8_t *s,
+                       const uint8_t *present, int count, int size,
+                       int32_t *d1a, int32_t *d1b, int32_t *d2a, int32_t *d2b,
+                       uint8_t *negs, int32_t *qx, int32_t *qy, int32_t *r1,
+                       int32_t *r2, uint8_t *r2_valid, uint8_t *host_valid,
+                       int nthreads) {
+  // ---- serial: validity + Montgomery batch inversion of s ----
+  std::vector<Fe> sv(count), prefix(count), w(count);
+  std::vector<uint8_t> ok(count);
+  Fe run{{1, 0, 0, 0}};
+  for (int i = 0; i < count; ++i) {
+    Fe si = fe_from_be(s + 32 * i);
+    Fe ri = fe_from_be(r + 32 * i);
+    ok[i] = present[i] && !is_zero(si) && !ge(si, FN.m) && !is_zero(ri) &&
+            !ge(ri, FN.m);
+    sv[i] = ok[i] ? si : Fe{{1, 0, 0, 0}};
+    run = FN.mul(run, sv[i]);
+    prefix[i] = run;
+  }
+  Fe inv_all = FN.inv(run);
+  for (int i = count - 1; i >= 0; --i) {
+    Fe before = (i == 0) ? Fe{{1, 0, 0, 0}} : prefix[i - 1];
+    w[i] = FN.mul(inv_all, before);
+    inv_all = FN.mul(inv_all, sv[i]);
+  }
+
+  // ---- parallel: per-item GLV + digits + limbs ----
+  std::atomic<int> violations{0};
+  auto work = [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      if (!ok[i]) continue;
+      host_valid[i] = 1;
+      Fe zi = fe_from_be(z + 32 * i);
+      while (ge(zi, FN.m)) sub_mod_raw(zi, FN.m);
+      Fe ri = fe_from_be(r + 32 * i);
+      Fe u1 = FN.mul(zi, w[i]);
+      Fe u2 = FN.mul(ri, w[i]);
+      Half h[4];
+      uint64_t c1[3], c2[3];
+      glv_c(GLV_G1, u1, c1);
+      glv_c(GLV_G2, u1, c2);
+      glv_halves(u1, c1, c2, h[0], h[1]);
+      glv_c(GLV_G1, u2, c1);
+      glv_c(GLV_G2, u2, c2);
+      glv_halves(u2, c1, c2, h[2], h[3]);
+      int32_t *dsts[4] = {d1a, d1b, d2a, d2b};
+      for (int j = 0; j < 4; ++j) {
+        if (h[j].abs[2] >> 4) {  // |k| >= 2^132: outside the window range
+          violations.fetch_add(1, std::memory_order_relaxed);
+          continue;
+        }
+        write_digits(h[j].abs, dsts[j], size, i);
+        negs[j * size + i] = h[j].neg ? 1 : 0;
+      }
+      write_limbs(fe_from_be(px + 32 * i), qx, size, i);
+      write_limbs(fe_from_be(py + 32 * i), qy, size, i);
+      write_limbs(ri, r1, size, i);
+      // r + n < p ?
+      Fe rn = ri;
+      uint64_t carry = mp_add(rn.v, 4, FN.m, 4);
+      if (!carry && !ge(rn, FP.m)) {
+        write_limbs(rn, r2, size, i);
+        r2_valid[i] = 1;
+      }
+    }
+  };
+  int T = nthreads > 0 ? nthreads : (int)std::thread::hardware_concurrency();
+  if (T < 1) T = 1;
+  if (T == 1 || count < 256) {
+    work(0, count);
+  } else {
+    std::vector<std::thread> ts;
+    int chunk = (count + T - 1) / T;
+    for (int t = 0; t < T; ++t) {
+      int lo = t * chunk, hi = lo + chunk < count ? lo + chunk : count;
+      if (lo >= hi) break;
+      ts.emplace_back(work, lo, hi);
+    }
+    for (auto &th : ts) th.join();
+  }
+  return violations.load();
+}
+
+}  // extern "C"

@@ -47,6 +47,75 @@ class NativeVerifier:
             ctypes.c_int,
             ctypes.c_char_p,
         ]
+        import numpy as _np
+        from numpy.ctypeslib import ndpointer
+
+        i32 = ndpointer(_np.int32, flags="C_CONTIGUOUS")
+        u8 = ndpointer(_np.uint8, flags="C_CONTIGUOUS")
+        self._lib.secp_prepare_batch.restype = ctypes.c_int
+        self._lib.secp_prepare_batch.argtypes = [
+            ctypes.c_char_p,  # px
+            ctypes.c_char_p,  # py
+            ctypes.c_char_p,  # z
+            ctypes.c_char_p,  # r
+            ctypes.c_char_p,  # s
+            ctypes.c_char_p,  # present
+            ctypes.c_int,  # count
+            ctypes.c_int,  # size
+            i32,  # d1a
+            i32,  # d1b
+            i32,  # d2a
+            i32,  # d2b
+            u8,  # negs
+            i32,  # qx
+            i32,  # qy
+            i32,  # r1
+            i32,  # r2
+            u8,  # r2_valid
+            u8,  # host_valid
+            ctypes.c_int,  # nthreads
+        ]
+
+    def prepare_batch_arrays(
+        self,
+        px: bytes,
+        py: bytes,
+        z: bytes,
+        r: bytes,
+        s: bytes,
+        present: bytes,
+        count: int,
+        size: int,
+        nthreads: int = 0,
+    ):
+        """Fill PreparedBatch arrays natively (see kernel.prepare_batch's
+        fast path).  Returns the dict of limb-major numpy arrays.  Raises
+        on a GLV bound violation (structurally impossible for in-range
+        scalars; nonzero means a bug, never a bad signature)."""
+        import numpy as np
+
+        out = {
+            "d1a": np.zeros((33, size), np.int32),
+            "d1b": np.zeros((33, size), np.int32),
+            "d2a": np.zeros((33, size), np.int32),
+            "d2b": np.zeros((33, size), np.int32),
+            "negs": np.zeros((4, size), np.uint8),
+            "qx": np.zeros((24, size), np.int32),
+            "qy": np.zeros((24, size), np.int32),
+            "r1": np.zeros((24, size), np.int32),
+            "r2": np.zeros((24, size), np.int32),
+            "r2_valid": np.zeros(size, np.uint8),
+            "host_valid": np.zeros(size, np.uint8),
+        }
+        bad = self._lib.secp_prepare_batch(
+            px, py, z, r, s, present, count, size,
+            out["d1a"], out["d1b"], out["d2a"], out["d2b"], out["negs"],
+            out["qx"], out["qy"], out["r1"], out["r2"],
+            out["r2_valid"], out["host_valid"], nthreads,
+        )
+        if bad:
+            raise ValueError(f"native prep: {bad} GLV half-scalars out of range")
+        return out
 
     def verify_batch(
         self, items: Sequence[tuple[Optional[Point], int, int, int]]
@@ -62,18 +131,32 @@ class NativeVerifier:
         zs = bytearray()
         rs = bytearray()
         ss = bytearray()
+        from .ecdsa_cpu import CURVE_N
+
         degenerate = [False] * n
         for i, (q, z, r, s) in enumerate(items):
-            if q is None or q.infinity:
+            # Range-check the ORIGINAL ints before packing: r/s from lax DER
+            # can exceed 2^256, and truncating them mod 2^256 could alias a
+            # hostile value onto a valid one — the oracle/TPU paths reject
+            # such items, so this backend must too (never pack-then-check).
+            if (
+                q is None
+                or q.infinity
+                or not (0 < r < CURVE_N)
+                or not (0 < s < CURVE_N)
+            ):
                 degenerate[i] = True
                 px += b"\x00" * 32
                 py += b"\x00" * 32
-            else:
-                px += q.x.to_bytes(32, "big")
-                py += q.y.to_bytes(32, "big")
-            zs += (z % (1 << 256)).to_bytes(32, "big")
-            rs += (r % (1 << 256)).to_bytes(32, "big")
-            ss += (s % (1 << 256)).to_bytes(32, "big")
+                zs += b"\x00" * 32
+                rs += b"\x00" * 32
+                ss += b"\x00" * 32
+                continue
+            px += q.x.to_bytes(32, "big")
+            py += q.y.to_bytes(32, "big")
+            zs += (z % CURVE_N).to_bytes(32, "big")
+            rs += r.to_bytes(32, "big")
+            ss += s.to_bytes(32, "big")
         out = ctypes.create_string_buffer(n)
         self._lib.secp_verify_batch(
             bytes(px), bytes(py), bytes(zs), bytes(rs), bytes(ss), n, out
@@ -84,14 +167,17 @@ class NativeVerifier:
 
 
 _cached: Optional[NativeVerifier] = None
+_load_failed = False
 
 
 def load_native_verifier() -> Optional[NativeVerifier]:
-    """Build+load the native verifier; None if the toolchain is unavailable."""
-    global _cached
-    if _cached is None:
+    """Build+load the native verifier; None if the toolchain is unavailable.
+    Failure is cached so a broken toolchain costs one ``make`` attempt per
+    process, not one per batch on the hot prep path."""
+    global _cached, _load_failed
+    if _cached is None and not _load_failed:
         try:
             _cached = NativeVerifier()
         except Exception:
-            return None
+            _load_failed = True
     return _cached

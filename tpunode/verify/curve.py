@@ -54,8 +54,12 @@ def pt_select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(mask, a, b)
 
 
-def pt_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+def pt_add(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
     """Complete addition (RCB'16 Algorithm 7, a = 0): 12 muls, no exceptions.
+
+    ``F`` is the field-arithmetic namespace (mul/mul_t/mul_small_red with
+    field.py's contracts); the Pallas kernel passes its Mosaic-friendly
+    implementation so both device paths share these audited formulas.
 
     Limb-bound audit against field.mul's contract (|non-top limb| <= 2^19,
     |top limb| <= 2^15, pairwise top(a)*top(b) <= 2^30): every mul operand
@@ -95,8 +99,10 @@ def pt_add(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     return make_point(x3, y3, z3)
 
 
-def pt_double(p: jnp.ndarray) -> jnp.ndarray:
-    """Complete doubling (RCB'16 Algorithm 9, a = 0): 6 muls + 2 squarings."""
+def pt_double(p: jnp.ndarray, F=F) -> jnp.ndarray:
+    """Complete doubling (RCB'16 Algorithm 9, a = 0): 6 muls + 2 squarings.
+
+    ``F`` as in :func:`pt_add`."""
     X, Y, Z = p[0], p[1], p[2]
     mul = F.mul
 

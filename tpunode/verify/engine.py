@@ -307,8 +307,7 @@ class VerifyEngine:
         backend = self._pick(len(items))
         t0 = time.perf_counter()
         if backend == "tpu":
-            out = self._run_tpu(items)
-            metrics.inc("verify.tpu_items", len(items))
+            out = self._run_tpu(items)  # counts tpu/cpu items per chunk
         elif backend == "cpu" and self._cpu is not None:
             out = self._cpu.verify_batch(items)
             metrics.inc("verify.cpu_items", len(items))
@@ -322,12 +321,14 @@ class VerifyEngine:
     def _run_tpu(self, items: list[VerifyItem]) -> list[bool]:
         """Device dispatch in fixed-size chunks: every call is the exact
         shape the warmup compiled — no surprise recompiles on the hot path.
-        A sub-``min_tpu_batch`` remainder goes to the CPU engine instead of
+        Dispatch is pipelined: chunk N+1 is host-prepped while chunk N runs
+        on the device (JAX async dispatch), so neither side idles.  A
+        sub-``min_tpu_batch`` remainder goes to the CPU engine instead of
         paying a full near-empty device step (forced-tpu backend excepted)."""
-        from .kernel import verify_batch_tpu
+        from .kernel import collect_verdicts, dispatch_batch_tpu
 
         B = self.cfg.batch_size
-        out: list[bool] = []
+        pending: list = []  # (device array, count) | list[bool]
         for i in range(0, len(items), B):
             chunk = items[i : i + B]
             if (
@@ -335,7 +336,12 @@ class VerifyEngine:
                 and self.cfg.backend != "tpu"
                 and self._cpu is not None
             ):
-                out.extend(self._cpu.verify_batch(chunk))
+                pending.append(self._cpu.verify_batch(chunk))
+                metrics.inc("verify.cpu_items", len(chunk))
             else:
-                out.extend(verify_batch_tpu(chunk, pad_to=B))
+                pending.append(dispatch_batch_tpu(chunk, pad_to=B))
+                metrics.inc("verify.tpu_items", len(chunk))
+        out: list[bool] = []
+        for p in pending:
+            out.extend(p if isinstance(p, list) else collect_verdicts(*p))
         return out

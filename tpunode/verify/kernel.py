@@ -54,6 +54,8 @@ __all__ = [
     "verify_core",
     "verify_device",
     "verify_batch_tpu",
+    "dispatch_batch_tpu",
+    "collect_verdicts",
     "PreparedBatch",
 ]
 
@@ -82,11 +84,20 @@ _SEVEN = jnp.array(F.to_limbs(7))[:, None]
 _BETA_L = jnp.array(F.to_limbs(BETA))[:, None]
 
 
+# Barrett reciprocals: round(2^384 * b2 / n) and round(2^384 * |b1| / n).
+# c_i = round(k * G_i / 2^384) equals the exact round((b*k + n/2) / n) in
+# practice (and ANY c rounding keeps the decomposition exact: k1 + λ·k2 ≡ k
+# holds structurally); the native prep (secp_prepare_batch) uses the same
+# formula so both paths emit bit-identical digits.
+_G1 = ((_B2 << 384) + CURVE_N // 2) // CURVE_N
+_G2 = ((-_B1 << 384) + CURVE_N // 2) // CURVE_N
+
+
 def glv_split(k: int) -> tuple[int, int]:
     """Decompose ``k`` (mod n) as ``k1 + k2·λ`` with |k1|, |k2| < ~2^129."""
     k %= CURVE_N
-    c1 = (_B2 * k + CURVE_N // 2) // CURVE_N
-    c2 = (-_B1 * k + CURVE_N // 2) // CURVE_N
+    c1 = (k * _G1 + (1 << 383)) >> 384
+    c2 = (k * _G2 + (1 << 383)) >> 384
     k1 = k - c1 * _A1 - c2 * _A2
     k2 = -c1 * _B1 - c2 * _B2
     return k1, k2
@@ -216,7 +227,9 @@ def _ints_to_digits_np(vals: list[int]) -> np.ndarray:
 
 
 def prepare_batch(
-    items: Sequence[tuple[Optional[Point], int, int, int]], pad_to: Optional[int] = None
+    items: Sequence[tuple[Optional[Point], int, int, int]],
+    pad_to: Optional[int] = None,
+    native: Optional[bool] = None,
 ) -> PreparedBatch:
     """Host-side preparation: (pubkey|None, z, r, s) -> device arrays.
 
@@ -224,7 +237,18 @@ def prepare_batch(
     masked out host-side (``host_valid``); their lanes carry dummy values so
     shapes stay static.  ``pad_to`` pads the batch to a fixed size to avoid
     recompilation across batches.
+
+    ``native=None`` auto-selects the C++ fast path (secp_prepare_batch in
+    native/secp256k1 — batch inversion, GLV split, digit/limb conversion;
+    bit-identical outputs, ~10x the Python rate) when the library loads;
+    ``native=False`` forces the pure-Python reference path.
     """
+    if native is not False:
+        prep = _prepare_batch_native(items, pad_to)
+        if prep is not None or native is True:
+            if prep is None:
+                raise RuntimeError("native prep requested but unavailable")
+            return prep
     count = len(items)
     size = pad_to or count
     assert size >= count
@@ -318,6 +342,75 @@ def prepare_batch(
     )
 
 
+def _prepare_batch_native(
+    items: Sequence[tuple[Optional[Point], int, int, int]],
+    pad_to: Optional[int],
+) -> Optional[PreparedBatch]:
+    """C++ fast path for prepare_batch (None if the library is missing).
+
+    Python packs fixed-width byte columns and prechecks ranges (so every
+    packed int fits 32 bytes); the native side redoes the r/s range checks,
+    then does the heavy big-int work per item.  Output arrays are written
+    directly in limb-major layout — no transposes.
+    """
+    from .cpu_native import load_native_verifier
+
+    nv = load_native_verifier()
+    if nv is None:
+        return None
+    count = len(items)
+    size = pad_to or count
+    assert size >= count
+    zero32 = b"\x00" * 32
+    px, py, zs, rs, ss, present = [], [], [], [], [], bytearray(count)
+    for i, (q, z, r, s) in enumerate(items):
+        if (
+            q is not None
+            and not q.infinity
+            and 0 < r < CURVE_N
+            and 0 < s < CURVE_N
+        ):
+            present[i] = 1
+            px.append(q.x.to_bytes(32, "big"))
+            py.append(q.y.to_bytes(32, "big"))
+            zs.append((z % CURVE_N).to_bytes(32, "big"))
+            rs.append(r.to_bytes(32, "big"))
+            ss.append(s.to_bytes(32, "big"))
+        else:
+            px.append(zero32)
+            py.append(zero32)
+            zs.append(zero32)
+            rs.append(zero32)
+            ss.append(zero32)
+    out = nv.prepare_batch_arrays(
+        b"".join(px),
+        b"".join(py),
+        b"".join(zs),
+        b"".join(rs),
+        b"".join(ss),
+        bytes(present),
+        count,
+        size,
+    )
+    return PreparedBatch(
+        d1a=out["d1a"],
+        d1b=out["d1b"],
+        d2a=out["d2a"],
+        d2b=out["d2b"],
+        n1a=out["negs"][0].astype(bool),
+        n1b=out["negs"][1].astype(bool),
+        n2a=out["negs"][2].astype(bool),
+        n2b=out["negs"][3].astype(bool),
+        qx=out["qx"],
+        qy=out["qy"],
+        r1=out["r1"],
+        r2=out["r2"],
+        r2_valid=out["r2_valid"].astype(bool),
+        host_valid=out["host_valid"].astype(bool),
+        count=count,
+    )
+
+
 def _build_q_table(qx: jnp.ndarray, qy: jnp.ndarray) -> jnp.ndarray:
     """Per-signature table [O, Q, 2Q, ..., 15Q], shape (16, 3, L, B)."""
     q1 = make_point(qx, qy, jnp.broadcast_to(F.ONE, qx.shape))
@@ -399,14 +492,52 @@ def verify_core(
 verify_device = jax.jit(verify_core)
 
 
+def _pallas_usable(batch: int) -> bool:
+    """The Pallas/Mosaic kernel (pallas_kernel.py) is ~3-6x faster than the
+    XLA program but TPU-only and fixed-block: use it when the padded batch
+    tiles into its lane blocks and the default backend is a TPU."""
+    try:
+        from .pallas_kernel import BLOCK
+
+        if batch % BLOCK != 0:
+            return False
+        import jax as _jax
+
+        return _jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def dispatch_batch_tpu(
+    items: Sequence[tuple[Optional[Point], int, int, int]],
+    pad_to: Optional[int] = None,
+) -> tuple[jnp.ndarray, int]:
+    """Host prep + ASYNC device dispatch: returns (device verdict array,
+    item count) without blocking on the result.  JAX dispatch is
+    asynchronous, so the caller can prep the next chunk while this one
+    computes — the overlap that keeps the device saturated during IBD
+    (SURVEY.md §7 hard part 5).  Collect with :func:`collect_verdicts`."""
+    prep = prepare_batch(items, pad_to=pad_to)
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    if _pallas_usable(args[8].shape[-1]):
+        from .pallas_kernel import verify_blocked
+
+        return verify_blocked(*args), prep.count
+    return verify_device(*args), prep.count
+
+
+def collect_verdicts(out: jnp.ndarray, count: int) -> list[bool]:
+    """Block on a :func:`dispatch_batch_tpu` result and return verdicts."""
+    return [bool(b) for b in np.asarray(out)[:count]]
+
+
 def verify_batch_tpu(
     items: Sequence[tuple[Optional[Point], int, int, int]],
     pad_to: Optional[int] = None,
 ) -> list[bool]:
     """End-to-end: host prep + device verify.  Same item shape as the CPU
-    engines: (pubkey, z, r, s)."""
+    engines: (pubkey, z, r, s).  Dispatches to the Pallas kernel on TPU
+    (block-aligned batches), else the portable XLA program."""
     if not items:
         return []
-    prep = prepare_batch(items, pad_to=pad_to)
-    out = verify_device(*(jnp.asarray(a) for a in prep.device_args))
-    return [bool(b) for b in np.asarray(out)[: prep.count]]
+    return collect_verdicts(*dispatch_batch_tpu(items, pad_to=pad_to))
