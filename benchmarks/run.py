@@ -121,7 +121,7 @@ def config3() -> None:
     against the CPU oracle on a sample."""
     from tpunode.headers import MemoryHeaderStore, connect_blocks
     from tpunode.params import BCH_REGTEST
-    from tpunode.txverify import extract_sig_items
+    from tpunode.txverify import extract_sig_items, intra_block_amounts
     from tpunode.verify.ecdsa_cpu import verify_batch_cpu
     from tpunode.verify.engine import VerifyConfig, VerifyEngine
     from benchmarks.txgen import gen_chain
@@ -134,7 +134,21 @@ def config3() -> None:
         n_blocks,
         txs_per_block,
         cache=f"ibd_{n_blocks}x{txs_per_block}.bin",
+        segwit_every=4,  # every 4th tx is a P2WPKH spend: BIP143 end-to-end
     )
+
+    def block_items(b):
+        outs = intra_block_amounts(b.txs)
+        items = []
+        for tx in b.txs:
+            amounts = {
+                idx: outs[(ti.prevout.txid, ti.prevout.index)]
+                for idx, ti in enumerate(tx.inputs)
+                if (ti.prevout.txid, ti.prevout.index) in outs
+            }
+            its, _ = extract_sig_items(tx, prevout_amounts=amounts or None)
+            items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
+        return items
 
     async def replay() -> tuple[int, float, int]:
         engine = VerifyEngine(VerifyConfig(batch_size=batch, max_wait=0.002))
@@ -148,10 +162,7 @@ def config3() -> None:
                 nodes, best = connect_blocks(store, BCH_REGTEST, now, [b.header])
                 store.add_headers(nodes)
                 store.set_best(best)
-                items = []
-                for tx in b.txs:
-                    its, _ = extract_sig_items(tx)
-                    items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
+                items = block_items(b)
                 if items:
                     sigs += len(items)
                     pending.append(asyncio.ensure_future(engine.verify(items)))
@@ -162,9 +173,7 @@ def config3() -> None:
             # consensus-identical check on a sample vs the oracle
             sample_items = []
             for b in blocks[:2]:
-                for tx in b.txs:
-                    its, _ = extract_sig_items(tx)
-                    sample_items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
+                sample_items.extend(block_items(b))
             assert verify_batch_cpu(sample_items) == [True] * len(sample_items)
             return sigs, dt, store.get_best().height
 
@@ -206,7 +215,15 @@ def config4() -> None:
     n_txs = 40 if SMALL else 1024  # unique; tiled across peers
     duration = 3.0 if SMALL else 15.0
     batch = 128 if SMALL else 4096
-    txs = gen_signed_txs(n_txs, inputs_per_tx=2, seed=0xF12E, invalid_every=64)
+    txs = gen_signed_txs(
+        n_txs, inputs_per_tx=2, seed=0xF12E, invalid_every=64, segwit_every=4
+    )
+    # The firehose streams single txs (no block context), so BIP143 amounts
+    # come through the embedder hook — config4 exercises that channel.
+    prevouts = {}
+    for tx in txs:
+        for vout, o in enumerate(tx.outputs):
+            prevouts[(tx.txid, vout)] = o.value
 
     async def run() -> tuple[int, int, float]:
         from tests import fixtures
@@ -257,6 +274,7 @@ def config4() -> None:
             max_peers=n_peers,
             connect=lambda sa: firehose_connect(),
             verify=VerifyConfig(batch_size=batch, max_wait=0.005),
+            prevout_lookup=lambda txid, vout: prevouts.get((txid, vout)),
         )
         verdicts = 0
         sigs = 0

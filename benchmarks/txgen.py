@@ -24,7 +24,7 @@ from typing import Optional
 from tpunode.headers import genesis_node
 from tpunode.util import bits_to_target
 from tpunode.params import Network
-from tpunode.sighash import SIGHASH_ALL, legacy_sighash
+from tpunode.sighash import SIGHASH_ALL, bip143_sighash, legacy_sighash
 from tpunode.txverify import _p2pkh_script_code
 from tpunode.util import Reader, double_sha256
 from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
@@ -66,18 +66,38 @@ def gen_signed_txs(
     inputs_per_tx: int = 2,
     seed: int = 0xB10C,
     invalid_every: int = 0,
+    segwit_every: int = 0,
 ) -> list[Tx]:
     """``count`` P2PKH-spending txs, each with ``inputs_per_tx`` signed
     inputs.  ``invalid_every`` > 0 corrupts every Nth tx's first signature
-    (to keep verifiers honest)."""
+    (to keep verifiers honest).  ``segwit_every`` > 0 makes every Nth tx a
+    P2WPKH spend (BIP143 digest) of the PREVIOUS tx's output 0, so packed
+    into one block the prevout amount is resolvable intra-block — the
+    channel node._verify_txs wires into extract_sig_items."""
     rng = random.Random(seed)
     priv = rng.getrandbits(256) % CURVE_N or 1
     pub = point_mul(priv, GENERATOR)
     blob = _pub_blob(pub)
     script_code = _p2pkh_script_code(blob)
     out_script = script_code  # pay back to the same key
-    txs = []
+    txs: list[Tx] = []
     for t in range(count):
+        if segwit_every and t % segwit_every == segwit_every - 1 and txs:
+            # P2WPKH: spend previous tx's output 0; witness [sig, pubkey]
+            prev = txs[-1]
+            amount = prev.outputs[0].value
+            inputs = (TxIn(OutPoint(prev.txid, 0), b"", 0xFFFFFFFF),)
+            outputs = (TxOut(50_000 + t, out_script),)
+            unsigned = Tx(2, inputs, outputs, 0)
+            z = bip143_sighash(unsigned, 0, script_code, amount, SIGHASH_ALL)
+            r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+            if invalid_every and t % invalid_every == invalid_every - 1:
+                s = (s + 1) % CURVE_N or 1
+            sig_blob = _der(r, s) + bytes([SIGHASH_ALL])
+            txs.append(
+                Tx(2, inputs, outputs, 0, witnesses=((sig_blob, blob),))
+            )
+            continue
         inputs = tuple(
             TxIn(OutPoint(rng.randbytes(32), i), b"", 0xFFFFFFFF)
             for i in range(inputs_per_tx)
@@ -116,6 +136,7 @@ def gen_chain(
     inputs_per_tx: int = 2,
     seed: int = 0x1BD,
     cache: Optional[str] = None,
+    segwit_every: int = 0,
 ) -> list[Block]:
     """A consensus-valid chain of ``n_blocks`` regtest blocks on top of the
     genesis, each carrying signed P2PKH txs.  Cached to ``cache`` (under
@@ -127,6 +148,7 @@ def gen_chain(
         key = (
             f"{net.magic:08x}-{n_blocks}x{txs_per_block}"
             f"-i{inputs_per_tx}-s{seed:x}"
+            + (f"-w{segwit_every}" if segwit_every else "")
         )
         cache = f"{os.path.splitext(cache)[0]}-{key}.bin"
         path = cache_path(cache)
@@ -145,7 +167,10 @@ def gen_chain(
     prev = gen.header.hash
     t0 = net.genesis.timestamp
     all_txs = gen_signed_txs(
-        n_blocks * txs_per_block, inputs_per_tx=inputs_per_tx, seed=seed
+        n_blocks * txs_per_block,
+        inputs_per_tx=inputs_per_tx,
+        seed=seed,
+        segwit_every=segwit_every,
     )
     blocks = []
     for h in range(n_blocks):

@@ -41,7 +41,9 @@ def test_config3_ibd_replay():
     res = _run("config3")
     assert res["metric"] == "config3_ibd_replay"
     assert res["blocks"] == 50 and res["height"] == 50
-    assert res["sigs"] == 50 * 2 * 2  # blocks x txs x inputs
+    # 100 txs: every 4th is a P2WPKH spend (1 BIP143 sig via the intra-block
+    # amount), the rest are legacy with 2 sigs each -> 75*2 + 25*1
+    assert res["sigs"] == 75 * 2 + 25
 
 
 def test_config4_mempool_firehose():

@@ -1,0 +1,213 @@
+"""ChainSynced semantics + consensus property tests.
+
+Covers VERDICT r2 item 10 (settle the synced semantics deliberately and
+test both the stale-peer and live-chain cases) and item 4 of "what's
+missing" (the reference's randomized SockAddr property test,
+NodeSpec.hs:153-160, plus difficulty-retarget property tests).
+"""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from tests.fixtures import all_blocks
+from tpunode import BCH_REGTEST, ChainSynced, Namespaced, Publisher
+from tpunode.chain import Chain, ChainConfig
+from tpunode.headers import BlockNode, _clamped_retarget
+from tpunode.peermgr import to_host_service
+from tpunode.store import MemoryKV
+from tpunode.util import bits_to_target, target_to_bits
+from tpunode.wire import BlockHeader
+
+NET = BCH_REGTEST
+rng = random.Random(0x5EED)
+
+
+class FakePeer:
+    """Just enough of the Peer surface for the chain actor."""
+
+    def __init__(self, label="fake:0"):
+        self.label = label
+        self._busy = False
+        self.sent = []
+        self.killed = None
+
+    def set_busy(self):
+        if self._busy:
+            return False
+        self._busy = True
+        return True
+
+    def set_free(self):
+        self._busy = False
+
+    def send_message(self, msg):
+        self.sent.append(msg)
+
+    def kill(self, e):
+        self.killed = e
+
+
+def make_chain(**cfg_kw):
+    pub = Publisher(name="chain-test")
+    cfg = ChainConfig(
+        store=Namespaced(MemoryKV(), b"c:"), net=NET, pub=pub, **cfg_kw
+    )
+    return Chain(cfg), pub
+
+
+HEADERS = [b.header for b in all_blocks()]
+
+
+@pytest.mark.asyncio
+async def test_synced_fires_on_drain_default():
+    """Default semantics: stale regtest fixture still reports synced the
+    moment the queue drains (the live-chain-friendly default)."""
+    chain, pub = make_chain()
+    async with pub.subscription() as sub:
+        async with chain:
+            p = FakePeer()
+            chain.peer_connected(p)
+            chain.headers(p, HEADERS)
+            async with asyncio.timeout(5):
+                ev = await sub.receive_match(
+                    lambda e: e if isinstance(e, ChainSynced) else None
+                )
+            assert ev.node.height == 15
+            assert chain.is_synced()
+
+
+@pytest.mark.asyncio
+async def test_synced_min_age_reference_gate():
+    """synced_min_age=7200 reproduces the reference gate exactly
+    (Chain.hs:533-537): a >2h-old tip reports synced, a fresh tip does not."""
+    # stale fixture (timestamps from 2015): fires
+    chain, pub = make_chain(synced_min_age=7200.0)
+    async with pub.subscription() as sub:
+        async with chain:
+            p = FakePeer()
+            chain.peer_connected(p)
+            chain.headers(p, HEADERS)
+            async with asyncio.timeout(5):
+                await sub.receive_match(
+                    lambda e: e if isinstance(e, ChainSynced) else None
+                )
+
+    # fresh tip (pretend "now" is just after the tip): never fires
+    chain2, pub2 = make_chain(synced_min_age=7200.0)
+    fresh_now = HEADERS[-1].timestamp + 60  # tip is one minute old
+    orig_time = time.time
+    time_patch = lambda: fresh_now  # noqa: E731
+    async with pub2.subscription() as sub2:
+        async with chain2:
+            import tpunode.chain as chain_mod
+
+            chain_mod.time.time = time_patch
+            try:
+                p = FakePeer()
+                chain2.peer_connected(p)
+                chain2.headers(p, HEADERS)
+                await asyncio.sleep(0.2)  # let the actor drain
+                assert not chain2.is_synced()
+            finally:
+                chain_mod.time.time = orig_time
+
+
+@pytest.mark.asyncio
+async def test_is_synced_rearms_on_continuation():
+    """Live view: after the first sync, a full continuation batch flips
+    is_synced() back to False until the catch-up drains; the ChainSynced
+    EVENT remains one-shot like the reference's."""
+    chain, pub = make_chain(headers_batch=5)
+    events = []
+    async with pub.subscription() as sub:
+        async with chain:
+            p = FakePeer()
+            chain.peer_connected(p)
+            chain.headers(p, HEADERS[:3])  # short batch -> done -> synced
+            async with asyncio.timeout(5):
+                await sub.receive_match(
+                    lambda e: e if isinstance(e, ChainSynced) else None
+                )
+            assert chain.is_synced()
+            # a full batch (len == headers_batch) signals the peer has more
+            p2 = FakePeer("fake:1")
+            chain.peer_connected(p2)
+            chain.headers(p2, HEADERS[3:8])
+            await asyncio.sleep(0.2)
+            assert not chain.is_synced()  # catching up
+            chain.headers(p2, HEADERS[8:])  # short batch -> done
+            await asyncio.sleep(0.2)
+            assert chain.is_synced()
+            # event stayed one-shot: drain whatever is queued
+            while not sub._queue.empty():
+                events.append(sub._queue.get_nowait())
+            assert not any(isinstance(e, ChainSynced) for e in events)
+
+
+# --- property tests ---------------------------------------------------------
+
+
+def _rand_host():
+    if rng.random() < 0.5:
+        return ".".join(str(rng.randrange(256)) for _ in range(4)), False
+    groups = [f"{rng.randrange(1 << 16):x}" for _ in range(8)]
+    return ":".join(groups), True
+
+
+def test_sockaddr_roundtrip_property():
+    """Reference NodeSpec.hs:153-160: random IPv4/IPv6 addresses round-trip
+    through format -> to_host_service."""
+    for _ in range(300):
+        host, v6 = _rand_host()
+        port = rng.randrange(1, 1 << 16)
+        s = f"[{host}]:{port}" if v6 else f"{host}:{port}"
+        h, p = to_host_service(s)
+        assert h == host and p == str(port), s
+        # no-port forms
+        s2 = f"[{host}]" if v6 else host
+        h2, p2 = to_host_service(s2)
+        assert h2 == host and p2 is None, s2
+
+
+def _node_with(bits, timestamp, height):
+    hdr = BlockHeader(
+        version=0x20000000,
+        prev=b"\x00" * 32,
+        merkle=b"\x00" * 32,
+        timestamp=timestamp,
+        bits=bits,
+        nonce=0,
+    )
+    return BlockNode(header=hdr, height=height, work=0)
+
+
+def test_retarget_properties():
+    """Property tests of the 2016-block retarget (VERDICT r2 missing #4):
+    clamp bounds hold, on-schedule timespan is a fixed point, and slower
+    chains never get harder."""
+    span = NET.pow_target_timespan
+    base_bits = 0x1B0404CB  # a realistic mid-range compact target
+    for _ in range(200):
+        timespan = rng.randrange(1, span * 10)
+        first = _node_with(base_bits, 1_500_000_000, 0)
+        parent = _node_with(base_bits, 1_500_000_000 + timespan, 2015)
+        new_bits = _clamped_retarget(NET, parent, first)
+        old_target = bits_to_target(base_bits)
+        new_target = bits_to_target(new_bits)
+        # 4x clamp in either direction (modulo compact-bits truncation)
+        assert new_target <= bits_to_target(target_to_bits(min(old_target * 4, NET.pow_limit)))
+        assert new_target >= bits_to_target(target_to_bits(old_target // 4))
+    # exact-schedule fixed point
+    first = _node_with(base_bits, 1_500_000_000, 0)
+    parent = _node_with(base_bits, 1_500_000_000 + span, 2015)
+    assert _clamped_retarget(NET, parent, first) == base_bits
+    # monotonic: slower block production -> never a harder (smaller) target
+    prev_target = 0
+    for factor in (1, 2, 3, 4, 6, 10):
+        parent = _node_with(base_bits, 1_500_000_000 + span * factor, 2015)
+        t = bits_to_target(_clamped_retarget(NET, parent, first))
+        assert t >= prev_target
+        prev_target = t

@@ -14,6 +14,7 @@ on version mismatch all 0x90/0x91 keys are purged and the chain re-syncs.
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import time
 from dataclasses import dataclass, field
@@ -56,6 +57,8 @@ _KEY_VERSION = b"\x92"
 
 ZERO_HASH = b"\x00" * 32
 
+log = logging.getLogger("tpunode.chain")
+
 
 @dataclass(frozen=True)
 class ChainBestBlock:
@@ -78,6 +81,16 @@ class ChainConfig:
     net: Network
     pub: Publisher
     timeout: float = 120.0
+    # ChainSynced gating.  Default (None): report synced the first time the
+    # sync queue drains with no locked peer — works on live chains AND stale
+    # fixtures.  Set to e.g. 7200.0 for the reference's exact behavior
+    # (Chain.hs:533-537: only report synced when the best header is MORE
+    # than 2h old — suits its old regtest fixture, but on a live chain the
+    # event would wait for a 2h tip stall; divergence is deliberate).
+    synced_min_age: Optional[float] = None
+    # Wire continuation threshold (reference hardcodes 2000, Chain.hs:513);
+    # configurable so tests can exercise continuation with small fixtures.
+    headers_batch: int = 2000
 
 
 class ChainDB:
@@ -113,7 +126,14 @@ class ChainDB:
         """Version-gated init: purge header keys on schema mismatch, write the
         genesis node if the store is empty (reference ``initChainDB``
         Chain.hs:454-468)."""
-        if self.get_version() != DATA_VERSION:
+        ver = self.get_version()
+        if ver != DATA_VERSION:
+            if ver is not None:
+                log.info(
+                    "[Chain] schema version %s != %s: purging header store",
+                    ver,
+                    DATA_VERSION,
+                )
             self.purge()
         self._kv.put(_KEY_VERSION, DATA_VERSION.to_bytes(4, "little"))
         if self._kv.get(_KEY_BEST) is None:
@@ -172,6 +192,7 @@ class Chain:
         self._syncing: Optional[_ChainSync] = None
         self._peers: list[Peer] = []
         self._been_in_sync = False
+        self._catching_up = False
         self._tasks = LinkedTasks(name="chain", on_failure=on_failure)
 
     # -- lifecycle ----------------------------------------------------------
@@ -188,7 +209,13 @@ class Chain:
         await self._tasks.__aexit__(*exc)
 
     async def _main_loop(self) -> None:
-        self._emit(ChainBestBlock(self.db.get_best()))
+        best = self.db.get_best()
+        log.info(
+            "[Chain] starting at height %d (%s)",
+            best.height,
+            best.hash[::-1].hex()[:16],
+        )
+        self._emit(ChainBestBlock(best))
         while True:
             msg = await self.mailbox.receive()
             if isinstance(msg, _Headers):
@@ -224,18 +251,39 @@ class Chain:
                     self.db, self.cfg.net, int(time.time()), headers
                 )
             except BadHeaders as e:
+                log.warning(
+                    "[Chain] peer %s sent bad headers: %s", p.label, e
+                )
                 p.kill(PeerSentBadHeaders(str(e)))
                 return
             self.db.put_headers(nodes, best if best.hash != prev_best.hash else None)
         metrics.inc("chain.headers", len(nodes))
+        if nodes:
+            log.debug(
+                "[Chain] imported %d headers from %s up to height %d",
+                len(nodes),
+                p.label,
+                nodes[-1].height,
+            )
         if self._syncing is not None:
             self._syncing.timestamp = time.monotonic()
             if nodes:
                 # remember the peer's tip so the next locator continues from it
                 self._syncing.best = nodes[-1]
         if best.hash != prev_best.hash:
+            log.info(
+                "[Chain] new best height %d (%s)",
+                best.height,
+                best.hash[::-1].hex()[:16],
+            )
             self._emit(ChainBestBlock(best))
-        done = len(headers) != 2000  # continuation signal (Chain.hs:513-515)
+        # continuation signal (Chain.hs:513-515)
+        done = len(headers) != self.cfg.headers_batch
+        if self._syncing is None or self._syncing.peer is p:
+            # only the sync peer's stream drives the live catch-up view: a
+            # one-header announcement from another peer must not mask an
+            # in-flight continuation
+            self._catching_up = not done
         if done:
             p.send_message(MsgSendHeaders())
             self._finish_peer(p)
@@ -265,12 +313,18 @@ class Chain:
         on a live chain whose tip is recent it would never report synced.  We
         instead report synced the first time the sync queue drains with no
         locked peer, which covers both the reference's own test environment
-        (old regtest fixture) and live chains.
+        (old regtest fixture) and live chains.  ``ChainConfig.synced_min_age``
+        restores the reference's exact gate when set.
         """
         if self._been_in_sync or self._syncing is not None or self._peers:
             return
+        best = self.db.get_best()
+        if self.cfg.synced_min_age is not None:
+            if time.time() - best.header.timestamp <= self.cfg.synced_min_age:
+                return  # reference gate: tip not old enough yet
         self._been_in_sync = True
-        self._emit(ChainSynced(self.db.get_best()))
+        log.info("[Chain] chain synced at height %d", best.height)
+        self._emit(ChainSynced(best))
 
     def _sync_peer(self, p: Peer) -> None:
         """Request more headers from ``p`` if appropriate
@@ -319,6 +373,10 @@ class Chain:
         (reference ``chainMessage ChainPing`` Chain.hs:416-427)."""
         if self._syncing is not None:
             if time.monotonic() - self._syncing.timestamp > self.cfg.timeout:
+                log.warning(
+                    "[Chain] sync peer %s stalled; killing",
+                    self._syncing.peer.label,
+                )
                 self._syncing.peer.kill(PeerTimeout("chain sync stalled"))
         else:
             self._sync_new_peer()
@@ -360,4 +418,11 @@ class Chain:
         return anc is not None and anc.hash == node.hash
 
     def is_synced(self) -> bool:
-        return self._been_in_sync
+        """Live view: ever synced AND not currently chasing a continuation.
+
+        Divergence from the reference (whose ``chainIsSynced`` is a sticky
+        latch, Chain.hs:760-762): after the first ChainSynced, falling
+        behind by a full continuation batch flips this back to False until
+        the catch-up drains.  The ChainSynced EVENT stays one-shot like the
+        reference's."""
+        return self._been_in_sync and not self._catching_up

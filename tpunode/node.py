@@ -23,7 +23,12 @@ from typing import Callable, Optional
 from .actors import LinkedTasks, Publisher, Supervisor
 from .chain import Chain, ChainBestBlock, ChainConfig, ChainEvent
 from .metrics import metrics
-from .txverify import ExtractStats, extract_sig_items
+from .txverify import (
+    ExtractStats,
+    extract_sig_items,
+    intra_block_amounts,
+    wants_amount,
+)
 from .verify.engine import VerifyConfig, VerifyEngine
 from .params import NODE_NETWORK, Network
 from .peer import (
@@ -52,6 +57,8 @@ from .wire import (
 
 __all__ = ["NodeConfig", "Node", "TxVerdict", "tcp_connect"]
 
+
+log = logging.getLogger("tpunode.node")
 
 @dataclass(frozen=True)
 class TxVerdict:
@@ -90,6 +97,12 @@ class NodeConfig:
     # north-star hook: when set, inbound tx/block signatures stream through
     # the batch verify engine and TxVerdict events reach the user bus
     verify: Optional[VerifyConfig] = None
+    # prevout amount oracle for BIP143 (P2WPKH / BCH FORKID) sighashes:
+    # (prevout txid, vout) -> satoshi amount, or None if unknown.  Block
+    # ingest resolves intra-block spends automatically; this hook lets the
+    # embedder (which may hold a UTXO set) resolve the rest.  Capability
+    # boundary of SURVEY.md C9 / §2.2.
+    prevout_lookup: Optional[Callable[[bytes, int], Optional[int]]] = None
 
     def __post_init__(self):
         if self.connect is None:
@@ -149,15 +162,14 @@ class Node:
         (verdicts for its txs were already published or are indeterminate)."""
         if exc is not None and not isinstance(exc, asyncio.CancelledError):
             metrics.inc("node.verify_task_crashes")
-            logging.getLogger("tpunode.node").warning(
-                "verify ingest task crashed: %r", exc
-            )
+            log.warning("[Node] verify ingest task crashed: %r", exc)
 
     def _component_failed(self, exc: BaseException) -> None:
         """An internal actor crashed: abort the embedding scope, the analog of
         the reference ``link``-ing its loops so a crash takes down the whole
         node bracket (Node.hs:191-192; crash-only design, SURVEY.md §5)."""
         if self._failure is None:
+            log.error("[Node] component failed, tearing down node: %r", exc)
             self._failure = exc
             if self._owner is not None:
                 self._owner.cancel()
@@ -181,9 +193,17 @@ class Node:
         await self._stack.enter_async_context(self.peer_mgr)
         self._tasks.link(self._chain_events(chain_sub), name="glue-chain")
         self._tasks.link(self._peer_events(peer_sub), name="glue-peer")
+        log.info(
+            "[Node] started on %s (%d static peers, discover=%s, verify=%s)",
+            self.cfg.net.name,
+            len(self.cfg.peers),
+            self.cfg.discover,
+            "on" if self.verify_engine is not None else "off",
+        )
         return self
 
     async def __aexit__(self, exc_type, exc, tb) -> None:
+        log.info("[Node] stopping")
         self._owner = None
         try:
             await self._tasks.__aexit__(exc_type, exc, tb)
@@ -259,11 +279,29 @@ class Node:
         device batches (awaiting per tx would degrade a 150k-sig block into
         sequential tiny batches)."""
         assert self.verify_engine is not None
+        # Intra-block prevout amounts: a block message carries the funding tx
+        # for every in-block spend, which is exactly what BIP143 digests need
+        # (VERDICT r2 item 5).  Misses fall through to cfg.prevout_lookup.
+        block_outs = intra_block_amounts(txs) if len(txs) > 1 else {}
         per_tx: list[tuple[Tx, ExtractStats, Optional[asyncio.Task]]] = []
         try:
             for tx in txs:
+                amounts: dict[int, int] = {}
+                for idx, txin in enumerate(tx.inputs):
+                    if not wants_amount(tx, idx, self.cfg.net.bch):
+                        continue  # legacy non-FORKID input: amount unused
+                    key = (txin.prevout.txid, txin.prevout.index)
+                    amt = block_outs.get(key)
+                    if amt is None and self.cfg.prevout_lookup is not None:
+                        amt = self.cfg.prevout_lookup(*key)
+                    if amt is not None:
+                        amounts[idx] = amt
                 try:
-                    items, stats = extract_sig_items(tx, bch=self.cfg.net.bch)
+                    items, stats = extract_sig_items(
+                        tx,
+                        prevout_amounts=amounts or None,
+                        bch=self.cfg.net.bch,
+                    )
                 except Exception as e:
                     metrics.inc("node.verify_errors")
                     self.cfg.pub.publish(

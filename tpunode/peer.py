@@ -15,6 +15,7 @@ this seam is what makes the whole node testable without a network.
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 from contextlib import AbstractAsyncContextManager
 from dataclasses import dataclass, field
@@ -157,6 +158,8 @@ class EmptyHeader(PeerError):
 # --- peer handle & events ---------------------------------------------------
 
 
+log = logging.getLogger("tpunode.peer")
+
 @dataclass(frozen=True)
 class _SendMessage:
     message: object
@@ -201,6 +204,7 @@ class Peer:
 
     def kill(self, error: PeerError) -> None:
         """Ask the session to die with ``error`` (reference Peer.hs:286-287)."""
+        log.debug("[Peer] %s: kill requested: %r", self.label, error)
         self.mailbox.send(_KillPeer(error))
 
     def __repr__(self) -> str:
@@ -276,6 +280,13 @@ async def _inbound_loop(cfg: PeerConfig, peer: Peer, conn: Connection) -> None:
             msg = decode_message(cfg.net, header, payload)
         except DecodeError as e:
             raise CannotDecodePayload(f"{header.command}: {e}") from e
+        if log.isEnabledFor(logging.DEBUG):  # hot loop: skip formatting cost
+            log.debug(
+                "[Peer] %s: received %s (%d bytes)",
+                cfg.label,
+                header.command,
+                header.length,
+            )
         cfg.pub.publish(PeerMessage(peer, msg))
 
 
@@ -298,6 +309,7 @@ async def run_peer(cfg: PeerConfig, peer: Peer, inbox: Mailbox) -> None:
     error, kill command) tears the session down.  Exceptions propagate to the
     supervisor, which the peer manager turns into ``PeerDied`` handling.
     """
+    log.debug("[Peer] %s: session starting", cfg.label)
     async with cfg.connect() as conn:
         loop = asyncio.get_running_loop()
         t_in = loop.create_task(_inbound_loop(cfg, peer, conn), name=f"peer-in-{cfg.label}")
@@ -312,7 +324,11 @@ async def run_peer(cfg: PeerConfig, peer: Peer, inbox: Mailbox) -> None:
             await asyncio.gather(t_in, t_out, return_exceptions=True)
         for t in done:
             if not t.cancelled() and t.exception() is not None:
+                log.debug(
+                    "[Peer] %s: session ending: %s", cfg.label, t.exception()
+                )
                 raise t.exception()
+        log.debug("[Peer] %s: session ended cleanly", cfg.label)
 
 
 # --- synchronous request helpers -------------------------------------------

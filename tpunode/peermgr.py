@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import logging
 import random
 import statistics
 import time
@@ -46,6 +47,8 @@ __all__ = [
     "to_host_service",
     "to_sock_addr",
 ]
+
+log = logging.getLogger("tpunode.peermgr")
 
 SockAddr = tuple[str, int]  # (host, port)
 
@@ -228,15 +231,26 @@ class PeerMgr:
         """Handshake step 1 (reference ``dispatch (PeerVersion ...)``
         PeerMgr.hs:311-329 + ``setPeerVersion`` :654-674)."""
         if v.services & NODE_NETWORK == 0:
+            log.warning(
+                "[PeerMgr] peer %s lacks network service bit; killing", p.label
+            )
             p.kill(NotNetworkPeer(p.label))
             return
         if any(o.nonce == v.nonce for o in self._peers):
+            log.warning("[PeerMgr] peer %s is myself (nonce match); killing", p.label)
             p.kill(PeerIsMyself(p.label))
             return
         o = self._find_peer(p)
         if o is None:
             p.kill(UnknownPeer(p.label))
             return
+        log.debug(
+            "[PeerMgr] version from %s: %d %s height=%d",
+            p.label,
+            v.version,
+            v.user_agent.decode("latin-1"),
+            v.start_height,
+        )
         o.version = v
         o.online = o.verack
         p.send_message(MsgVerAck())
@@ -256,6 +270,12 @@ class PeerMgr:
             self._announce_peer(o)
 
     def _announce_peer(self, o: OnlinePeer) -> None:
+        # reference logConnectedPeers (PeerMgr.hs:285-290)
+        log.info(
+            "[PeerMgr] connected to peer %s (%d online)",
+            o.peer.label,
+            sum(1 for x in self._peers if x.online),
+        )
         self.cfg.pub.publish(PeerConnected(o.peer))
 
     def _on_addrs(self, addrs: list[NetworkAddress]) -> None:
@@ -263,6 +283,7 @@ class PeerMgr:
         (reference PeerMgr.hs:344-360)."""
         if not self.cfg.discover:
             return
+        log.debug("[PeerMgr] received %d addresses via gossip", len(addrs))
         for na in addrs:
             self._new_peer(na.to_host_port())
 
@@ -286,12 +307,15 @@ class PeerMgr:
             return
         now = time.monotonic()
         if now > o.connected + self.cfg.max_peer_life:
+            log.info("[PeerMgr] peer %s exceeded max life; evicting", p.label)
             p.kill(PeerTooOld(p.label))
             return
         if now > o.tickled + self.cfg.timeout:
             if o.ping is None:
+                log.debug("[PeerMgr] peer %s quiet; pinging", p.label)
                 self._send_ping(o)
             else:
+                log.warning("[PeerMgr] peer %s unresponsive; killing", p.label)
                 p.kill(PeerTimeout(p.label))
 
     def _send_ping(self, o: OnlinePeer) -> None:
@@ -307,6 +331,13 @@ class PeerMgr:
         o = next((x for x in self._peers if x.task is task), None)
         if o is None:
             return
+        exc = task.exception() if task.done() and not task.cancelled() else None
+        log.info(
+            "[PeerMgr] peer %s offline%s (%d online)",
+            o.peer.label,
+            f": {exc}" if exc else "",
+            sum(1 for x in self._peers if x.online) - (1 if o.online else 0),
+        )
         if o.online:
             self.cfg.pub.publish(PeerDisconnected(o.peer))
         self._peers.remove(o)
@@ -347,6 +378,7 @@ class PeerMgr:
         if any(o.address == sa for o in self._peers):
             return
         label = f"[{sa[0]}]:{sa[1]}" if ":" in sa[0] else f"{sa[0]}:{sa[1]}"
+        log.debug("[PeerMgr] connecting to %s", label)
         nonce = random.getrandbits(64)
         inbox: Mailbox = Mailbox(name=f"peer-{label}")
         pc = PeerConfig(

@@ -29,7 +29,36 @@ from .sighash import SIGHASH_FORKID, bip143_sighash, legacy_sighash
 from .verify.ecdsa_cpu import Point, decode_pubkey, parse_der_signature
 from .wire import Tx
 
-__all__ = ["SigItem", "extract_sig_items", "ExtractStats"]
+__all__ = [
+    "SigItem",
+    "extract_sig_items",
+    "ExtractStats",
+    "intra_block_amounts",
+    "wants_amount",
+]
+
+
+def wants_amount(tx: Tx, idx: int, bch: bool) -> bool:
+    """Could input ``idx`` consume a BIP143 prevout amount?  True for the
+    P2WPKH witness shape and for any input on a FORKID (BCH) network;
+    legacy inputs elsewhere never use amounts, so callers can skip their
+    (possibly expensive) amount lookups."""
+    if bch:
+        return True
+    wit = tx.witnesses[idx] if idx < len(tx.witnesses) else ()
+    return not tx.inputs[idx].script and len(wit) == 2
+
+
+def intra_block_amounts(txs) -> dict[tuple[bytes, int], int]:
+    """(txid, vout) -> satoshi amount for every output in ``txs`` — the
+    intra-block prevout map that lets BIP143 digests be computed for
+    in-block spends without a UTXO set (used by node block ingest and the
+    IBD benchmark so both resolve amounts identically)."""
+    outs: dict[tuple[bytes, int], int] = {}
+    for tx in txs:
+        for vout, o in enumerate(tx.outputs):
+            outs[(tx.txid, vout)] = o.value
+    return outs
 
 
 def _hash160(b: bytes) -> bytes:
