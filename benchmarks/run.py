@@ -215,15 +215,16 @@ def config4() -> None:
     n_txs = 40 if SMALL else 1024  # unique; tiled across peers
     duration = 3.0 if SMALL else 15.0
     batch = 128 if SMALL else 4096
+    # invalid_every must not share a phase with segwit_every (64 % 4 == 0
+    # would make EVERY corrupted tx segwit, losing legacy invalid coverage)
     txs = gen_signed_txs(
-        n_txs, inputs_per_tx=2, seed=0xF12E, invalid_every=64, segwit_every=4
+        n_txs, inputs_per_tx=2, seed=0xF12E, invalid_every=63, segwit_every=4
     )
     # The firehose streams single txs (no block context), so BIP143 amounts
     # come through the embedder hook — config4 exercises that channel.
-    prevouts = {}
-    for tx in txs:
-        for vout, o in enumerate(tx.outputs):
-            prevouts[(tx.txid, vout)] = o.value
+    from tpunode.txverify import intra_block_amounts as _iba
+
+    prevouts = _iba(txs)
 
     async def run() -> tuple[int, int, float]:
         from tests import fixtures

@@ -96,23 +96,15 @@ async def test_synced_min_age_reference_gate():
                 )
 
     # fresh tip (pretend "now" is just after the tip): never fires
-    chain2, pub2 = make_chain(synced_min_age=7200.0)
     fresh_now = HEADERS[-1].timestamp + 60  # tip is one minute old
-    orig_time = time.time
-    time_patch = lambda: fresh_now  # noqa: E731
+    chain2, pub2 = make_chain(synced_min_age=7200.0, now=lambda: fresh_now)
     async with pub2.subscription() as sub2:
         async with chain2:
-            import tpunode.chain as chain_mod
-
-            chain_mod.time.time = time_patch
-            try:
-                p = FakePeer()
-                chain2.peer_connected(p)
-                chain2.headers(p, HEADERS)
-                await asyncio.sleep(0.2)  # let the actor drain
-                assert not chain2.is_synced()
-            finally:
-                chain_mod.time.time = orig_time
+            p = FakePeer()
+            chain2.peer_connected(p)
+            chain2.headers(p, HEADERS)
+            await asyncio.sleep(0.2)  # let the actor drain
+            assert not chain2.is_synced()
 
 
 @pytest.mark.asyncio

@@ -18,7 +18,7 @@ import logging
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Optional, Union
+from typing import Callable, Optional, Union
 
 from .actors import LinkedTasks, Mailbox, Publisher
 from .headers import (
@@ -91,6 +91,9 @@ class ChainConfig:
     # Wire continuation threshold (reference hardcodes 2000, Chain.hs:513);
     # configurable so tests can exercise continuation with small fixtures.
     headers_batch: int = 2000
+    # Injectable wall clock (consensus timestamp checks + the synced_min_age
+    # gate); tests override instead of patching the stdlib time module.
+    now: Callable[[], float] = time.time
 
 
 class ChainDB:
@@ -248,7 +251,7 @@ class Chain:
         with span("chain.import_headers"):
             try:
                 nodes, best = connect_blocks(
-                    self.db, self.cfg.net, int(time.time()), headers
+                    self.db, self.cfg.net, int(self.cfg.now()), headers
                 )
             except BadHeaders as e:
                 log.warning(
@@ -320,7 +323,7 @@ class Chain:
             return
         best = self.db.get_best()
         if self.cfg.synced_min_age is not None:
-            if time.time() - best.header.timestamp <= self.cfg.synced_min_age:
+            if self.cfg.now() - best.header.timestamp <= self.cfg.synced_min_age:
                 return  # reference gate: tip not old enough yet
         self._been_in_sync = True
         log.info("[Chain] chain synced at height %d", best.height)

@@ -109,20 +109,31 @@ def _kernel(
     qy = qy_ref[:]
 
     # ---- per-signature Q table: [O, Q, 2Q, ..., 15Q] ----------------------
+    # fori_loop bodies (one pt_add / one mul) instead of unrolled chains:
+    # the straight-line table build dominated Mosaic compile time otherwise.
     q1 = jnp.stack([qx, qy, one], axis=0)
     qtab_ref[0] = inf
     qtab_ref[1] = q1
-    acc = q1
-    for k in range(2, 16):
-        acc = pt_add(acc, q1, F=PF)
-        qtab_ref[k] = acc
+
+    def build_step(k, acc):
+        nxt = pt_add(acc, q1, F=PF)
+        qtab_ref[pl.ds(k, 1)] = nxt[None]
+        return nxt
+
+    lax.fori_loop(2, 16, build_step, q1)
 
     # ---- λQ table: the endomorphism is additive, so scale each X by β ----
     beta = PF.const_col(_BETA_LIMBS, b)
-    for k in range(16):
-        e = qtab_ref[k]
+
+    def lam_step(k, carry):
+        e = qtab_ref[pl.ds(k, 1)][0]
         lx = PF.mul(e[0], beta)
-        lqtab_ref[k] = jnp.concatenate([lx[None], e[1:]], axis=0)
+        lqtab_ref[pl.ds(k, 1)] = jnp.concatenate([lx[None], e[1:]], axis=0)[
+            None
+        ]
+        return carry
+
+    lax.fori_loop(0, 16, lam_step, 0)
 
     g_tab = g_ref[:]
     lg_tab = lg_ref[:]
