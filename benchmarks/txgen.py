@@ -144,6 +144,17 @@ def gen_chain(
     parameter (net magic, block/tx counts, inputs_per_tx, seed) so changing
     any of them can never silently reuse a stale workload, and the load
     path re-verifies the block count byte-for-byte."""
+    if segwit_every:
+        # each segwit tx spends its immediate predecessor, so both must land
+        # in the same block for the intra-block amount map to resolve —
+        # otherwise BIP143 coverage silently drops to "unsupported"
+        for t in range(segwit_every - 1, n_blocks * txs_per_block, segwit_every):
+            if t % txs_per_block == 0:
+                raise ValueError(
+                    f"segwit tx {t} would start a block and spend across the "
+                    f"boundary: choose segwit_every/txs_per_block so no "
+                    f"segwit index is a multiple of txs_per_block"
+                )
     if cache is not None:
         key = (
             f"{net.magic:08x}-{n_blocks}x{txs_per_block}"
@@ -166,17 +177,6 @@ def gen_chain(
     target = bits_to_target(net.genesis.bits)
     prev = gen.header.hash
     t0 = net.genesis.timestamp
-    if segwit_every:
-        # each segwit tx spends its immediate predecessor, so both must land
-        # in the same block for the intra-block amount map to resolve —
-        # otherwise BIP143 coverage silently drops to "unsupported"
-        for t in range(segwit_every - 1, n_blocks * txs_per_block, segwit_every):
-            if t % txs_per_block == 0:
-                raise ValueError(
-                    f"segwit tx {t} would start a block and spend across the "
-                    f"boundary: choose segwit_every/txs_per_block so no "
-                    f"segwit index is a multiple of txs_per_block"
-                )
     all_txs = gen_signed_txs(
         n_blocks * txs_per_block,
         inputs_per_tx=inputs_per_tx,
