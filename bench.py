@@ -93,12 +93,12 @@ def _worker() -> None:
         progress(f"host prep done, compiling {kernel_name} at batch {BATCH}...")
         t0 = time.perf_counter()
         out = device_fn(*args)  # compile + first run
-        # ONE bulk transfer: iterating the device array would issue one
-        # tunnel round-trip PER ELEMENT (minutes at batch 32k — this very
-        # line, not compile time, was what blew the r01/r02 watchdogs).
-        import numpy as np
+        # ONE bulk transfer (collect_verdicts): iterating the device array
+        # would issue one tunnel round-trip PER ELEMENT — minutes at batch
+        # 32k; that, not compile time, was what blew the r01/r02 watchdogs.
+        from tpunode.verify.kernel import collect_verdicts
 
-        got = [bool(b) for b in np.asarray(out)[: len(base)]]
+        got = collect_verdicts(out, len(base))
         compile_s = time.perf_counter() - t0
         progress(f"compiled+ran in {compile_s:.1f}s, checking oracle...")
         # Expectation via the C++ engine (itself pinned against the Python

@@ -75,23 +75,31 @@ def config2() -> None:
     (BASELINE.md config 2; the repo-root bench.py is this config's
     single-batch steady-state variant)."""
     from tpunode.verify.ecdsa_cpu import verify_batch_cpu
-    from tpunode.verify.kernel import verify_batch_tpu
+    from tpunode.verify.kernel import (
+        collect_verdicts,
+        dispatch_batch_tpu,
+        verify_batch_tpu,
+    )
 
     total = 640 if SMALL else 10_240
     batch = 128 if SMALL else 4096
     uniq = _make_triples(min(total, 512))
     items = _tile(uniq, total)
-    # correctness first: one chunk vs oracle
+    # correctness first: one chunk vs oracle (also compiles outside timing)
     assert verify_batch_tpu(items[:64], pad_to=batch) == verify_batch_cpu(
         items[:64]
     )
-    # steady state: time chunked dispatch
+    # steady state: pipelined dispatch — chunk N+1 host-preps while chunk N
+    # runs on the device (the engine's production pattern)
     t0 = time.perf_counter()
     n = 0
+    pending = []
     for off in range(0, total, batch):
         chunk = items[off : off + batch]
-        verify_batch_tpu(chunk, pad_to=batch)
+        pending.append(dispatch_batch_tpu(chunk, pad_to=batch))
         n += len(chunk)
+    for p in pending:
+        collect_verdicts(*p)
     dt = time.perf_counter() - t0
 
     cpu_rate, cpu_engine, _ = cpu_single_core_bench(uniq[:256])
@@ -127,7 +135,11 @@ def config3() -> None:
     from benchmarks.txgen import gen_chain
 
     n_blocks = 50 if SMALL else 1000
-    txs_per_block = 2 if SMALL else 8  # 8 txs x 2 sigs = 16 sigs/block
+    # denser than the old 8 txs/block so signature volume is meaningful;
+    # on a 1-core host the end-to-end rate is bounded by Python ingest
+    # (parse/extract/sighash), so the emitted line also reports the verify
+    # engine's own throughput within the replay
+    txs_per_block = 2 if SMALL else 64
     batch = 128 if SMALL else 4096
     blocks = gen_chain(
         BCH_REGTEST,
@@ -177,7 +189,11 @@ def config3() -> None:
             assert verify_batch_cpu(sample_items) == [True] * len(sample_items)
             return sigs, dt, store.get_best().height
 
+    from tpunode.metrics import metrics as _metrics
+
+    v0 = _metrics.get("verify.seconds") or 0.0
     sigs, dt, height = asyncio.run(replay())
+    verify_s = (_metrics.get("verify.seconds") or 0.0) - v0
     _emit(
         {
             "metric": "config3_ibd_replay",
@@ -188,6 +204,12 @@ def config3() -> None:
             "height": height,
             "sigs": sigs,
             "sigs_per_sec": round(sigs / dt, 1),
+            "verify_engine_sigs_per_sec": (
+                round(sigs / verify_s, 1) if verify_s else None
+            ),
+            "note": "end-to-end wall incl. header consensus + pure-Python "
+                    "tx parse/extract/sighash on a 1-core host; the engine "
+                    "rate is the verify path alone",
             "device": _device_kind(),
         }
     )
@@ -331,10 +353,16 @@ def config5() -> None:
     assert verify_batch_sharded(items[: 4 * n_dev], mesh=mesh) == verify_batch_cpu(
         items[: 4 * n_dev]
     )
+    expected = _tile([bool(b) for b in verify_batch_cpu(uniq)], total)
+    # warm (compile) outside the timed window, then time steady state: the
+    # 32MB-block config measures sustained verify throughput, not XLA
+    t0 = time.perf_counter()
+    out = verify_batch_sharded(items, mesh=mesh)
+    compile_s = time.perf_counter() - t0
+    assert out == expected
     t0 = time.perf_counter()
     out = verify_batch_sharded(items, mesh=mesh)
     dt = time.perf_counter() - t0
-    expected = _tile([bool(b) for b in verify_batch_cpu(uniq)], total)
     assert out == expected
     _emit(
         {
@@ -346,6 +374,7 @@ def config5() -> None:
             "device": _device_kind(),
             "sigs": total,
             "wall_s": round(dt, 3),
+            "first_call_s": round(compile_s, 3),
         }
     )
 

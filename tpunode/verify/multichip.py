@@ -53,15 +53,28 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
 _FN_CACHE: dict = {}
 
 
-def sharded_verify_fn(mesh: Mesh):
+def _mesh_is_tpu(mesh: Mesh) -> bool:
+    return all(d.platform == "tpu" for d in mesh.devices.flat)
+
+
+def sharded_verify_fn(mesh: Mesh, kernel: str = "auto"):
     """Jitted verify step sharded over ``mesh``: same signature as
     :func:`kernel.verify_core`, returns ``(ok: (B,) bool, total: int32)``.
+
+    ``kernel``: "auto" picks the Pallas program per shard on an all-TPU
+    mesh (per-shard batch must then be BLOCK-aligned — callers pad), the
+    portable XLA program otherwise; "xla" forces the latter (the CPU-mesh
+    dryrun path).  Pallas composes with shard_map: each device runs its own
+    Mosaic grid over its shard, collectives stay outside the kernel.
 
     ``B`` must be a multiple of the mesh size (callers pad; static shapes
     also keep XLA from recompiling across batches).  Cached per mesh so
     repeated batches reuse the compiled executable.
     """
-    cached = _FN_CACHE.get(mesh)
+    if kernel not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown kernel {kernel!r}: auto|pallas|xla")
+    use_pallas = kernel == "pallas" or (kernel == "auto" and _mesh_is_tpu(mesh))
+    cached = _FN_CACHE.get((mesh, use_pallas))
     if cached is not None:
         return cached
     # limb-major layout: batch is the trailing axis of the 2-D arrays
@@ -69,8 +82,13 @@ def sharded_verify_fn(mesh: Mesh):
     spec_1d = P("batch")
     in_specs = tuple(spec_2d if is2d else spec_1d for is2d in ARG_IS_2D)
 
+    if use_pallas:
+        from .pallas_kernel import verify_blocked_impl as _core
+    else:
+        _core = verify_core
+
     def step(*args):
-        ok = verify_core(*args)
+        ok = _core(*args)
         total = lax.psum(jnp.sum(ok.astype(jnp.int32)), "batch")
         return ok, total
 
@@ -94,7 +112,7 @@ def sharded_verify_fn(mesh: Mesh):
             check_rep=False,
         )
     fn = jax.jit(sharded)
-    _FN_CACHE[mesh] = fn
+    _FN_CACHE[(mesh, use_pallas)] = fn
     return fn
 
 
@@ -112,9 +130,17 @@ def verify_batch_sharded(
         return []
     mesh = mesh or make_mesh()
     n = mesh.devices.size
+    # Pallas shards need BLOCK-aligned per-shard batches; XLA just needs a
+    # multiple of the mesh size.
+    if _mesh_is_tpu(mesh):
+        from .pallas_kernel import BLOCK
+
+        quantum = n * BLOCK
+    else:
+        quantum = n
     size = pad_to or len(items)
     size = max(size, len(items))
-    size = (size + n - 1) // n * n
+    size = (size + quantum - 1) // quantum * quantum
     prep = prepare_batch(items, pad_to=size)
 
     fn = sharded_verify_fn(mesh)

@@ -39,7 +39,7 @@ from . import pallas_field as PF
 from .curve import pt_add, pt_double
 from .kernel import BETA, G_TABLE, LG_TABLE, WINDOWS
 
-__all__ = ["verify_blocked", "BLOCK"]
+__all__ = ["verify_blocked", "verify_blocked_impl", "BLOCK"]
 
 BLOCK = 256  # lanes per grid step: 2 tables x 1.2 MB VMEM + headroom
 
@@ -172,8 +172,7 @@ def _kernel(
     out_ref[:] = valid.astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("interpret", "block"))
-def verify_blocked(
+def verify_blocked_impl(
     d1a,
     d1b,
     d2a,
@@ -192,11 +191,8 @@ def verify_blocked(
     interpret: bool = False,
     block: int = BLOCK,
 ) -> jnp.ndarray:
-    """Drop-in replacement for :func:`kernel.verify_core` (same argument
-    order — PreparedBatch.device_args) running the Pallas kernel over
-    lane blocks of ``block`` (default BLOCK; tests use small blocks in
-    interpret mode).  Batch size must be a multiple of the block size
-    (prepare_batch pads to the engine's fixed shape)."""
+    """Un-jitted kernel body — reused inside shard_map by multichip.py
+    (a jitted callee cannot be shard_mapped).  See :func:`verify_blocked`."""
     BLOCK = block
     bsz = qx.shape[-1]
     if bsz % BLOCK != 0:
@@ -255,3 +251,13 @@ def verify_blocked(
         flags,
     )
     return out[0].astype(jnp.bool_)
+
+
+@partial(jax.jit, static_argnames=("interpret", "block"))
+def verify_blocked(*args, interpret: bool = False, block: int = BLOCK):
+    """Drop-in replacement for :func:`kernel.verify_core` (same argument
+    order — PreparedBatch.device_args) running the Pallas kernel over
+    lane blocks of ``block`` (default BLOCK; tests use small blocks in
+    interpret mode).  Batch size must be a multiple of the block size
+    (prepare_batch pads to the engine's fixed shape)."""
+    return verify_blocked_impl(*args, interpret=interpret, block=block)
