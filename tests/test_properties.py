@@ -1,0 +1,236 @@
+"""Seeded randomized property tests.
+
+The reference has exactly one property test — random IPv4/IPv6 ``SockAddr``s
+round-tripping through ``toSockAddr . show`` (NodeSpec.hs:153-160, QuickCheck).
+This file mirrors it and adds the consensus-math properties SURVEY.md §4 calls
+for (difficulty retargeting, compact-bits encoding) that the reference
+outsources to haskoin-core.  No hypothesis in the image, so: ``random.Random``
+with fixed seeds — failures are reproducible by construction.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import random
+
+import pytest
+
+from tpunode.headers import (
+    BlockNode,
+    MemoryHeaderStore,
+    _asert_bits,
+    _clamped_retarget,
+    genesis_node,
+    next_work_required,
+)
+from tpunode.params import BCH, BTC, BTC_REGTEST, BTC_TEST
+from tpunode.peermgr import to_host_service, to_sock_addr
+from tpunode.util import bits_to_target, target_to_bits
+from tpunode.wire import BlockHeader
+
+# --- sockaddr round-trip (the reference's QuickCheck property) --------------
+
+
+def _random_ipv4(rng: random.Random) -> str:
+    return str(ipaddress.IPv4Address(rng.getrandbits(32)))
+
+
+def _random_ipv6(rng: random.Random) -> str:
+    # Mix fully random with structured ones (zero runs) so the compressed
+    # "::"-form printer is exercised, like QuickCheck's Arbitrary SockAddr.
+    if rng.random() < 0.5:
+        bits = rng.getrandbits(128)
+    else:
+        groups = [rng.getrandbits(16) if rng.random() < 0.5 else 0 for _ in range(8)]
+        bits = 0
+        for g in groups:
+            bits = (bits << 16) | g
+    return str(ipaddress.IPv6Address(bits))
+
+
+@pytest.mark.asyncio
+async def test_random_sockaddrs_roundtrip_through_format_and_parse():
+    """format(addr) -> to_sock_addr -> the same (host, port), 200 random
+    IPv4/IPv6 addresses (mirror of NodeSpec.hs:153-160)."""
+    rng = random.Random(0xADD12E55)
+    for _ in range(200):
+        port = rng.randrange(1, 65536)
+        if rng.random() < 0.5:
+            host = _random_ipv4(rng)
+            shown = f"{host}:{port}"
+        else:
+            host = _random_ipv6(rng)
+            shown = f"[{host}]:{port}"
+        addrs = await to_sock_addr(BTC, shown)
+        assert addrs, f"no resolution for {shown!r}"
+        got_hosts = {ipaddress.ip_address(h) for h, p in addrs}
+        got_ports = {p for _, p in addrs}
+        assert ipaddress.ip_address(host) in got_hosts, shown
+        assert got_ports == {port}, shown
+
+
+def test_random_host_service_splits():
+    """to_host_service(host ":" port) == (host, port) for random hosts of
+    every shape the grammar admits (table test's randomized big sibling)."""
+    rng = random.Random(0x5E12F1CE)
+    alphabet = "abcdefghijklmnopqrstuvwxyz0123456789-."
+    for _ in range(300):
+        port = str(rng.randrange(1, 65536))
+        kind = rng.randrange(3)
+        if kind == 0:  # hostname / IPv4
+            host = "".join(rng.choice(alphabet) for _ in range(rng.randrange(1, 20)))
+            assert to_host_service(f"{host}:{port}") == (host, port)
+            assert to_host_service(host) == (host, None)
+        elif kind == 1:  # bracketed IPv6
+            host = _random_ipv6(rng)
+            assert to_host_service(f"[{host}]:{port}") == (host, port)
+            assert to_host_service(f"[{host}]") == (host, None)
+        else:  # bare IPv6 literal (no port possible)
+            host = _random_ipv6(rng)
+            if host.count(":") > 1:
+                assert to_host_service(host) == (host, None)
+
+
+# --- compact difficulty bits ------------------------------------------------
+
+
+def test_compact_bits_roundtrip_random_targets():
+    """target -> bits -> target is exact up to the 24-bit mantissa (the
+    re-encoded target equals the mantissa-truncated original), and
+    bits -> target -> bits is the identity on canonical encodings."""
+    rng = random.Random(0xB175)
+    for _ in range(500):
+        target = rng.getrandbits(rng.randrange(1, 256)) | 1
+        bits = target_to_bits(target)
+        back = bits_to_target(bits)
+        assert back <= target
+        # the normalized mantissa keeps 16-23 significant bits (one whole
+        # byte is dropped when keeping it would set the sign bit), so the
+        # truncation error is below one byte-granular ulp
+        assert target - back < (1 << max(0, target.bit_length() - 15))
+        assert target_to_bits(back) == bits  # stable fixed point
+
+
+def test_compact_bits_monotone_on_random_pairs():
+    """For random target pairs, encode order never inverts decode order
+    (difficulty comparisons via compact bits are order-safe)."""
+    rng = random.Random(0x0DE12)
+    for _ in range(300):
+        a = rng.getrandbits(rng.randrange(8, 256)) | 1
+        b = rng.getrandbits(rng.randrange(8, 256)) | 1
+        ta, tb = bits_to_target(target_to_bits(a)), bits_to_target(target_to_bits(b))
+        if a <= b:
+            assert ta <= tb
+        else:
+            assert ta >= tb
+
+
+# --- retarget properties ----------------------------------------------------
+
+
+def _node(bits: int, timestamp: int, height: int, prev: bytes = b"\x00" * 32) -> BlockNode:
+    return BlockNode(
+        header=BlockHeader(1, prev, b"\x00" * 32, timestamp, bits, 0),
+        height=height,
+        work=0,
+    )
+
+
+def test_clamped_retarget_random_timespans_respect_4x_clamp():
+    """For arbitrary (even hostile) timestamps the next target stays within
+    [old/4, old*4] and under the pow limit — the consensus 4x clamp."""
+    rng = random.Random(0xC1A4)
+    interval = BTC.retarget_interval
+    for _ in range(300):
+        old_bits = target_to_bits(rng.getrandbits(rng.randrange(200, 225)) | (1 << 199))
+        old_target = bits_to_target(old_bits)
+        t_first = rng.randrange(1, 2**31)
+        # timespan from negative (clock attack) to 100x the schedule
+        t_parent = t_first + rng.randrange(-BTC.pow_target_timespan, BTC.pow_target_timespan * 100)
+        first = _node(old_bits, t_first, interval * 5)
+        parent = _node(old_bits, t_parent, interval * 6 - 1)
+        new_target = bits_to_target(_clamped_retarget(BTC, parent, first))
+        assert new_target <= BTC.pow_limit
+        # compact encoding truncates: compare with one-mantissa-ulp slack
+        ulp = 1 << max(0, new_target.bit_length() - 23)
+        assert new_target <= old_target * 4 + ulp
+        if old_target // 4 <= BTC.pow_limit:
+            assert new_target + ulp >= old_target // 4
+        # monotone in timespan: slower chain => easier (larger) target
+        new2 = bits_to_target(
+            _clamped_retarget(BTC, _node(old_bits, t_parent + 3600, parent.height), first)
+        )
+        assert new2 + ulp >= new_target
+
+
+def test_off_boundary_blocks_keep_parent_bits_mainnet():
+    """On BTC mainnet any non-boundary height must inherit the parent's bits
+    exactly, for random heights/timestamps (no min-difficulty rule there)."""
+    rng = random.Random(0x0FFB)
+    store = MemoryHeaderStore(BTC)
+    for _ in range(200):
+        h = rng.randrange(1, 10**7)
+        if h % BTC.retarget_interval == 0:
+            h += 1
+        bits = target_to_bits(rng.getrandbits(220) | (1 << 219))
+        parent = _node(bits, rng.randrange(1, 2**31), h - 1)
+        hdr = BlockHeader(1, parent.hash, b"\x00" * 32, rng.randrange(1, 2**31), bits, 0)
+        assert next_work_required(store, BTC, parent, hdr) == bits
+
+
+def test_testnet_min_difficulty_gate_random():
+    """testnet3: a block >20min after its parent may claim pow-limit bits;
+    one at/below 20min must not (random timestamps both sides of the line)."""
+    rng = random.Random(0x7E57)
+    store = MemoryHeaderStore(BTC_TEST)
+    g = genesis_node(BTC_TEST)
+    store.add_headers([g])
+    real_bits = 0x1C0FFFFF
+    for _ in range(200):
+        h = rng.randrange(2, 10**6)
+        if h % BTC_TEST.retarget_interval == 0:
+            h += 1
+        t0 = rng.randrange(1, 2**30)
+        parent = _node(real_bits, t0, h - 1)
+        gap = rng.randrange(0, 4 * BTC_TEST.pow_target_spacing)
+        hdr = BlockHeader(1, parent.hash, b"\x00" * 32, t0 + gap, 0, 0)
+        want_min = gap > 2 * BTC_TEST.pow_target_spacing
+        got = next_work_required(store, BTC_TEST, parent, hdr)
+        if want_min:
+            assert got == BTC_TEST.pow_limit_bits
+        else:
+            assert got == real_bits
+
+
+def test_regtest_never_retargets_random():
+    rng = random.Random(0x12E6)
+    store = MemoryHeaderStore(BTC_REGTEST)
+    for _ in range(100):
+        bits = BTC_REGTEST.pow_limit_bits
+        h = rng.randrange(1, 10**6)
+        parent = _node(bits, rng.randrange(1, 2**31), h - 1)
+        hdr = BlockHeader(1, parent.hash, b"\x00" * 32, rng.randrange(1, 2**31), bits, 0)
+        assert next_work_required(store, BTC_REGTEST, parent, hdr) == bits
+
+
+def test_asert_monotone_in_parent_time():
+    """aserti3-2d: target is nondecreasing in parent timestamp (slower chain
+    can only get easier), across random anchor offsets."""
+    anchor_h, anchor_bits, anchor_time = BCH.asert_anchor
+    rng = random.Random(0xA5E27)
+    for _ in range(200):
+        height = anchor_h + rng.randrange(1, 100_000)
+        base = anchor_time + rng.randrange(0, 3 * 10**7)
+        hdr = BlockHeader(1, b"\x00" * 32, b"\x00" * 32, 0, 0, 0)
+        t1 = bits_to_target(_asert_bits(BCH, _node(anchor_bits, base, height - 1), hdr))
+        dt = rng.randrange(1, 10**6)
+        t2 = bits_to_target(_asert_bits(BCH, _node(anchor_bits, base + dt, height - 1), hdr))
+        assert t2 >= t1
+        # and exactly one halflife of extra delay doubles the target (up to
+        # the pow-limit clamp and one mantissa ulp of compact truncation)
+        t3 = bits_to_target(
+            _asert_bits(BCH, _node(anchor_bits, base + 2 * 24 * 3600, height - 1), hdr)
+        )
+        if t3 < BCH.pow_limit and t1 > (1 << 40):  # away from both clamps
+            ulp = 1 << max(0, t3.bit_length() - 15)
+            assert abs(t3 - 2 * t1) <= ulp
