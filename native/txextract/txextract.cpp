@@ -1,0 +1,1039 @@
+// Native transaction signature-item extractor.
+//
+// The host-side producer of the verify pipeline: takes a raw serialized
+// transaction region (a block's tx area or concatenated loose txs) and emits,
+// per verifiable input, exactly the 32-byte big-endian buffers the rest of
+// the native path consumes (secp_prepare_batch / secp_verify_batch in
+// native/secp256k1/secp256k1.cpp):
+//
+//     z (sighash mod n) | px | py (decompressed pubkey) | r | s | present
+//
+// plus per-item (tx_index, input_index) and per-tx (txid, stats) metadata.
+//
+// Semantics are a bit-exact mirror of the Python reference path
+// (tpunode/txverify.py + tpunode/sighash.py + ecdsa_cpu.decode_pubkey /
+// parse_der_signature) — the parity test suite checks item-for-item
+// equality on randomized workloads.  The reference node outsources all of
+// this to haskoin-core/libsecp256k1 (SURVEY.md C6/C9); this is the
+// TPU-framework's native equivalent of that hot path.
+//
+// Build: make -C native build/libtxextract.so
+// Python binding: tpunode/txextract.py (ctypes).
+
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS 180-4), streaming.
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+  uint32_t h[8];
+  uint8_t buf[64];
+  uint64_t len = 0;
+
+  Sha256() { reset(); }
+
+  void reset() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    memcpy(h, init, sizeof(h));
+    len = 0;
+  }
+
+  static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void block(const uint8_t *p) {
+    static const uint32_t K[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+        0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+        0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+        0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+        0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+        0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+        0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+        0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+        0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[i * 4]) << 24) | (uint32_t(p[i * 4 + 1]) << 16) |
+             (uint32_t(p[i * 4 + 2]) << 8) | p[i * 4 + 3];
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t *p, size_t n) {
+    size_t fill = len % 64;
+    len += n;
+    if (fill) {
+      size_t take = 64 - fill;
+      if (take > n) take = n;
+      memcpy(buf + fill, p, take);
+      p += take;
+      n -= take;
+      if (fill + take == 64) block(buf);
+      else return;
+    }
+    while (n >= 64) {
+      block(p);
+      p += 64;
+      n -= 64;
+    }
+    if (n) memcpy(buf, p, n);
+  }
+
+  void final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (len % 64 != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; ++i) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    update(lenb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[i * 4] = uint8_t(h[i] >> 24);
+      out[i * 4 + 1] = uint8_t(h[i] >> 16);
+      out[i * 4 + 2] = uint8_t(h[i] >> 8);
+      out[i * 4 + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void sha256(const uint8_t *p, size_t n, uint8_t out[32]) {
+  Sha256 c;
+  c.update(p, n);
+  c.final(out);
+}
+
+void dsha256(const uint8_t *p, size_t n, uint8_t out[32]) {
+  uint8_t t[32];
+  sha256(p, n, t);
+  sha256(t, 32, out);
+}
+
+// ---------------------------------------------------------------------------
+// RIPEMD-160 (for hash160 of the pubkey -> P2PKH script code).
+// ---------------------------------------------------------------------------
+
+struct Ripemd160 {
+  static uint32_t rol(uint32_t x, int n) { return (x << n) | (x >> (32 - n)); }
+  static uint32_t f(int j, uint32_t x, uint32_t y, uint32_t z) {
+    if (j < 16) return x ^ y ^ z;
+    if (j < 32) return (x & y) | (~x & z);
+    if (j < 48) return (x | ~y) ^ z;
+    if (j < 64) return (x & z) | (y & ~z);
+    return x ^ (y | ~z);
+  }
+
+  static void hash(const uint8_t *msg, size_t n, uint8_t out[20]) {
+    static const int r1[80] = {
+        0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+        7, 4, 13, 1, 10, 6, 15, 3, 12, 0, 9, 5, 2, 14, 11, 8,
+        3, 10, 14, 4, 9, 15, 8, 1, 2, 7, 0, 6, 13, 11, 5, 12,
+        1, 9, 11, 10, 0, 8, 12, 4, 13, 3, 7, 15, 14, 5, 6, 2,
+        4, 0, 5, 9, 7, 12, 2, 10, 14, 1, 3, 8, 11, 6, 15, 13};
+    static const int r2[80] = {
+        5, 14, 7, 0, 9, 2, 11, 4, 13, 6, 15, 8, 1, 10, 3, 12,
+        6, 11, 3, 7, 0, 13, 5, 10, 14, 15, 8, 12, 4, 9, 1, 2,
+        15, 5, 1, 3, 7, 14, 6, 9, 11, 8, 12, 2, 10, 0, 4, 13,
+        8, 6, 4, 1, 3, 11, 15, 0, 5, 12, 2, 13, 9, 7, 10, 14,
+        12, 15, 10, 4, 1, 5, 8, 7, 6, 2, 13, 14, 0, 3, 9, 11};
+    static const int s1[80] = {
+        11, 14, 15, 12, 5, 8, 7, 9, 11, 13, 14, 15, 6, 7, 9, 8,
+        7, 6, 8, 13, 11, 9, 7, 15, 7, 12, 15, 9, 11, 7, 13, 12,
+        11, 13, 6, 7, 14, 9, 13, 15, 14, 8, 13, 6, 5, 12, 7, 5,
+        11, 12, 14, 15, 14, 15, 9, 8, 9, 14, 5, 6, 8, 6, 5, 12,
+        9, 15, 5, 11, 6, 8, 13, 12, 5, 12, 13, 14, 11, 8, 5, 6};
+    static const int s2[80] = {
+        8, 9, 9, 11, 13, 15, 15, 5, 7, 7, 8, 11, 14, 14, 12, 6,
+        9, 13, 15, 7, 12, 8, 9, 11, 7, 7, 12, 7, 6, 15, 13, 11,
+        9, 7, 15, 11, 8, 6, 6, 14, 12, 13, 5, 14, 13, 13, 7, 5,
+        15, 5, 8, 11, 14, 14, 6, 14, 6, 9, 12, 9, 12, 5, 15, 8,
+        8, 5, 12, 9, 12, 5, 14, 6, 8, 13, 6, 5, 15, 13, 11, 11};
+    static const uint32_t K1[5] = {0, 0x5a827999, 0x6ed9eba1, 0x8f1bbcdc,
+                                   0xa953fd4e};
+    static const uint32_t K2[5] = {0x50a28be6, 0x5c4dd124, 0x6d703ef3,
+                                   0x7a6d76e9, 0};
+    uint32_t h[5] = {0x67452301, 0xefcdab89, 0x98badcfe, 0x10325476,
+                     0xc3d2e1f0};
+    // pad
+    std::vector<uint8_t> m(msg, msg + n);
+    m.push_back(0x80);
+    while (m.size() % 64 != 56) m.push_back(0);
+    uint64_t bits = uint64_t(n) * 8;
+    for (int i = 0; i < 8; ++i) m.push_back(uint8_t(bits >> (8 * i)));
+    for (size_t off = 0; off < m.size(); off += 64) {
+      uint32_t x[16];
+      for (int i = 0; i < 16; ++i)
+        x[i] = uint32_t(m[off + i * 4]) | (uint32_t(m[off + i * 4 + 1]) << 8) |
+               (uint32_t(m[off + i * 4 + 2]) << 16) |
+               (uint32_t(m[off + i * 4 + 3]) << 24);
+      uint32_t a1 = h[0], b1 = h[1], c1 = h[2], d1 = h[3], e1 = h[4];
+      uint32_t a2 = a1, b2 = b1, c2 = c1, d2 = d1, e2 = e1;
+      for (int j = 0; j < 80; ++j) {
+        uint32_t t = rol(a1 + f(j, b1, c1, d1) + x[r1[j]] + K1[j / 16], s1[j]) + e1;
+        a1 = e1; e1 = d1; d1 = rol(c1, 10); c1 = b1; b1 = t;
+        t = rol(a2 + f(79 - j, b2, c2, d2) + x[r2[j]] + K2[j / 16], s2[j]) + e2;
+        a2 = e2; e2 = d2; d2 = rol(c2, 10); c2 = b2; b2 = t;
+      }
+      uint32_t t = h[1] + c1 + d2;
+      h[1] = h[2] + d1 + e2;
+      h[2] = h[3] + e1 + a2;
+      h[3] = h[4] + a1 + b2;
+      h[4] = h[0] + b1 + c2;
+      h[0] = t;
+    }
+    for (int i = 0; i < 5; ++i) {
+      out[i * 4] = uint8_t(h[i]);
+      out[i * 4 + 1] = uint8_t(h[i] >> 8);
+      out[i * 4 + 2] = uint8_t(h[i] >> 16);
+      out[i * 4 + 3] = uint8_t(h[i] >> 24);
+    }
+  }
+};
+
+void hash160(const uint8_t *p, size_t n, uint8_t out[20]) {
+  uint8_t s[32];
+  sha256(p, n, s);
+  Ripemd160::hash(s, 32, out);
+}
+
+// ---------------------------------------------------------------------------
+// secp256k1 base field (mod p) — only what pubkey decompression needs.
+// Independent of native/secp256k1/secp256k1.cpp (that unit verifies;
+// this one parses) so neither build depends on the other.
+// ---------------------------------------------------------------------------
+
+typedef unsigned __int128 u128;
+
+struct F4 {
+  uint64_t v[4];  // little-endian limbs
+};
+
+const uint64_t P_LIMBS[4] = {0xFFFFFFFEFFFFFC2FULL, 0xFFFFFFFFFFFFFFFFULL,
+                             0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL};
+const uint64_t FOLD_K = 0x1000003D1ULL;  // 2^256 mod p
+
+bool f_ge_p(const F4 &a) {
+  for (int i = 3; i >= 0; --i) {
+    if (a.v[i] > P_LIMBS[i]) return true;
+    if (a.v[i] < P_LIMBS[i]) return false;
+  }
+  return true;  // equal
+}
+
+void f_sub_p(F4 &a) {
+  u128 borrow = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 d = (u128)a.v[i] - P_LIMBS[i] - borrow;
+    a.v[i] = (uint64_t)d;
+    borrow = (d >> 64) & 1;
+  }
+}
+
+void f_normalize(F4 &a) {
+  while (f_ge_p(a)) f_sub_p(a);
+}
+
+void f_mul(F4 &out, const F4 &a, const F4 &b) {
+  uint64_t t[8] = {0};
+  for (int i = 0; i < 4; ++i) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; ++j) {
+      u128 cur = (u128)a.v[i] * b.v[j] + t[i + j] + carry;
+      t[i + j] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+    t[i + 4] += (uint64_t)carry;
+  }
+  // fold high 256 bits: r = lo + hi * FOLD_K
+  uint64_t r[5] = {0};
+  u128 carry = 0;
+  for (int i = 0; i < 4; ++i) {
+    u128 cur = (u128)t[i] + (u128)t[i + 4] * FOLD_K + carry;
+    r[i] = (uint64_t)cur;
+    carry = cur >> 64;
+  }
+  r[4] = (uint64_t)carry;
+  // fold the (small) carry limb once more
+  u128 cur = (u128)r[0] + (u128)r[4] * FOLD_K;
+  F4 res;
+  res.v[0] = (uint64_t)cur;
+  carry = cur >> 64;
+  for (int i = 1; i < 4; ++i) {
+    cur = (u128)r[i] + carry;
+    res.v[i] = (uint64_t)cur;
+    carry = cur >> 64;
+  }
+  if (carry) {  // wrapped past 2^256: add FOLD_K (== 2^256 mod p)
+    cur = (u128)res.v[0] + FOLD_K;
+    res.v[0] = (uint64_t)cur;
+    carry = cur >> 64;
+    for (int i = 1; carry && i < 4; ++i) {
+      cur = (u128)res.v[i] + carry;
+      res.v[i] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+  }
+  f_normalize(res);
+  out = res;
+}
+
+void f_sqr(F4 &out, const F4 &a) { f_mul(out, a, a); }
+
+void f_add(F4 &out, const F4 &a, const F4 &b) {
+  u128 carry = 0;
+  F4 res;
+  for (int i = 0; i < 4; ++i) {
+    u128 cur = (u128)a.v[i] + b.v[i] + carry;
+    res.v[i] = (uint64_t)cur;
+    carry = cur >> 64;
+  }
+  if (carry) {
+    u128 cur = (u128)res.v[0] + FOLD_K;
+    res.v[0] = (uint64_t)cur;
+    carry = cur >> 64;
+    for (int i = 1; carry && i < 4; ++i) {
+      cur = (u128)res.v[i] + carry;
+      res.v[i] = (uint64_t)cur;
+      carry = cur >> 64;
+    }
+  }
+  f_normalize(res);
+  out = res;
+}
+
+bool f_is_eq(const F4 &a, const F4 &b) {
+  return memcmp(a.v, b.v, sizeof(a.v)) == 0;
+}
+
+void f_from_be(F4 &out, const uint8_t b[32]) {
+  for (int i = 0; i < 4; ++i) {
+    uint64_t limb = 0;
+    for (int j = 0; j < 8; ++j) limb = (limb << 8) | b[(3 - i) * 8 + j];
+    out.v[i] = limb;
+  }
+}
+
+void f_to_be(const F4 &a, uint8_t out[32]) {
+  for (int i = 0; i < 4; ++i)
+    for (int j = 0; j < 8; ++j)
+      out[(3 - i) * 8 + j] = uint8_t(a.v[i] >> (56 - 8 * j));
+}
+
+// a^((p+1)/4) mod p: square root when a is a quadratic residue.
+// (p+1)/4 = 2^254 - 2^30 - 244, whose bits are long runs of ones:
+//   ((2^223-1) << 23 | (2^22-1)) << 6 | (2^2-1)) << 2
+// so an addition chain over x^(2^k - 1) blocks costs ~253 squarings +
+// 14 multiplies instead of ~500 ops for plain square-and-multiply —
+// this is the hot op of pubkey decompression (one per compressed key).
+void f_sqrt_candidate(F4 &out, const F4 &a) {
+  F4 x2, x3, x6, x9, x11, x22, x44, x88, x176, x220, x223, t;
+  auto sqn = [](F4 &r, const F4 &v, int n) {
+    r = v;
+    for (int i = 0; i < n; ++i) f_sqr(r, r);
+  };
+  f_sqr(x2, a);
+  f_mul(x2, x2, a);  // x^(2^2 - 1)
+  f_sqr(x3, x2);
+  f_mul(x3, x3, a);  // x^(2^3 - 1)
+  sqn(t, x3, 3);
+  f_mul(x6, t, x3);
+  sqn(t, x6, 3);
+  f_mul(x9, t, x3);
+  sqn(t, x9, 2);
+  f_mul(x11, t, x2);
+  sqn(t, x11, 11);
+  f_mul(x22, t, x11);
+  sqn(t, x22, 22);
+  f_mul(x44, t, x22);
+  sqn(t, x44, 44);
+  f_mul(x88, t, x44);
+  sqn(t, x88, 88);
+  f_mul(x176, t, x88);
+  sqn(t, x176, 44);
+  f_mul(x220, t, x44);
+  sqn(t, x220, 3);
+  f_mul(x223, t, x3);  // x^(2^223 - 1)
+  sqn(t, x223, 23);
+  f_mul(t, t, x22);
+  sqn(t, t, 6);
+  f_mul(t, t, x2);
+  sqn(t, t, 2);
+  out = t;
+}
+
+// Decode a SEC1 pubkey into affine (x, y).  Mirrors ecdsa_cpu.decode_pubkey:
+// returns false (present=0, auto-invalid) for malformed / off-curve keys.
+bool decode_pubkey(const uint8_t *data, size_t len, uint8_t px[32],
+                   uint8_t py[32]) {
+  static const F4 B7 = {{7, 0, 0, 0}};
+  if (len == 33 && (data[0] == 2 || data[0] == 3)) {
+    F4 x;
+    f_from_be(x, data + 1);
+    if (f_ge_p(x)) return false;
+    F4 y2, x2;
+    f_sqr(x2, x);
+    f_mul(y2, x2, x);
+    f_add(y2, y2, B7);
+    F4 y;
+    f_sqrt_candidate(y, y2);
+    F4 check;
+    f_sqr(check, y);
+    if (!f_is_eq(check, y2)) return false;  // non-residue: not on curve
+    if ((y.v[0] & 1) != (data[0] & 1)) {
+      // y = p - y
+      F4 neg = {{P_LIMBS[0], P_LIMBS[1], P_LIMBS[2], P_LIMBS[3]}};
+      u128 borrow = 0;
+      for (int i = 0; i < 4; ++i) {
+        u128 d = (u128)neg.v[i] - y.v[i] - borrow;
+        neg.v[i] = (uint64_t)d;
+        borrow = (d >> 64) & 1;
+      }
+      y = neg;
+    }
+    f_to_be(x, px);
+    f_to_be(y, py);
+    return true;
+  }
+  if (len == 65 && data[0] == 4) {
+    F4 x, y;
+    f_from_be(x, data + 1);
+    f_from_be(y, data + 33);
+    if (f_ge_p(x) || f_ge_p(y)) return false;
+    // on-curve check: y^2 == x^3 + 7.  (0,0) fails: 0 != 7 — matching the
+    // oracle, which treats the infinity encoding as not-on-curve.
+    F4 lhs, x2, rhs;
+    f_sqr(lhs, y);
+    f_sqr(x2, x);
+    f_mul(rhs, x2, x);
+    f_add(rhs, rhs, B7);
+    if (!f_is_eq(lhs, rhs)) return false;
+    memcpy(px, data + 1, 32);
+    memcpy(py, data + 33, 32);
+    return true;
+  }
+  return false;
+}
+
+// Curve order n, big-endian — sighash digests are reduced mod n before
+// packing (parity with NativeVerifier.verify_batch's `z % CURVE_N`).
+const uint8_t N_BE[32] = {0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+                          0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFE,
+                          0xBA, 0xAE, 0xDC, 0xE6, 0xAF, 0x48, 0xA0, 0x3B,
+                          0xBF, 0xD2, 0x5E, 0x8C, 0xD0, 0x36, 0x41, 0x41};
+
+void reduce_mod_n(uint8_t z[32]) {
+  if (memcmp(z, N_BE, 32) < 0) return;  // z < n (z < 2^256 < 2n: one sub)
+  int borrow = 0;
+  for (int i = 31; i >= 0; --i) {
+    int d = int(z[i]) - int(N_BE[i]) - borrow;
+    borrow = d < 0;
+    z[i] = uint8_t(d & 0xFF);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Wire parsing (mirrors tpunode/wire.py Reader/Tx.deserialize).
+// ---------------------------------------------------------------------------
+
+struct Cursor {
+  const uint8_t *p;
+  const uint8_t *end;
+  bool ok = true;
+
+  size_t remaining() const { return size_t(end - p); }
+
+  bool need(size_t n) {
+    if (!ok || remaining() < n) {
+      ok = false;
+      return false;
+    }
+    return true;
+  }
+
+  uint32_t u32() {
+    if (!need(4)) return 0;
+    uint32_t v = uint32_t(p[0]) | (uint32_t(p[1]) << 8) |
+                 (uint32_t(p[2]) << 16) | (uint32_t(p[3]) << 24);
+    p += 4;
+    return v;
+  }
+
+  uint64_t u64() {
+    if (!need(8)) return 0;
+    uint64_t v = 0;
+    for (int i = 7; i >= 0; --i) v = (v << 8) | p[i];
+    p += 8;
+    return v;
+  }
+
+  uint64_t varint() {
+    if (!need(1)) return 0;
+    uint8_t first = *p++;
+    if (first < 0xFD) return first;
+    if (first == 0xFD) {
+      if (!need(2)) return 0;
+      uint64_t v = uint64_t(p[0]) | (uint64_t(p[1]) << 8);
+      p += 2;
+      return v;
+    }
+    if (first == 0xFE) return u32();
+    return u64();
+  }
+
+  const uint8_t *bytes(size_t n) {
+    if (!need(n)) return nullptr;
+    const uint8_t *r = p;
+    p += n;
+    return r;
+  }
+};
+
+struct InSpan {
+  const uint8_t *prevout;  // 36 bytes (txid + index)
+  const uint8_t *script;
+  uint32_t script_len;
+  uint32_t sequence;
+  // witness (segwit txs): item count; spans kept only for the 2-item shape
+  uint32_t wit_count = 0;
+  const uint8_t *w0 = nullptr, *w1 = nullptr;
+  uint32_t w0_len = 0, w1_len = 0;
+};
+
+struct OutSpan {
+  const uint8_t *start;  // value(8) + varstr(script): contiguous raw span
+  uint32_t len;
+  int64_t value;
+};
+
+struct TxSpan {
+  const uint8_t *version;        // 4 bytes
+  const uint8_t *inout_start;    // varint(n_in) .. outputs end (witness-free)
+  uint32_t inout_len;
+  const uint8_t *locktime;       // 4 bytes
+  const uint8_t *outputs_start;  // contiguous serialized outputs region
+  uint32_t outputs_len;
+  std::vector<InSpan> ins;
+  std::vector<OutSpan> outs;
+  uint8_t txid[32];
+  // lazy BIP143 per-tx caches (flag 1 = computed)
+  uint8_t hash_prevouts[32], hash_sequence[32], hash_outputs[32];
+  bool hp = false, hs = false, ho = false;
+};
+
+// Parse one tx at the cursor.  Returns false on malformed data.
+bool parse_tx(Cursor &c, TxSpan &tx, bool compute_txid) {
+  tx.version = c.bytes(4);
+  if (!c.ok) return false;
+  bool segwit = c.remaining() >= 2 && c.p[0] == 0x00 && c.p[1] == 0x01;
+  if (segwit) c.p += 2;
+  tx.inout_start = c.p;
+  uint64_t n_in = c.varint();
+  // Clamp by the minimum encoded size (36B prevout + 1B script len + 4B
+  // sequence) BEFORE allocating: a tiny malformed buffer claiming 2^24
+  // inputs must fail here, not after a GB-scale transient resize.
+  if (!c.ok || n_in > c.remaining() / 41) return false;
+  tx.ins.resize(n_in);
+  for (uint64_t i = 0; i < n_in; ++i) {
+    InSpan &in = tx.ins[i];
+    in.prevout = c.bytes(36);
+    uint64_t slen = c.varint();
+    if (!c.ok || slen > c.remaining()) return false;
+    in.script = c.bytes(slen);
+    in.script_len = uint32_t(slen);
+    in.sequence = c.u32();
+    if (!c.ok) return false;
+  }
+  uint64_t n_out = c.varint();
+  // Same pre-allocation clamp: an output is at least value(8) + varstr(1).
+  if (!c.ok || n_out > c.remaining() / 9) return false;
+  tx.outs.resize(n_out);
+  tx.outputs_start = c.p;
+  for (uint64_t i = 0; i < n_out; ++i) {
+    OutSpan &out = tx.outs[i];
+    out.start = c.p;
+    out.value = int64_t(c.u64());
+    uint64_t slen = c.varint();
+    if (!c.ok || slen > c.remaining()) return false;
+    c.bytes(slen);
+    out.len = uint32_t(c.p - out.start);
+    if (!c.ok) return false;
+  }
+  tx.outputs_len = uint32_t(c.p - tx.outputs_start);
+  tx.inout_len = uint32_t(c.p - tx.inout_start);
+  if (segwit) {
+    for (uint64_t i = 0; i < n_in; ++i) {
+      uint64_t cnt = c.varint();
+      if (!c.ok || cnt > (1u << 20)) return false;
+      InSpan &in = tx.ins[i];
+      in.wit_count = uint32_t(cnt);
+      for (uint64_t w = 0; w < cnt; ++w) {
+        uint64_t wlen = c.varint();
+        if (!c.ok || wlen > c.remaining()) return false;
+        const uint8_t *wp = c.bytes(wlen);
+        if (w == 0) { in.w0 = wp; in.w0_len = uint32_t(wlen); }
+        if (w == 1) { in.w1 = wp; in.w1_len = uint32_t(wlen); }
+      }
+    }
+  }
+  tx.locktime = c.bytes(4);
+  if (!c.ok) return false;
+  if (compute_txid) {
+    // txid = dsha256 of the witness-stripped serialization
+    Sha256 h1;
+    h1.update(tx.version, 4);
+    h1.update(tx.inout_start, tx.inout_len);
+    h1.update(tx.locktime, 4);
+    uint8_t t[32];
+    h1.final(t);
+    sha256(t, 32, tx.txid);
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// DER signature parsing (mirrors ecdsa_cpu.parse_der_signature's lax rules).
+// r/s land right-aligned in 32-byte big-endian buffers; values with more
+// than 32 significant bytes (> 2^256, possible under lax DER) come out as
+// zero — zero fails the 0 < r,s < n range check downstream exactly like the
+// oversized original would, with no aliasing.
+// ---------------------------------------------------------------------------
+
+bool parse_der(const uint8_t *sig, size_t len, uint8_t r[32], uint8_t s[32]) {
+  if (len < 8 || sig[0] != 0x30) return false;
+  if (sig[1] != len - 2) return false;
+  if (sig[2] != 0x02) return false;
+  size_t rlen = sig[3];
+  size_t pos = 4 + rlen;
+  if (pos + 1 >= len) return false;  // need the 0x02 and slen bytes
+  if (sig[pos] != 0x02) return false;
+  size_t slen = sig[pos + 1];
+  if (pos + 2 + slen != len) return false;
+  const uint8_t *rp = sig + 4;
+  const uint8_t *sp = sig + pos + 2;
+  // strip leading zeros; reject (as out-of-range zero) if > 32 bytes remain
+  while (rlen > 0 && *rp == 0) { ++rp; --rlen; }
+  while (slen > 0 && *sp == 0) { ++sp; --slen; }
+  memset(r, 0, 32);
+  memset(s, 0, 32);
+  if (rlen <= 32) memcpy(r + 32 - rlen, rp, rlen);
+  if (slen <= 32) memcpy(s + 32 - slen, sp, slen);
+  return true;
+}
+
+// Parse a pushes-only script (opcodes 1-75, PUSHDATA1/2) — mirror of
+// txverify._parse_pushes.  Fills at most `max_out` spans; returns the push
+// count or -1 if any non-push opcode appears.
+int parse_pushes(const uint8_t *script, size_t n,
+                 const uint8_t *out[4], size_t out_len[4], int max_out) {
+  int count = 0;
+  size_t i = 0;
+  while (i < n) {
+    uint8_t op = script[i++];
+    size_t ln;
+    if (op >= 1 && op <= 75) {
+      ln = op;
+    } else if (op == 76 && i < n) {
+      ln = script[i++];
+    } else if (op == 77 && i + 1 < n) {
+      ln = size_t(script[i]) | (size_t(script[i + 1]) << 8);
+      i += 2;
+    } else {
+      return -1;
+    }
+    if (i + ln > n) return -1;
+    if (count < max_out) {
+      out[count] = script + i;
+      out_len[count] = ln;
+    }
+    ++count;
+    i += ln;
+  }
+  return count;
+}
+
+// ---------------------------------------------------------------------------
+// Sighash computation (mirrors tpunode/sighash.py byte for byte).
+// ---------------------------------------------------------------------------
+
+const int SIGHASH_NONE = 2, SIGHASH_SINGLE = 3;
+const int SIGHASH_ANYONECANPAY = 0x80, SIGHASH_FORKID = 0x40;
+
+void put_varint(std::vector<uint8_t> &buf, uint64_t n) {
+  if (n < 0xFD) {
+    buf.push_back(uint8_t(n));
+  } else if (n <= 0xFFFF) {
+    buf.push_back(0xFD);
+    buf.push_back(uint8_t(n));
+    buf.push_back(uint8_t(n >> 8));
+  } else if (n <= 0xFFFFFFFFULL) {
+    buf.push_back(0xFE);
+    for (int i = 0; i < 4; ++i) buf.push_back(uint8_t(n >> (8 * i)));
+  } else {
+    buf.push_back(0xFF);
+    for (int i = 0; i < 8; ++i) buf.push_back(uint8_t(n >> (8 * i)));
+  }
+}
+
+void put_u32(std::vector<uint8_t> &buf, uint32_t v) {
+  for (int i = 0; i < 4; ++i) buf.push_back(uint8_t(v >> (8 * i)));
+}
+
+// Legacy (pre-segwit) digest -> out[32] big-endian (already the z bytes).
+void legacy_sighash(const TxSpan &tx, size_t index, const uint8_t *script_code,
+                    size_t sc_len, int hashtype, std::vector<uint8_t> &scratch,
+                    uint8_t out[32]) {
+  int base = hashtype & 0x1F;
+  if (base == SIGHASH_SINGLE && index >= tx.outs.size()) {
+    memset(out, 0, 32);
+    out[31] = 1;  // the historical "hash = 1" quirk
+    return;
+  }
+  scratch.clear();
+  std::vector<uint8_t> &buf = scratch;
+  buf.insert(buf.end(), tx.version, tx.version + 4);
+  if (hashtype & SIGHASH_ANYONECANPAY) {
+    put_varint(buf, 1);
+    const InSpan &in = tx.ins[index];
+    buf.insert(buf.end(), in.prevout, in.prevout + 36);
+    put_varint(buf, sc_len);
+    buf.insert(buf.end(), script_code, script_code + sc_len);
+    put_u32(buf, in.sequence);
+  } else {
+    put_varint(buf, tx.ins.size());
+    for (size_t i = 0; i < tx.ins.size(); ++i) {
+      const InSpan &in = tx.ins[i];
+      buf.insert(buf.end(), in.prevout, in.prevout + 36);
+      if (i == index) {
+        put_varint(buf, sc_len);
+        buf.insert(buf.end(), script_code, script_code + sc_len);
+      } else {
+        buf.push_back(0);
+      }
+      uint32_t seq = in.sequence;
+      if (i != index && (base == SIGHASH_NONE || base == SIGHASH_SINGLE))
+        seq = 0;
+      put_u32(buf, seq);
+    }
+  }
+  if (base == SIGHASH_NONE) {
+    put_varint(buf, 0);
+  } else if (base == SIGHASH_SINGLE) {
+    put_varint(buf, index + 1);
+    for (size_t i = 0; i < index; ++i) {
+      for (int k = 0; k < 8; ++k) buf.push_back(0xFF);  // value = -1
+      buf.push_back(0);                                 // empty script
+    }
+    const OutSpan &o = tx.outs[index];
+    buf.insert(buf.end(), o.start, o.start + o.len);
+  } else {
+    put_varint(buf, tx.outs.size());
+    buf.insert(buf.end(), tx.outputs_start, tx.outputs_start + tx.outputs_len);
+  }
+  buf.insert(buf.end(), tx.locktime, tx.locktime + 4);
+  put_u32(buf, uint32_t(hashtype));
+  dsha256(buf.data(), buf.size(), out);
+}
+
+// BIP143 (segwit v0 / BCH FORKID) digest -> out[32].
+void bip143_sighash(TxSpan &tx, size_t index, const uint8_t *script_code,
+                    size_t sc_len, int64_t amount, int hashtype,
+                    std::vector<uint8_t> &scratch, uint8_t out[32]) {
+  int base = hashtype & 0x1F;
+  bool acp = (hashtype & SIGHASH_ANYONECANPAY) != 0;
+  uint8_t zero32[32] = {0};
+  const uint8_t *hash_prevouts = zero32, *hash_sequence = zero32,
+                *hash_outputs = zero32;
+  uint8_t single_out[32];
+  if (!acp) {
+    if (!tx.hp) {
+      Sha256 h;
+      for (const InSpan &in : tx.ins) h.update(in.prevout, 36);
+      uint8_t t[32];
+      h.final(t);
+      sha256(t, 32, tx.hash_prevouts);
+      tx.hp = true;
+    }
+    hash_prevouts = tx.hash_prevouts;
+  }
+  if (!acp && base != SIGHASH_NONE && base != SIGHASH_SINGLE) {
+    if (!tx.hs) {
+      Sha256 h;
+      for (const InSpan &in : tx.ins) {
+        uint8_t seq[4] = {uint8_t(in.sequence), uint8_t(in.sequence >> 8),
+                          uint8_t(in.sequence >> 16),
+                          uint8_t(in.sequence >> 24)};
+        h.update(seq, 4);
+      }
+      uint8_t t[32];
+      h.final(t);
+      sha256(t, 32, tx.hash_sequence);
+      tx.hs = true;
+    }
+    hash_sequence = tx.hash_sequence;
+  }
+  if (base != SIGHASH_NONE && base != SIGHASH_SINGLE) {
+    if (!tx.ho) {
+      dsha256(tx.outputs_start, tx.outputs_len, tx.hash_outputs);
+      tx.ho = true;
+    }
+    hash_outputs = tx.hash_outputs;
+  } else if (base == SIGHASH_SINGLE && index < tx.outs.size()) {
+    dsha256(tx.outs[index].start, tx.outs[index].len, single_out);
+    hash_outputs = single_out;
+  }
+  scratch.clear();
+  std::vector<uint8_t> &buf = scratch;
+  const InSpan &in = tx.ins[index];
+  buf.insert(buf.end(), tx.version, tx.version + 4);
+  buf.insert(buf.end(), hash_prevouts, hash_prevouts + 32);
+  buf.insert(buf.end(), hash_sequence, hash_sequence + 32);
+  buf.insert(buf.end(), in.prevout, in.prevout + 36);
+  put_varint(buf, sc_len);
+  buf.insert(buf.end(), script_code, script_code + sc_len);
+  for (int i = 0; i < 8; ++i) buf.push_back(uint8_t(uint64_t(amount) >> (8 * i)));
+  put_u32(buf, in.sequence);
+  buf.insert(buf.end(), hash_outputs, hash_outputs + 32);
+  buf.insert(buf.end(), tx.locktime, tx.locktime + 4);
+  put_u32(buf, uint32_t(hashtype));
+  dsha256(buf.data(), buf.size(), out);
+}
+
+// ---------------------------------------------------------------------------
+// Intra-block prevout amount map: (txid, vout) -> satoshis.
+// ---------------------------------------------------------------------------
+
+struct OutpointKey {
+  uint8_t b[36];
+  bool operator==(const OutpointKey &o) const {
+    return memcmp(b, o.b, 36) == 0;
+  }
+};
+
+struct OutpointHash {
+  size_t operator()(const OutpointKey &k) const {
+    uint64_t h;  // txids are uniform: first 8 bytes are a fine hash, mix vout
+    memcpy(&h, k.b, 8);
+    uint32_t vout;
+    memcpy(&vout, k.b + 32, 4);
+    return size_t(h ^ (uint64_t(vout) * 0x9E3779B97F4A7C15ULL));
+  }
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// Pass 0: walk tx structure, return tx count parsed and total input count
+// (the exact item-capacity upper bound for txx_extract).  tx_count == -1
+// parses to end of buffer.  Returns number of txs, or -1 on malformed data.
+long txx_scan(const uint8_t *data, long len, long tx_count,
+              long *total_inputs_out) {
+  Cursor c{data, data + len};
+  long txs = 0;
+  long total_inputs = 0;
+  while (c.ok && (tx_count < 0 ? c.remaining() > 0 : txs < tx_count)) {
+    TxSpan tx;
+    if (!parse_tx(c, tx, /*compute_txid=*/false)) return -1;
+    total_inputs += long(tx.ins.size());
+    ++txs;
+  }
+  if (tx_count >= 0 && txs != tx_count) return -1;
+  if (total_inputs_out) *total_inputs_out = total_inputs;
+  return txs;
+}
+
+// Extract verifiable signature items from `tx_count` serialized txs.
+//
+//   flags bit 0: BCH network (FORKID hashtype selects the BIP143-style digest
+//                for legacy inputs; amounts required for those)
+//   flags bit 1: build and consult the intra-block prevout amount map
+//                (block ingest: in-block spends resolve without a UTXO set)
+//   ext_amounts: optional per-input amounts, flattened across txs in parse
+//                order, -1 = unknown; consulted after the intra-block map
+//                (mirror of node._verify_txs's block_outs -> prevout_lookup
+//                precedence).  NULL = none.
+//
+// Per-item outputs (capacity rows each): z/px/py/r/s are 32-byte big-endian
+// rows; present[i]=0 marks an auto-invalid item (undecodable pubkey).
+// Per-tx outputs (tx_count rows): txids (32B), input/extract/coinbase/
+// unsupported counters.
+//
+// Returns the item count, or -1 malformed data / -2 capacity exceeded.
+long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
+                 const int64_t *ext_amounts, long n_ext, long capacity,
+                 uint8_t *z, uint8_t *px, uint8_t *py, uint8_t *r, uint8_t *s,
+                 uint8_t *present, int32_t *item_tx, int32_t *item_input,
+                 uint8_t *txids, int32_t *tx_n_inputs, int32_t *tx_extracted,
+                 int32_t *tx_coinbase, int32_t *tx_unsupported) {
+  bool bch = (flags & 1) != 0;
+  bool intra = (flags & 2) != 0;
+
+  // pass 1: parse every tx, compute txids, build the amount map
+  std::vector<TxSpan> txs;
+  txs.reserve(tx_count > 0 ? size_t(tx_count) : 16);
+  {
+    Cursor c{data, data + len};
+    long n = 0;
+    while (c.ok && (tx_count < 0 ? c.remaining() > 0 : n < tx_count)) {
+      txs.emplace_back();
+      if (!parse_tx(c, txs.back(), /*compute_txid=*/true)) return -1;
+      ++n;
+    }
+    if (tx_count >= 0 && n != tx_count) return -1;
+  }
+  std::unordered_map<OutpointKey, int64_t, OutpointHash> amounts;
+  if (intra) {
+    size_t total_outs = 0;
+    for (const TxSpan &tx : txs) total_outs += tx.outs.size();
+    amounts.reserve(total_outs * 2);
+    for (const TxSpan &tx : txs) {
+      for (size_t vout = 0; vout < tx.outs.size(); ++vout) {
+        OutpointKey key;
+        memcpy(key.b, tx.txid, 32);
+        uint32_t v32 = uint32_t(vout);
+        memcpy(key.b + 32, &v32, 4);
+        amounts[key] = tx.outs[vout].value;
+      }
+    }
+  }
+
+  // pass 2: extract items
+  static const uint8_t ZERO_TXID[32] = {0};
+  std::vector<uint8_t> scratch;
+  scratch.reserve(4096);
+  long item = 0;
+  long flat_input = 0;  // index into ext_amounts
+  for (size_t ti = 0; ti < txs.size(); ++ti) {
+    TxSpan &tx = txs[ti];
+    memcpy(txids + ti * 32, tx.txid, 32);
+    int32_t n_inputs = 0, extracted = 0, coinbase = 0, unsupported = 0;
+    for (size_t idx = 0; idx < tx.ins.size(); ++idx, ++flat_input) {
+      const InSpan &in = tx.ins[idx];
+      ++n_inputs;
+      if (memcmp(in.prevout, ZERO_TXID, 32) == 0) {
+        ++coinbase;
+        continue;
+      }
+      const uint8_t *sig_blob = nullptr, *pub_blob = nullptr;
+      size_t sig_len = 0, pub_len = 0;
+      bool segwit_item = false;
+      if (in.script_len == 0 && in.wit_count == 2) {
+        sig_blob = in.w0;
+        sig_len = in.w0_len;
+        pub_blob = in.w1;
+        pub_len = in.w1_len;
+        segwit_item = true;
+      } else {
+        const uint8_t *pushes[4];
+        size_t push_len[4];
+        int np = parse_pushes(in.script, in.script_len, pushes, push_len, 4);
+        if (np == 2 && (push_len[1] == 33 || push_len[1] == 65)) {
+          sig_blob = pushes[0];
+          sig_len = push_len[0];
+          pub_blob = pushes[1];
+          pub_len = push_len[1];
+        }
+      }
+      if (sig_blob == nullptr || sig_len < 9) {
+        ++unsupported;
+        continue;
+      }
+      int hashtype = sig_blob[sig_len - 1];
+      uint8_t rbuf[32], sbuf[32];
+      if (!parse_der(sig_blob, sig_len - 1, rbuf, sbuf)) {
+        ++unsupported;
+        continue;
+      }
+      // script_code: the P2PKH template over hash160(pubkey)
+      uint8_t script_code[25];
+      script_code[0] = 0x76; script_code[1] = 0xA9; script_code[2] = 0x14;
+      hash160(pub_blob, pub_len, script_code + 3);
+      script_code[23] = 0x88; script_code[24] = 0xAC;
+      uint8_t digest[32];
+      if (segwit_item || (bch && (hashtype & SIGHASH_FORKID))) {
+        // amount required: intra-block map first, then ext_amounts.  The
+        // map keeps the raw 64-bit value (valid even above 2^63); only the
+        // ext sentinel uses sign (-1 = unknown).
+        int64_t amount = 0;
+        bool have_amount = false;
+        if (intra) {
+          OutpointKey key;
+          memcpy(key.b, in.prevout, 36);
+          auto it = amounts.find(key);
+          if (it != amounts.end()) {
+            amount = it->second;
+            have_amount = true;
+          }
+        }
+        if (!have_amount && ext_amounts != nullptr && flat_input < n_ext &&
+            ext_amounts[flat_input] >= 0) {
+          amount = ext_amounts[flat_input];
+          have_amount = true;
+        }
+        if (!have_amount) {
+          ++unsupported;
+          continue;
+        }
+        bip143_sighash(tx, idx, script_code, 25, amount, hashtype, scratch,
+                       digest);
+      } else {
+        legacy_sighash(tx, idx, script_code, 25, hashtype, scratch, digest);
+      }
+      reduce_mod_n(digest);
+      if (item >= capacity) return -2;
+      memcpy(z + item * 32, digest, 32);
+      memcpy(r + item * 32, rbuf, 32);
+      memcpy(s + item * 32, sbuf, 32);
+      present[item] =
+          decode_pubkey(pub_blob, pub_len, px + item * 32, py + item * 32)
+              ? 1
+              : 0;
+      if (!present[item]) {
+        memset(px + item * 32, 0, 32);
+        memset(py + item * 32, 0, 32);
+      }
+      item_tx[item] = int32_t(ti);
+      item_input[item] = int32_t(idx);
+      ++item;
+      ++extracted;
+    }
+    tx_n_inputs[ti] = n_inputs;
+    tx_extracted[ti] = extracted;
+    tx_coinbase[ti] = coinbase;
+    tx_unsupported[ti] = unsupported;
+  }
+  return item;
+}
+
+}  // extern "C"

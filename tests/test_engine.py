@@ -154,3 +154,74 @@ async def test_engine_forced_tpu_errors_when_unavailable(monkeypatch):
         items, _ = make_items(2)
         with pytest.raises(RuntimeError, match="tpu backend unavailable"):
             await eng.verify(items)
+
+
+def test_pack_items_roundtrip_and_degenerates():
+    """RawBatch packing: valid items round-trip through to_tuples; the
+    degenerate classes (None/infinity pubkey, out-of-range r/s incl. the
+    oversized lax-DER case) pack to present=0 and verify False everywhere."""
+    from tpunode.verify.ecdsa_cpu import Point, verify_batch_cpu
+    from tpunode.verify.raw import pack_items
+
+    items, expected = make_items(8, tamper_every=3)
+    good = items[1]
+    degenerates = [
+        (None, good[1], good[2], good[3]),
+        (Point(None, None), good[1], good[2], good[3]),
+        (good[0], good[1], 0, good[3]),
+        (good[0], good[1], good[2], CURVE_N),
+        (good[0], good[1], 2**256 + 5, good[3]),  # oversized lax-DER r
+    ]
+    all_items = items + degenerates
+    raw = pack_items(all_items)
+    assert list(raw.present) == [1] * 8 + [0] * 5
+    back = raw.to_tuples()
+    for (q, z, r, s), (q2, z2, r2, s2) in zip(items, back[:8]):
+        assert (q2.x, q2.y) == (q.x, q.y)
+        assert (z2, r2, s2) == (z % CURVE_N, r, s)
+    assert verify_batch_cpu(back) == expected + [False] * 5
+
+
+@pytest.mark.asyncio
+async def test_engine_raw_path_all_backends():
+    """verify_raw == verify for the same logical items on every backend,
+    including a mixed raw+tuple batch coalesced into one dispatch."""
+    from tpunode.verify.raw import pack_items
+
+    items, expected = make_items(32, tamper_every=5)
+    raw = pack_items(items)
+    for backend in ("cpu", "oracle"):
+        async with VerifyEngine(
+            VerifyConfig(backend=backend, max_wait=0.0)
+        ) as eng:
+            got_raw = await eng.verify_raw(raw)
+            got_tup = await eng.verify(items)
+            assert got_raw == got_tup == expected
+    # mixed batch: raw and tuple submissions coalesce, per-payload results
+    async with VerifyEngine(
+        VerifyConfig(backend="cpu", max_wait=0.1, batch_size=128)
+    ) as eng:
+        t1 = asyncio.ensure_future(eng.verify_raw(pack_items(items[:10])))
+        t2 = asyncio.ensure_future(eng.verify(items[10:20]))
+        t3 = asyncio.ensure_future(eng.verify_raw(pack_items(items[20:])))
+        assert await t1 == expected[:10]
+        assert await t2 == expected[10:20]
+        assert await t3 == expected[20:]
+
+
+def test_engine_raw_sync_from_native_extract():
+    """RawSigItems from the native extractor feed verify_raw_sync directly
+    (duck-typed coercion), matching the tuple path."""
+    pytest.importorskip("tpunode.txextract")
+    from benchmarks.txgen import gen_signed_txs
+    from tpunode.txextract import extract_raw, have_native_extract
+
+    if not have_native_extract():
+        pytest.skip("native extractor unavailable")
+    txs = gen_signed_txs(20, inputs_per_tx=2, seed=77, invalid_every=4)
+    data = b"".join(t.serialize() for t in txs)
+    raw = extract_raw(data, len(txs))
+    eng = VerifyEngine(VerifyConfig(backend="cpu", warmup=False))
+    got = eng.verify_raw_sync(raw)
+    assert got == eng.verify_sync(raw.to_verify_items())
+    assert False in got and True in got

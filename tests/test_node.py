@@ -381,3 +381,120 @@ async def test_mempool_segwit_uses_embedder_prevout_lookup():
                 )
                 assert v.stats.extracted == 0 and v.stats.unsupported == 1
                 assert v.valid  # nothing extractable failed
+
+
+@pytest.mark.asyncio
+async def test_block_ingest_native_path_matches_python():
+    """The native-extract fast path (wire-round-tripped messages carry raw
+    bytes) must produce the same TxVerdict stream as the Python path, and
+    must actually be taken when raw bytes are present."""
+    import tpunode.node as node_mod
+    from benchmarks.txgen import gen_signed_txs
+    from tpunode import TxVerdict
+    from tpunode.peer import PeerMessage
+    from tpunode.util import Reader
+    from tpunode.verify.engine import VerifyConfig
+    from tpunode.wire import Block, BlockHeader, MsgBlock, MsgTx, Tx
+
+    if not node_mod._native_extract_available():
+        pytest.skip("native extractor unavailable")
+
+    txs = gen_signed_txs(
+        6, inputs_per_tx=2, seed=0x7A77, invalid_every=3, segwit_every=5
+    )
+    hdr = BlockHeader(1, b"\x00" * 32, b"\x00" * 32, 0, 0x207FFFFF, 0)
+    built = Block(hdr, tuple(txs))  # raw_txs=None: python path
+    rt = Block.deserialize(Reader(built.serialize()))  # raw_txs set
+    assert rt.raw_txs is not None
+
+    native_calls = 0
+    orig = node_mod.Node._verify_txs_native
+
+    async def counting(self, peer, txs_, raw):
+        nonlocal native_calls
+        native_calls += 1
+        return await orig(self, peer, txs_, raw)
+
+    async def run(block_msg) -> dict[bytes, object]:
+        pub = Publisher(name="node-events")
+        cfg = NodeConfig(
+            net=NET,
+            store=MemoryKV(),
+            pub=pub,
+            peers=["[::1]:17486"],
+            connect=lambda sa: dummy_peer_connect(NET, all_blocks()),
+            verify=VerifyConfig(backend="cpu", max_wait=0.0),
+        )
+        seen: dict[bytes, object] = {}
+        async with pub.subscription() as events:
+            async with Node(cfg) as node:
+                async with asyncio.timeout(15):
+                    peer = await wait_for_peer(events)
+                    node._peer_pub.publish(PeerMessage(peer, block_msg))
+                    while len(seen) < len(txs):
+                        ev = await events.receive()
+                        if isinstance(ev, TxVerdict):
+                            seen[ev.txid] = ev
+        return seen
+
+    node_mod.Node._verify_txs_native = counting
+    try:
+        native = await run(MsgBlock(rt))
+        assert native_calls == 1, "wire-round-tripped block must go native"
+        python = await run(MsgBlock(built))
+        assert native_calls == 1, "constructed block must take the python path"
+    finally:
+        node_mod.Node._verify_txs_native = orig
+
+    assert set(native) == set(python)
+    invalid_seen = False
+    for txid, nv in native.items():
+        pv = python[txid]
+        assert (nv.valid, nv.verdicts, nv.error) == (pv.valid, pv.verdicts, pv.error)
+        assert (
+            nv.stats.total_inputs, nv.stats.extracted,
+            nv.stats.coinbase, nv.stats.unsupported,
+        ) == (
+            pv.stats.total_inputs, pv.stats.extracted,
+            pv.stats.coinbase, pv.stats.unsupported,
+        )
+        invalid_seen |= not nv.valid
+    assert invalid_seen, "fixture must exercise invalid signatures"
+
+    # mempool path: a wire-round-tripped tx goes native too
+    one = Tx.deserialize(Reader(txs[0].serialize()))
+    assert one.raw is not None
+    node_mod.Node._verify_txs_native = counting
+    try:
+        native_calls = 0
+        got = await run_single(one)
+        assert native_calls == 1
+        assert got.valid is not None
+    finally:
+        node_mod.Node._verify_txs_native = orig
+
+
+async def run_single(tx):
+    """Deliver one MsgTx through a node and return its TxVerdict."""
+    from tpunode import TxVerdict
+    from tpunode.peer import PeerMessage
+    from tpunode.verify.engine import VerifyConfig
+    from tpunode.wire import MsgTx
+
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:17486"],
+        connect=lambda sa: dummy_peer_connect(NET, all_blocks()),
+        verify=VerifyConfig(backend="cpu", max_wait=0.0),
+    )
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(10):
+                peer = await wait_for_peer(events)
+                node._peer_pub.publish(PeerMessage(peer, MsgTx(tx)))
+                return await events.receive_match(
+                    lambda ev: ev if isinstance(ev, TxVerdict) else None
+                )

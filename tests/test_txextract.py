@@ -1,0 +1,339 @@
+"""Parity tests: native txextract vs the pure-Python extract path.
+
+The native extractor (native/txextract/txextract.cpp) must be a bit-exact
+mirror of txverify.extract_sig_items + sighash.py + ecdsa_cpu's DER/pubkey
+parsing — same items (z, r, s, decoded pubkey, present flag), same per-tx
+stats, same txids, on every workload shape.  These tests drive both paths
+over generated and hand-crafted transactions and compare everything.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from benchmarks.txgen import gen_signed_txs
+from tpunode.sighash import SIGHASH_ANYONECANPAY, SIGHASH_NONE, SIGHASH_SINGLE
+from tpunode.txverify import extract_sig_items, intra_block_amounts
+from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+from tpunode.wire import OutPoint, Tx, TxIn, TxOut
+
+txextract = pytest.importorskip("tpunode.txextract")
+if not txextract.have_native_extract():  # pragma: no cover
+    pytest.skip("native txextract unavailable", allow_module_level=True)
+
+from tpunode.txextract import extract_raw  # noqa: E402
+
+
+def _python_reference(txs, bch=False, lookup=None):
+    """Run the Python path the way node._verify_txs does: intra-block
+    amounts first, then the embedder lookup."""
+    block_outs = intra_block_amounts(txs) if len(txs) > 1 else {}
+    all_items, all_stats = [], []
+    for tx in txs:
+        amounts = {}
+        for idx, txin in enumerate(tx.inputs):
+            key = (txin.prevout.txid, txin.prevout.index)
+            amt = block_outs.get(key)
+            if amt is None and lookup is not None:
+                amt = lookup(*key)
+            if amt is not None:
+                amounts[idx] = amt
+        items, stats = extract_sig_items(tx, prevout_amounts=amounts or None, bch=bch)
+        all_items.extend(items)
+        all_stats.append(stats)
+    return all_items, all_stats
+
+
+def _serialize_all(txs) -> bytes:
+    return b"".join(tx.serialize() for tx in txs)
+
+
+def _assert_parity(txs, bch=False, ext_amounts=None, lookup=None):
+    raw = extract_raw(
+        _serialize_all(txs), len(txs), bch=bch,
+        intra_amounts=len(txs) > 1, ext_amounts=ext_amounts,
+    )
+    py_items, py_stats = _python_reference(txs, bch=bch, lookup=lookup)
+    assert raw.count == len(py_items)
+    native_items = raw.to_verify_items()
+    for i, ((q_n, z_n, r_n, s_n), it) in enumerate(zip(native_items, py_items)):
+        assert z_n == it.z % CURVE_N, f"item {i} digest"
+        # oversized (>2^256) r/s come out as 0 natively: same verdict class
+        assert r_n == (it.r if it.r < 2**256 else 0), f"item {i} r"
+        assert s_n == (it.s if it.s < 2**256 else 0), f"item {i} s"
+        if it.pubkey is None:
+            assert q_n is None, f"item {i} pubkey should be undecodable"
+        else:
+            assert q_n is not None and (q_n.x, q_n.y) == (it.pubkey.x, it.pubkey.y)
+        assert raw.item_tx[i] >= 0
+        tx = txs[raw.item_tx[i]]
+        assert it.txid == tx.txid
+        assert it.input_index == raw.item_input[i]
+    for ti, (tx, st) in enumerate(zip(txs, py_stats)):
+        assert raw.txid(ti) == tx.txid, f"tx {ti} txid"
+        got = raw.stats(ti)
+        assert (got.total_inputs, got.extracted, got.coinbase, got.unsupported) == (
+            st.total_inputs, st.extracted, st.coinbase, st.unsupported
+        ), f"tx {ti} stats"
+    return raw
+
+
+def test_legacy_p2pkh_parity():
+    _assert_parity(gen_signed_txs(40, inputs_per_tx=2, seed=1))
+
+
+def test_segwit_mix_parity():
+    txs = gen_signed_txs(60, inputs_per_tx=2, seed=2, segwit_every=3)
+    _assert_parity(txs)
+
+
+def test_invalid_mix_parity():
+    txs = gen_signed_txs(50, inputs_per_tx=3, seed=3, invalid_every=4, segwit_every=5)
+    _assert_parity(txs)
+
+
+def test_bch_forkid_parity():
+    """On a FORKID network legacy templates take the BIP143-style digest and
+    need amounts; in-block spends resolve, external ones don't."""
+    rng = random.Random(7)
+    priv = rng.getrandbits(256) % CURVE_N or 1
+    pub = point_mul(priv, GENERATOR)
+    blob = bytes([2 + (pub.y & 1)]) + pub.x.to_bytes(32, "big")
+    from benchmarks.txgen import _der, _p2pkh_script_code
+
+    script = _p2pkh_script_code(blob)
+    funding = Tx(
+        1,
+        (TxIn(OutPoint(rng.randbytes(32), 0), bytes([1, 0x51]) or b"", 0xFFFFFFFF),),
+        (TxOut(77_000, script), TxOut(33_000, script)),
+        0,
+    )
+    from tpunode.sighash import SIGHASH_FORKID, bip143_sighash
+
+    hashtype = 0x41  # ALL | FORKID
+    spend_inputs = (
+        TxIn(OutPoint(funding.txid, 0), b"", 0xFFFFFFFF),
+        TxIn(OutPoint(rng.randbytes(32), 1), b"", 0xFFFFFFFF),  # external: missing amount
+    )
+    unsigned = Tx(1, spend_inputs, (TxOut(50_000, script),), 0)
+    signed = []
+    for idx, amount in ((0, 77_000), (1, 12_345)):
+        z = bip143_sighash(unsigned, idx, script, amount, hashtype)
+        r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+        sig_blob = _der(r, s) + bytes([hashtype])
+        signed.append(
+            TxIn(
+                spend_inputs[idx].prevout,
+                bytes([len(sig_blob)]) + sig_blob + bytes([len(blob)]) + blob,
+                0xFFFFFFFF,
+            )
+        )
+    spend = Tx(1, tuple(signed), (TxOut(50_000, script),), 0)
+    assert SIGHASH_FORKID & hashtype
+    raw = _assert_parity([funding, spend], bch=True)
+    # the in-block input extracted; the external one unsupported
+    assert raw.stats(1).extracted == 1 and raw.stats(1).unsupported == 1
+
+
+def test_ext_amounts_match_prevout_lookup():
+    """ext_amounts (flattened per input) must mirror the Python path's
+    embedder prevout_lookup channel for out-of-block P2WPKH spends."""
+    rng = random.Random(11)
+    priv = rng.getrandbits(256) % CURVE_N or 1
+    pub = point_mul(priv, GENERATOR)
+    blob = bytes([2 + (pub.y & 1)]) + pub.x.to_bytes(32, "big")
+    from benchmarks.txgen import _der, _p2pkh_script_code
+    from tpunode.sighash import bip143_sighash
+
+    script = _p2pkh_script_code(blob)
+    amount = 123_456
+    prev_txid = rng.randbytes(32)
+    inputs = (TxIn(OutPoint(prev_txid, 0), b"", 0xFFFFFFFF),)
+    unsigned = Tx(2, inputs, (TxOut(99_000, script),), 0)
+    z = bip143_sighash(unsigned, 0, script, amount, 0x01)
+    r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+    sig_blob = _der(r, s) + b"\x01"
+    tx = Tx(2, inputs, (TxOut(99_000, script),), 0, witnesses=((sig_blob, blob),))
+
+    raw = extract_raw(tx.serialize(), 1, intra_amounts=False, ext_amounts=[amount])
+    items = raw.to_verify_items()
+    assert raw.count == 1
+
+    def lookup(txid, idx):
+        return amount if (txid, idx) == (prev_txid, 0) else None
+
+    py_items, _ = _python_reference([tx], lookup=lookup)
+    assert items[0][1] == py_items[0].z % CURVE_N
+    # and with no amount at all, both sides say unsupported
+    raw_none = extract_raw(tx.serialize(), 1, intra_amounts=False)
+    assert raw_none.count == 0 and raw_none.stats(0).unsupported == 1
+
+
+def test_hashtype_zoo_parity():
+    """NONE / SINGLE (incl. the out-of-range z=1 quirk) / ANYONECANPAY
+    combos through the legacy digest, all item-for-item identical."""
+    rng = random.Random(13)
+    priv = rng.getrandbits(256) % CURVE_N or 1
+    pub = point_mul(priv, GENERATOR)
+    blob = bytes([2 + (pub.y & 1)]) + pub.x.to_bytes(32, "big")
+    from benchmarks.txgen import _der, _p2pkh_script_code
+    from tpunode.sighash import legacy_sighash
+
+    script = _p2pkh_script_code(blob)
+    hashtypes = [
+        0x01, SIGHASH_NONE, SIGHASH_SINGLE,
+        0x01 | SIGHASH_ANYONECANPAY,
+        SIGHASH_NONE | SIGHASH_ANYONECANPAY,
+        SIGHASH_SINGLE | SIGHASH_ANYONECANPAY,
+        0x00,  # base 0 behaves like ALL
+    ]
+    txs = []
+    for ht in hashtypes:
+        # 3 inputs, 2 outputs: input 2 with SIGHASH_SINGLE is out of range
+        inputs = tuple(
+            TxIn(OutPoint(rng.randbytes(32), i), b"", 0xFFFFFFF0 + i) for i in range(3)
+        )
+        outputs = (TxOut(10_000, script), TxOut(20_000, script))
+        unsigned = Tx(1, inputs, outputs, 99)
+        signed = []
+        for i in range(3):
+            z = legacy_sighash(unsigned, i, script, ht)
+            r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+            sig_blob = _der(r, s) + bytes([ht])
+            signed.append(
+                TxIn(inputs[i].prevout,
+                     bytes([len(sig_blob)]) + sig_blob + bytes([len(blob)]) + blob,
+                     inputs[i].sequence)
+            )
+        txs.append(Tx(1, tuple(signed), outputs, 99))
+    _assert_parity(txs)
+
+
+def test_malformed_and_edge_inputs_parity():
+    """Coinbase, non-push scripts, wrong push counts, bad pubkey lengths,
+    undecodable pubkeys, short/garbage DER — stats and items must match."""
+    rng = random.Random(17)
+    garbage_pub_33 = b"\x02" + b"\xff" * 32  # x >= p: undecodable
+    off_curve_33 = b"\x02" + (5).to_bytes(32, "big")  # x=5: non-residue y^2
+    from benchmarks.txgen import _der, _p2pkh_script_code
+    from tpunode.sighash import legacy_sighash
+
+    priv = 0xDEADBEEF % CURVE_N
+    pub = point_mul(priv, GENERATOR)
+    blob = bytes([2 + (pub.y & 1)]) + pub.x.to_bytes(32, "big")
+    script = _p2pkh_script_code(blob)
+
+    def p2pkh_in(sig_blob: bytes, pub_blob: bytes, prevout=None):
+        return TxIn(
+            prevout or OutPoint(rng.randbytes(32), 0),
+            bytes([len(sig_blob)]) + sig_blob + bytes([len(pub_blob)]) + pub_blob,
+            0xFFFFFFFF,
+        )
+
+    cases = [
+        # coinbase
+        Tx(1, (TxIn(OutPoint(b"\x00" * 32, 0xFFFFFFFF), b"\x04abcd", 0),),
+           (TxOut(50, b"\x51"),), 0),
+        # non-push scriptSig (OP_DUP)
+        Tx(1, (TxIn(OutPoint(rng.randbytes(32), 0), b"\x76\xa9", 0),),
+           (TxOut(1, b""),), 0),
+        # one push only
+        Tx(1, (TxIn(OutPoint(rng.randbytes(32), 0), b"\x02\xab\xcd", 0),),
+           (TxOut(1, b""),), 0),
+        # pubkey-length not 33/65 => unsupported on the P2PKH path
+        Tx(1, (p2pkh_in(b"\x30" * 10, b"\x02\x01"),), (TxOut(1, b""),), 0),
+        # short sig blob (< 9 bytes)
+        Tx(1, (p2pkh_in(b"\x30\x01\x02", blob),), (TxOut(1, b""),), 0),
+        # garbage DER with valid-looking length
+        Tx(1, (p2pkh_in(b"\x31" + b"\x00" * 20, blob),), (TxOut(1, b""),), 0),
+        # undecodable pubkeys (right length): item with present=0
+        Tx(1, (p2pkh_in(_mk_sig(priv, rng), garbage_pub_33),), (TxOut(1, b""),), 0),
+        Tx(1, (p2pkh_in(_mk_sig(priv, rng), off_curve_33),), (TxOut(1, b""),), 0),
+        # uncompressed pubkey, valid
+        _uncompressed_case(priv, rng),
+        # witness with non-2 item count => falls through, script empty => unsupported
+        Tx(2, (TxIn(OutPoint(rng.randbytes(32), 0), b"", 0),), (TxOut(1, b""),), 0,
+           witnesses=(((b"\x00" * 12),),)),
+        # witness pubkey undecodable (any length allowed on witness path)
+        Tx(2, (TxIn(OutPoint(rng.randbytes(32), 0), b"", 0),), (TxOut(1, b""),), 0,
+           witnesses=((_mk_sig(priv, rng), b"\x09\x08"),)),
+    ]
+    for tx in cases:
+        _assert_parity([tx])
+    _assert_parity(cases)  # and all together as one "block"
+
+
+def _mk_sig(priv: int, rng: random.Random) -> bytes:
+    from benchmarks.txgen import _der
+
+    r, s = sign(priv, 0x1234, rng.getrandbits(256) % CURVE_N or 1)
+    return _der(r, s) + b"\x01"
+
+
+def _uncompressed_case(priv: int, rng: random.Random) -> Tx:
+    from benchmarks.txgen import _der, _p2pkh_script_code
+    from tpunode.sighash import legacy_sighash
+
+    pub = point_mul(priv, GENERATOR)
+    blob65 = b"\x04" + pub.x.to_bytes(32, "big") + pub.y.to_bytes(32, "big")
+    script = _p2pkh_script_code(blob65)
+    inputs = (TxIn(OutPoint(rng.randbytes(32), 0), b"", 0xFFFFFFFF),)
+    unsigned = Tx(1, inputs, (TxOut(5, b""),), 0)
+    z = legacy_sighash(unsigned, 0, script, 0x01)
+    r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+    sig_blob = _der(r, s) + b"\x01"
+    return Tx(
+        1,
+        (TxIn(inputs[0].prevout,
+              bytes([len(sig_blob)]) + sig_blob + bytes([len(blob65)]) + blob65,
+              0xFFFFFFFF),),
+        (TxOut(5, b""),),
+        0,
+    )
+
+
+def test_verdicts_match_cpu_backend():
+    """End to end: native-extracted raw arrays through the C++ verifier give
+    the same verdicts as the Python extract + oracle."""
+    from tpunode.verify.cpu_native import load_native_verifier
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+
+    txs = gen_signed_txs(30, inputs_per_tx=2, seed=23, invalid_every=3, segwit_every=5)
+    raw = extract_raw(_serialize_all(txs), len(txs))
+    native_items = raw.to_verify_items()
+    py_items, _ = _python_reference(txs)
+    expected = verify_batch_cpu([(i.pubkey, i.z, i.r, i.s) for i in py_items])
+    got_oracle = verify_batch_cpu(native_items)
+    assert got_oracle == expected
+    nv = load_native_verifier()
+    if nv is not None:
+        assert nv.verify_batch(native_items) == expected
+    # the workload must actually exercise both verdicts
+    assert True in expected and False in expected
+
+
+def test_scan_reports_counts():
+    txs = gen_signed_txs(12, inputs_per_tx=3, seed=29)
+    data = _serialize_all(txs)
+    from tpunode.txextract import load_txextract_lib
+    import ctypes
+
+    lib = load_txextract_lib()
+    n_inputs = ctypes.c_long()
+    assert lib.txx_scan(data, len(data), -1, ctypes.byref(n_inputs)) == 12
+    assert n_inputs.value == 36
+
+
+def test_malformed_data_raises():
+    with pytest.raises(ValueError):
+        extract_raw(b"\x01\x02\x03", 1)
+    # claiming more txs than present
+    txs = gen_signed_txs(2, seed=31)
+    with pytest.raises(ValueError):
+        extract_raw(_serialize_all(txs), 5)
+    # huge claimed input count must fail fast, not allocate
+    bad = (1).to_bytes(4, "little") + b"\xfe\x00\x00\x00\x01" + b"\x00" * 8
+    with pytest.raises(ValueError):
+        extract_raw(bad, 1)

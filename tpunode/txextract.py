@@ -1,0 +1,254 @@
+"""ctypes binding to the native transaction signature-item extractor
+(native/txextract/txextract.cpp).
+
+This is the host-side producer of the verify pipeline: raw serialized
+transactions in, `RawSigItems` out — contiguous 32-byte big-endian rows
+(z | px | py | r | s | present) that feed `secp_prepare_batch` /
+`secp_verify_batch` (native/secp256k1) directly, with no Python-int round
+trip.  Semantics are a bit-exact mirror of the pure-Python path
+(`txverify.extract_sig_items` over `wire.Tx`), checked item-for-item by
+tests/test_txextract.py.
+
+The reference node gets this capability from haskoin-core + libsecp256k1
+(SURVEY.md C6/C9); measured here at ~25x the pure-Python extract rate —
+the round-3 IBD bottleneck (PERF.md "gap analysis").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .txverify import ExtractStats
+
+__all__ = ["RawSigItems", "extract_raw", "load_txextract_lib", "have_native_extract"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libtxextract.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def load_txextract_lib() -> ctypes.CDLL:
+    """Build (if needed) and load the shared library, once per process."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", os.path.join(_REPO_ROOT, "native"),
+                 "build/libtxextract.so"],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        from numpy.ctypeslib import ndpointer
+
+        u8 = ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i32 = ndpointer(np.int32, flags="C_CONTIGUOUS")
+        i64 = ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.txx_scan.restype = ctypes.c_long
+        lib.txx_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.txx_extract.restype = ctypes.c_long
+        lib.txx_extract.argtypes = [
+            ctypes.c_char_p,  # data
+            ctypes.c_long,  # len
+            ctypes.c_long,  # tx_count
+            ctypes.c_int,  # flags
+            ctypes.c_void_p,  # ext_amounts (i64*) or NULL
+            ctypes.c_long,  # n_ext
+            ctypes.c_long,  # capacity
+            u8,  # z
+            u8,  # px
+            u8,  # py
+            u8,  # r
+            u8,  # s
+            u8,  # present
+            i32,  # item_tx
+            i32,  # item_input
+            u8,  # txids
+            i32,  # tx_n_inputs
+            i32,  # tx_extracted
+            i32,  # tx_coinbase
+            i32,  # tx_unsupported
+        ]
+        lib._ext_amounts_t = i64  # kept for callers building arrays
+        _lib = lib
+        return lib
+
+
+def have_native_extract() -> bool:
+    """True when the native extractor builds/loads on this box (failure is
+    cached: one make attempt per process)."""
+    global _load_failed
+    if _load_failed:
+        return False
+    try:
+        load_txextract_lib()
+        return True
+    except Exception:
+        _load_failed = True
+        return False
+
+
+@dataclass
+class RawSigItems:
+    """Extraction result in device-ready form.
+
+    Item rows (``count`` of each): ``z``/``px``/``py``/``r``/``s`` are
+    ``(count, 32)`` uint8 big-endian; ``present[i] == 0`` marks an
+    auto-invalid item (undecodable pubkey — the None-pubkey analog).
+    ``item_tx``/``item_input`` locate each item; per-tx arrays carry txids
+    and the ExtractStats counters.
+    """
+
+    count: int
+    z: np.ndarray
+    px: np.ndarray
+    py: np.ndarray
+    r: np.ndarray
+    s: np.ndarray
+    present: np.ndarray
+    item_tx: np.ndarray
+    item_input: np.ndarray
+    txids: np.ndarray  # (n_txs, 32)
+    tx_n_inputs: np.ndarray
+    tx_extracted: np.ndarray
+    tx_coinbase: np.ndarray
+    tx_unsupported: np.ndarray
+
+    def __len__(self) -> int:
+        return self.count
+
+    @property
+    def n_txs(self) -> int:
+        return len(self.txids)
+
+    def txid(self, tx_index: int) -> bytes:
+        return self.txids[tx_index].tobytes()
+
+    def stats(self, tx_index: int) -> ExtractStats:
+        return ExtractStats(
+            total_inputs=int(self.tx_n_inputs[tx_index]),
+            extracted=int(self.tx_extracted[tx_index]),
+            coinbase=int(self.tx_coinbase[tx_index]),
+            unsupported=int(self.tx_unsupported[tx_index]),
+        )
+
+    def tx_slices(self) -> list[slice]:
+        """Per-tx item ranges (items are emitted in (tx, input) order)."""
+        bounds = np.zeros(self.n_txs + 1, np.int64)
+        np.cumsum(self.tx_extracted, out=bounds[1:])
+        return [slice(int(bounds[i]), int(bounds[i + 1])) for i in range(self.n_txs)]
+
+    def to_verify_items(self):
+        """Convert to the engine's ``VerifyItem`` tuples — for the oracle
+        backend and cross-checks; the fast paths consume the arrays."""
+        from .verify.ecdsa_cpu import Point
+
+        items = []
+        for i in range(self.count):
+            if self.present[i]:
+                q = Point(
+                    int.from_bytes(self.px[i].tobytes(), "big"),
+                    int.from_bytes(self.py[i].tobytes(), "big"),
+                )
+            else:
+                q = None
+            items.append(
+                (
+                    q,
+                    int.from_bytes(self.z[i].tobytes(), "big"),
+                    int.from_bytes(self.r[i].tobytes(), "big"),
+                    int.from_bytes(self.s[i].tobytes(), "big"),
+                )
+            )
+        return items
+
+
+def extract_raw(
+    data: bytes,
+    tx_count: int = -1,
+    bch: bool = False,
+    intra_amounts: bool = True,
+    ext_amounts: Optional[Sequence[int]] = None,
+) -> RawSigItems:
+    """Extract signature items from ``tx_count`` serialized transactions.
+
+    ``data`` is a raw tx region (a block's tx area or concatenated txs);
+    ``tx_count == -1`` parses to the end of the buffer.  ``intra_amounts``
+    builds the in-block prevout->amount map (block ingest); ``ext_amounts``
+    supplies per-input amounts flattened across txs in parse order, ``-1``
+    or ``None`` entries meaning unknown — consulted after the intra map,
+    mirroring node._verify_txs's block_outs -> prevout_lookup precedence.
+
+    Raises ValueError on malformed data.
+    """
+    lib = load_txextract_lib()
+    n_inputs = ctypes.c_long()
+    n_txs = lib.txx_scan(data, len(data), tx_count, ctypes.byref(n_inputs))
+    if n_txs < 0:
+        raise ValueError("malformed transaction data")
+    capacity = max(1, n_inputs.value)
+    nt = max(1, n_txs)
+    out = RawSigItems(
+        count=0,
+        z=np.zeros((capacity, 32), np.uint8),
+        px=np.zeros((capacity, 32), np.uint8),
+        py=np.zeros((capacity, 32), np.uint8),
+        r=np.zeros((capacity, 32), np.uint8),
+        s=np.zeros((capacity, 32), np.uint8),
+        present=np.zeros(capacity, np.uint8),
+        item_tx=np.zeros(capacity, np.int32),
+        item_input=np.zeros(capacity, np.int32),
+        txids=np.zeros((nt, 32), np.uint8),
+        tx_n_inputs=np.zeros(nt, np.int32),
+        tx_extracted=np.zeros(nt, np.int32),
+        tx_coinbase=np.zeros(nt, np.int32),
+        tx_unsupported=np.zeros(nt, np.int32),
+    )
+    flags = (1 if bch else 0) | (2 if intra_amounts else 0)
+    if ext_amounts is not None:
+        ext = np.asarray(
+            [(-1 if a is None else a) for a in ext_amounts], np.int64
+        )
+        ext_ptr = ext.ctypes.data_as(ctypes.c_void_p)
+        n_ext = len(ext)
+    else:
+        ext = None  # noqa: F841 — keep the array alive through the call
+        ext_ptr = None
+        n_ext = 0
+    count = lib.txx_extract(
+        data, len(data), n_txs, flags, ext_ptr, n_ext, capacity,
+        out.z, out.px, out.py, out.r, out.s, out.present,
+        out.item_tx, out.item_input,
+        out.txids, out.tx_n_inputs, out.tx_extracted,
+        out.tx_coinbase, out.tx_unsupported,
+    )
+    if count < 0:
+        raise ValueError(f"txx_extract failed ({count})")
+    # trim to the actual item count (views, no copies)
+    out.count = int(count)
+    for name in ("z", "px", "py", "r", "s"):
+        setattr(out, name, getattr(out, name)[:count])
+    out.present = out.present[:count]
+    out.item_tx = out.item_tx[:count]
+    out.item_input = out.item_input[:count]
+    # per-tx arrays keep their true n_txs length
+    for name in ("txids", "tx_n_inputs", "tx_extracted", "tx_coinbase", "tx_unsupported"):
+        setattr(out, name, getattr(out, name)[:n_txs])
+    return out

@@ -79,6 +79,10 @@ class Reader:
     def remaining(self) -> int:
         return len(self._buf) - self._pos
 
+    def slice_from(self, start: int) -> bytes:
+        """Bytes consumed since ``start`` (a previously read ``pos``)."""
+        return self._buf[start : self._pos]
+
     def peek(self, n: int) -> bytes:
         return self._buf[self._pos : self._pos + n]
 

@@ -165,6 +165,21 @@ class NativeVerifier:
             (not degenerate[i]) and out.raw[i] == 1 for i in range(n)
         ]
 
+    def verify_raw(self, raw) -> list[bool]:
+        """Verify a packed :class:`tpunode.verify.raw.RawBatch` — the
+        zero-copy path from the native extractor.  ``present == 0`` rows
+        carry zeros, which already fail the in-engine r-range check; the
+        mask is ANDed anyway so the contract doesn't depend on that."""
+        n = len(raw)
+        if n == 0:
+            return []
+        out = ctypes.create_string_buffer(n)
+        self._lib.secp_verify_batch(
+            raw.px.tobytes(), raw.py.tobytes(), raw.z.tobytes(),
+            raw.r.tobytes(), raw.s.tobytes(), n, out,
+        )
+        return [bool(raw.present[i]) and out.raw[i] == 1 for i in range(n)]
+
 
 _cached: Optional[NativeVerifier] = None
 _load_failed = False

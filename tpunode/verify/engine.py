@@ -36,10 +36,15 @@ from typing import Callable, Optional, Sequence
 from ..metrics import metrics
 from ..trace import span
 from .ecdsa_cpu import Point, verify_batch_cpu
+from .raw import RawBatch, as_raw_batch, concat_raw
 
 __all__ = ["VerifyConfig", "VerifyEngine", "VerifyItem", "enable_compile_cache"]
 
 VerifyItem = tuple[Optional[Point], int, int, int]  # (pubkey, z, r, s)
+
+# what the queue holds: a list of VerifyItem tuples, or a packed RawBatch
+# (the native-extract fast path) — both sized via len()
+_Payload = "list[VerifyItem] | RawBatch"
 
 log = logging.getLogger("tpunode.verify")
 
@@ -214,10 +219,19 @@ class VerifyEngine:
 
     async def verify(self, items: Sequence[VerifyItem]) -> list[bool]:
         """Queue items; resolves when their batch has been verified."""
-        if not items:
+        return await self._enqueue(list(items))
+
+    async def verify_raw(self, raw) -> list[bool]:
+        """Queue a packed batch (RawBatch, or anything `as_raw_batch`
+        coerces, e.g. txextract.RawSigItems): the native-extract fast path —
+        no per-item Python objects anywhere between wire bytes and device."""
+        return await self._enqueue(as_raw_batch(raw))
+
+    async def _enqueue(self, payload) -> list[bool]:
+        if not len(payload):
             return []
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.append((list(items), fut))
+        self._queue.append((payload, fut))
         assert self._kick is not None, "engine not started"
         self._kick.set()
         return await fut
@@ -225,6 +239,10 @@ class VerifyEngine:
     def verify_sync(self, items: Sequence[VerifyItem]) -> list[bool]:
         """Blocking verification (benchmarks, scripts): no queueing."""
         return self._dispatch(list(items))
+
+    def verify_raw_sync(self, raw) -> list[bool]:
+        """Blocking raw-batch verification (benchmarks, scripts)."""
+        return self._dispatch(as_raw_batch(raw))
 
     # -- internals -----------------------------------------------------------
 
@@ -241,36 +259,37 @@ class VerifyEngine:
             ):
                 await asyncio.sleep(0.002)
             while self._queue:
-                batch: list[tuple[list[VerifyItem], asyncio.Future]] = []
+                batch: list[tuple[object, asyncio.Future]] = []
                 total = 0
                 while self._queue and total < self.cfg.batch_size:
-                    items, fut = self._queue.popleft()
-                    batch.append((items, fut))
-                    total += len(items)
-                flat = [it for items, _ in batch for it in items]
+                    payload, fut = self._queue.popleft()
+                    batch.append((payload, fut))
+                    total += len(payload)
+                payloads = [p for p, _ in batch]
                 metrics.inc("verify.batches")
-                metrics.inc("verify.items", len(flat))
+                metrics.inc("verify.items", total)
                 metrics.set_gauge(
                     "verify.batch_occupancy", total / self.cfg.batch_size
                 )
                 try:
-                    results = await asyncio.to_thread(self._dispatch, flat)
+                    results = await asyncio.to_thread(
+                        self._dispatch_multi, payloads
+                    )
                 except Exception as e:  # engine errors fail the waiters
-                    log.error("[Engine] batch of %d failed: %s", len(flat), e)
+                    log.error("[Engine] batch of %d failed: %s", total, e)
                     for _, fut in batch:
                         if not fut.done():
                             fut.set_exception(e)
                     continue
                 pos = 0
-                for items, fut in batch:
+                for payload, fut in batch:
                     if not fut.done():
-                        fut.set_result(results[pos : pos + len(items)])
-                    pos += len(items)
+                        fut.set_result(results[pos : pos + len(payload)])
+                    pos += len(payload)
 
-    def _dispatch(self, items: list[VerifyItem]) -> list[bool]:
-        """Pick an execution engine and run the batch (worker thread)."""
-        with span("verify.dispatch"):
-            return self._dispatch_inner(items)
+    def _dispatch(self, payload) -> list[bool]:
+        """Pick an execution engine and run one payload (worker thread)."""
+        return self._dispatch_multi([payload])
 
     def _pick(self, n: int) -> str:
         """Resolve the backend for one batch.  Never blocks except for the
@@ -303,43 +322,56 @@ class VerifyEngine:
             log.info("[Engine] device warmup still running; batches on cpu")
         return "cpu" if self._cpu is not None else "oracle"
 
-    def _dispatch_inner(self, items: list[VerifyItem]) -> list[bool]:
-        backend = self._pick(len(items))
-        t0 = time.perf_counter()
-        if backend == "tpu":
-            out = self._run_tpu(items)  # counts tpu/cpu items per chunk
-        elif backend == "cpu" and self._cpu is not None:
-            out = self._cpu.verify_batch(items)
-            metrics.inc("verify.cpu_items", len(items))
-        else:
-            out = verify_batch_cpu(items)
-            metrics.inc("verify.oracle_items", len(items))
-        dt = time.perf_counter() - t0
-        metrics.inc("verify.seconds", dt)
-        return out
+    def _dispatch_multi(self, payloads: list) -> list[bool]:
+        """Verify a coalesced batch of payloads (tuple lists and/or raw
+        batches) on one backend; results are in payload order."""
+        with span("verify.dispatch"):
+            total = sum(len(p) for p in payloads)
+            backend = self._pick(total)
+            t0 = time.perf_counter()
+            if backend == "tpu":
+                out = self._run_tpu(payloads)  # counts tpu/cpu items per chunk
+            elif backend == "cpu" and self._cpu is not None:
+                out = self._cpu.verify_raw(
+                    concat_raw([as_raw_batch(p) for p in payloads])
+                )
+                metrics.inc("verify.cpu_items", total)
+            else:
+                out = []
+                for p in payloads:
+                    out.extend(
+                        verify_batch_cpu(
+                            p if isinstance(p, list) else as_raw_batch(p).to_tuples()
+                        )
+                    )
+                metrics.inc("verify.oracle_items", total)
+            dt = time.perf_counter() - t0
+            metrics.inc("verify.seconds", dt)
+            return out
 
-    def _run_tpu(self, items: list[VerifyItem]) -> list[bool]:
+    def _run_tpu(self, payloads: list) -> list[bool]:
         """Device dispatch in fixed-size chunks: every call is the exact
         shape the warmup compiled — no surprise recompiles on the hot path.
         Dispatch is pipelined: chunk N+1 is host-prepped while chunk N runs
         on the device (JAX async dispatch), so neither side idles.  A
         sub-``min_tpu_batch`` remainder goes to the CPU engine instead of
         paying a full near-empty device step (forced-tpu backend excepted)."""
-        from .kernel import collect_verdicts, dispatch_batch_tpu
+        from .kernel import collect_verdicts, dispatch_batch_tpu_raw
 
+        raw = concat_raw([as_raw_batch(p) for p in payloads])
         B = self.cfg.batch_size
         pending: list = []  # (device array, count) | list[bool]
-        for i in range(0, len(items), B):
-            chunk = items[i : i + B]
+        for i in range(0, len(raw), B):
+            chunk = raw.slice(i, i + B)
             if (
                 len(chunk) < self.cfg.min_tpu_batch
                 and self.cfg.backend != "tpu"
                 and self._cpu is not None
             ):
-                pending.append(self._cpu.verify_batch(chunk))
+                pending.append(self._cpu.verify_raw(chunk))
                 metrics.inc("verify.cpu_items", len(chunk))
             else:
-                pending.append(dispatch_batch_tpu(chunk, pad_to=B))
+                pending.append(dispatch_batch_tpu_raw(chunk, pad_to=B))
                 metrics.inc("verify.tpu_items", len(chunk))
         out: list[bool] = []
         for p in pending:

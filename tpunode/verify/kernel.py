@@ -411,6 +411,48 @@ def _prepare_batch_native(
     )
 
 
+def prepare_batch_raw(raw, pad_to: Optional[int] = None) -> PreparedBatch:
+    """Host prep from a packed :class:`tpunode.verify.raw.RawBatch` — the
+    zero-Python-int path from the native extractor straight into
+    ``secp_prepare_batch`` (which redoes all range checks on the raw rows).
+    Falls back to the tuple path when the native library is unavailable."""
+    from .cpu_native import load_native_verifier
+
+    nv = load_native_verifier()
+    if nv is None:
+        return prepare_batch(raw.to_tuples(), pad_to=pad_to, native=False)
+    count = len(raw)
+    size = pad_to or count
+    assert size >= count
+    out = nv.prepare_batch_arrays(
+        raw.px.tobytes(),
+        raw.py.tobytes(),
+        raw.z.tobytes(),
+        raw.r.tobytes(),
+        raw.s.tobytes(),
+        raw.present.tobytes(),
+        count,
+        size,
+    )
+    return PreparedBatch(
+        d1a=out["d1a"],
+        d1b=out["d1b"],
+        d2a=out["d2a"],
+        d2b=out["d2b"],
+        n1a=out["negs"][0].astype(bool),
+        n1b=out["negs"][1].astype(bool),
+        n2a=out["negs"][2].astype(bool),
+        n2b=out["negs"][3].astype(bool),
+        qx=out["qx"],
+        qy=out["qy"],
+        r1=out["r1"],
+        r2=out["r2"],
+        r2_valid=out["r2_valid"].astype(bool),
+        host_valid=out["host_valid"].astype(bool),
+        count=count,
+    )
+
+
 def _build_q_table(qx: jnp.ndarray, qy: jnp.ndarray) -> jnp.ndarray:
     """Per-signature table [O, Q, 2Q, ..., 15Q], shape (16, 3, L, B)."""
     q1 = make_point(qx, qy, jnp.broadcast_to(F.ONE, qx.shape))
@@ -508,6 +550,15 @@ def _pallas_usable(batch: int) -> bool:
         return False
 
 
+def _dispatch_prep(prep: PreparedBatch) -> tuple[jnp.ndarray, int]:
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    if _pallas_usable(args[8].shape[-1]):
+        from .pallas_kernel import verify_blocked
+
+        return verify_blocked(*args), prep.count
+    return verify_device(*args), prep.count
+
+
 def dispatch_batch_tpu(
     items: Sequence[tuple[Optional[Point], int, int, int]],
     pad_to: Optional[int] = None,
@@ -517,13 +568,13 @@ def dispatch_batch_tpu(
     asynchronous, so the caller can prep the next chunk while this one
     computes — the overlap that keeps the device saturated during IBD
     (SURVEY.md §7 hard part 5).  Collect with :func:`collect_verdicts`."""
-    prep = prepare_batch(items, pad_to=pad_to)
-    args = tuple(jnp.asarray(a) for a in prep.device_args)
-    if _pallas_usable(args[8].shape[-1]):
-        from .pallas_kernel import verify_blocked
+    return _dispatch_prep(prepare_batch(items, pad_to=pad_to))
 
-        return verify_blocked(*args), prep.count
-    return verify_device(*args), prep.count
+
+def dispatch_batch_tpu_raw(raw, pad_to: Optional[int] = None) -> tuple[jnp.ndarray, int]:
+    """:func:`dispatch_batch_tpu` over a packed RawBatch (native-extract
+    fast path): same async dispatch, no Python-int round trip."""
+    return _dispatch_prep(prepare_batch_raw(raw, pad_to=pad_to))
 
 
 def collect_verdicts(out: jnp.ndarray, count: int) -> list[bool]:

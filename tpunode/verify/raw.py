@@ -1,0 +1,124 @@
+"""Canonical raw representation of a verify batch: packed byte rows.
+
+``RawBatch`` is the zero-Python-int interchange format between the native
+extractor (tpunode/txextract.py), the C++ CPU verifier (``secp_verify_batch``)
+and the TPU prep (``secp_prepare_batch``): five ``(N, 32)`` uint8 arrays of
+big-endian values plus a per-item ``present`` flag.  Tuple items (the
+engine's ``VerifyItem``) pack into it with the same degenerate-item rules the
+CPU backend always applied (None/infinity pubkey, out-of-range r/s — checked
+on the ORIGINAL ints, so oversized lax-DER values can't alias); rows with
+``present == 0`` verify False on every backend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .ecdsa_cpu import CURVE_N, Point
+
+__all__ = ["RawBatch", "pack_items", "as_raw_batch", "concat_raw"]
+
+
+@dataclass
+class RawBatch:
+    """Packed verify items: ``(N, 32)`` big-endian uint8 rows."""
+
+    px: np.ndarray
+    py: np.ndarray
+    z: np.ndarray
+    r: np.ndarray
+    s: np.ndarray
+    present: np.ndarray  # (N,) uint8; 0 = auto-invalid row (zeros elsewhere)
+
+    def __len__(self) -> int:
+        return len(self.present)
+
+    def slice(self, lo: int, hi: int) -> "RawBatch":
+        return RawBatch(
+            px=self.px[lo:hi],
+            py=self.py[lo:hi],
+            z=self.z[lo:hi],
+            r=self.r[lo:hi],
+            s=self.s[lo:hi],
+            present=self.present[lo:hi],
+        )
+
+    def to_tuples(self) -> list[tuple[Optional[Point], int, int, int]]:
+        """VerifyItem tuples (oracle backend / cross-checks).  Rows with
+        ``present == 0`` become ``(None, 0, 0, 0)`` — same verdict (False)
+        as whatever degenerate original they packed from."""
+        out = []
+        for i in range(len(self)):
+            if not self.present[i]:
+                out.append((None, 0, 0, 0))
+                continue
+            out.append(
+                (
+                    Point(
+                        int.from_bytes(self.px[i].tobytes(), "big"),
+                        int.from_bytes(self.py[i].tobytes(), "big"),
+                    ),
+                    int.from_bytes(self.z[i].tobytes(), "big"),
+                    int.from_bytes(self.r[i].tobytes(), "big"),
+                    int.from_bytes(self.s[i].tobytes(), "big"),
+                )
+            )
+        return out
+
+
+def pack_items(
+    items: Sequence[tuple[Optional[Point], int, int, int]]
+) -> RawBatch:
+    """Pack VerifyItem tuples, applying the degenerate-row rules on the
+    original ints (mirrors NativeVerifier.verify_batch's packing)."""
+    n = len(items)
+    px = np.zeros((n, 32), np.uint8)
+    py = np.zeros((n, 32), np.uint8)
+    z = np.zeros((n, 32), np.uint8)
+    r = np.zeros((n, 32), np.uint8)
+    s = np.zeros((n, 32), np.uint8)
+    present = np.zeros(n, np.uint8)
+    for i, (q, zi, ri, si) in enumerate(items):
+        if (
+            q is None
+            or q.infinity
+            or not (0 < ri < CURVE_N)
+            or not (0 < si < CURVE_N)
+        ):
+            continue
+        present[i] = 1
+        px[i] = np.frombuffer(q.x.to_bytes(32, "big"), np.uint8)
+        py[i] = np.frombuffer(q.y.to_bytes(32, "big"), np.uint8)
+        z[i] = np.frombuffer((zi % CURVE_N).to_bytes(32, "big"), np.uint8)
+        r[i] = np.frombuffer(ri.to_bytes(32, "big"), np.uint8)
+        s[i] = np.frombuffer(si.to_bytes(32, "big"), np.uint8)
+    return RawBatch(px=px, py=py, z=z, r=r, s=s, present=present)
+
+
+def as_raw_batch(obj) -> RawBatch:
+    """Coerce to RawBatch: pass-through, duck-typed arrays (e.g.
+    txextract.RawSigItems), or a VerifyItem sequence."""
+    if isinstance(obj, RawBatch):
+        return obj
+    if hasattr(obj, "px") and hasattr(obj, "present"):
+        return RawBatch(
+            px=obj.px, py=obj.py, z=obj.z, r=obj.r, s=obj.s,
+            present=np.asarray(obj.present, np.uint8),
+        )
+    return pack_items(obj)
+
+
+def concat_raw(batches: Sequence[RawBatch]) -> RawBatch:
+    if len(batches) == 1:
+        return batches[0]
+    return RawBatch(
+        px=np.concatenate([b.px for b in batches]),
+        py=np.concatenate([b.py for b in batches]),
+        z=np.concatenate([b.z for b in batches]),
+        r=np.concatenate([b.r for b in batches]),
+        s=np.concatenate([b.s for b in batches]),
+        present=np.concatenate([b.present for b in batches]),
+    )

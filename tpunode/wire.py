@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from functools import cached_property
+from typing import Optional
 
 from .params import Network
 from .util import (
@@ -241,6 +242,10 @@ class Tx:
     locktime: int
     # per-input witness stacks; empty tuple means non-segwit serialization
     witnesses: tuple[tuple[bytes, ...], ...] = ()
+    # original wire bytes when this Tx came off the network (deserialize
+    # sets it) — the zero-reparse input for the native extract fast path
+    # (tpunode/txextract.py).  Not part of value identity.
+    raw: Optional[bytes] = field(default=None, compare=False, repr=False)
 
     @property
     def has_witness(self) -> bool:
@@ -273,6 +278,7 @@ class Tx:
 
     @classmethod
     def deserialize(cls, r: Reader) -> "Tx":
+        start = r.pos
         version = r.u32()
         marker = r.peek(2)
         segwit = marker[:1] == b"\x00" and len(marker) == 2 and marker[1] == 1
@@ -288,7 +294,10 @@ class Tx:
                 tuple(r.varstr() for _ in range(r.varint())) for _ in range(n_in)
             )
         locktime = r.u32()
-        return cls(version, inputs, outputs, locktime, witnesses)
+        return cls(
+            version, inputs, outputs, locktime, witnesses,
+            raw=r.slice_from(start),
+        )
 
 
 # --- block header / block --------------------------------------------------
@@ -340,6 +349,9 @@ class BlockHeader:
 class Block:
     header: BlockHeader
     txs: tuple[Tx, ...]
+    # original tx-region wire bytes (deserialize sets it): feeds the native
+    # extract fast path without re-serializing.  Not part of value identity.
+    raw_txs: Optional[bytes] = field(default=None, compare=False, repr=False)
 
     def serialize(self) -> bytes:
         return (
@@ -352,8 +364,9 @@ class Block:
     def deserialize(cls, r: Reader) -> "Block":
         header = BlockHeader.deserialize(r)
         n = r.varint()
+        start = r.pos
         txs = tuple(Tx.deserialize(r) for _ in range(n))
-        return cls(header, txs)
+        return cls(header, txs, raw_txs=r.slice_from(start))
 
 
 def build_merkle_root(txids: list[bytes]) -> bytes:
