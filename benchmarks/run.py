@@ -42,18 +42,40 @@ def _emit(obj: dict) -> None:
 def config1() -> None:
     """Single big-block tx set through the C++ CPU verifier (single core).
     This IS the baseline reference point (BASELINE.md config 1): mainnet
-    block 800000 carried ~3,700 inputs; we use a 4,096-signature stand-in."""
-    from tpunode.txverify import extract_sig_items
-    from benchmarks.txgen import gen_signed_txs
+    block 800000 carried ~3,700 inputs; we use a ~4k-signature stand-in
+    with the realistic script-type mix (P2PKH / P2WPKH / P2SH-P2WPKH /
+    P2SH+P2WSH 2-of-3 multisig / ~5% unsupported — VERDICT r3 item 3),
+    reporting extraction coverage alongside the verify rate."""
+    from tpunode.txverify import (
+        combine_verdicts,
+        extract_sig_items,
+        wants_amount,
+    )
+    from benchmarks.txgen import gen_mixed_txs, synth_amount
 
-    n_txs = 64 if SMALL else 2048  # 2 sigs each -> 4096 sigs
-    txs = gen_signed_txs(n_txs, inputs_per_tx=2, seed=0x800000, invalid_every=0)
+    n_txs = 64 if SMALL else 1536  # ~2.7 sigs/tx in the mix -> ~4k sigs
+    txs = gen_mixed_txs(n_txs, seed=0x800000, invalid_every=0)
     items = []
+    total_in = coinbase = extracted = sigs = 0
     for tx in txs:
-        its, _ = extract_sig_items(tx)
-        items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
-    rate, engine, out = cpu_single_core_bench(items)
-    assert all(out), "baseline block must verify fully"
+        amounts = {
+            idx: synth_amount(ti.prevout.txid, ti.prevout.index)
+            for idx, ti in enumerate(tx.inputs)
+            if wants_amount(tx, idx, False)
+        }
+        its, st = extract_sig_items(tx, prevout_amounts=amounts or None)
+        items.extend(its)
+        total_in += st.total_inputs
+        coinbase += st.coinbase
+        extracted += st.extracted
+        sigs += st.sigs
+    rate, engine, out = cpu_single_core_bench(
+        [(i.pubkey, i.z, i.r, i.s) for i in items]
+    )
+    per_sig = combine_verdicts(items, out)
+    assert all(per_sig), "baseline block must verify fully"
+    coverage = extracted / (total_in - coinbase)
+    assert coverage >= 0.90, f"coverage {coverage:.2f} below target"
     _emit(
         {
             "metric": "config1_block800k_cpu_verify",
@@ -61,7 +83,9 @@ def config1() -> None:
             "unit": "sigs/sec/core",
             "vs_baseline": 1.0,
             "engine": engine,
-            "sigs": len(items),
+            "sigs": sigs,
+            "candidates": len(items),
+            "coverage": round(coverage, 4),
             "wall_s": round(len(items) / rate, 4),
         }
     )
@@ -123,93 +147,206 @@ def config2() -> None:
 
 
 def config3() -> None:
-    """IBD replay (BASELINE.md config 3): parse stored blocks, extract
-    signatures, stream through the verify engine in fixed 4096 batches;
-    consensus (header connect) runs alongside, and TPU verdicts are checked
-    against the CPU oracle on a sample."""
-    from tpunode.headers import MemoryHeaderStore, connect_blocks
-    from tpunode.params import BCH_REGTEST
-    from tpunode.txverify import extract_sig_items, intra_block_amounts
-    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
-    from tpunode.verify.engine import VerifyConfig, VerifyEngine
-    from benchmarks.txgen import gen_chain
+    """IBD replay through the FULL node stack (BASELINE.md config 3;
+    VERDICT r3 item 2): a fake wire-speaking peer serves a 1000-block
+    mixed-script chain; the chain actor syncs headers (real consensus
+    connect), then the embedder fetches block bodies in windows over the
+    peer-session API and every block rides the lazy-block native ingest —
+    LazyBlock raw bytes -> C++ txx_prevouts (amount oracle rows) ->
+    C++ txx_extract -> engine.verify_raw -> TxVerdict events.  No Python
+    tx parsing anywhere on the hot path."""
+    import contextlib
 
+    from tpunode.actors import Publisher
+    from tpunode.node import Node, NodeConfig, TxVerdict, VerifyShed
+    from tpunode.params import BCH_REGTEST
+    from tpunode.peer import get_blocks
+    from tpunode.verify.engine import VerifyConfig
+    from tpunode.wire import (
+        HEADER_SIZE,
+        InvType,
+        MsgBlock,
+        MsgGetData,
+        MsgGetHeaders,
+        MsgHeaders,
+        MsgPing,
+        MsgPong,
+        MsgVerAck,
+        MsgVersion,
+        decode_message,
+        decode_message_header,
+        encode_message,
+    )
+    from benchmarks.txgen import gen_chain, synth_amount
+    from tests.fakenet import QueueConnection, _QueueReader
+
+    net = BCH_REGTEST
     n_blocks = 50 if SMALL else 1000
-    # denser than the old 8 txs/block so signature volume is meaningful;
-    # on a 1-core host the end-to-end rate is bounded by Python ingest
-    # (parse/extract/sighash), so the emitted line also reports the verify
-    # engine's own throughput within the replay
     txs_per_block = 2 if SMALL else 64
-    batch = 128 if SMALL else 4096
+    window = 4 if SMALL else 24  # blocks per getdata round-trip
     blocks = gen_chain(
-        BCH_REGTEST,
+        net,
         n_blocks,
         txs_per_block,
         cache=f"ibd_{n_blocks}x{txs_per_block}.bin",
-        segwit_every=4,  # every 4th tx is a P2WPKH spend: BIP143 end-to-end
+        mix=True,  # realistic script mix incl. 2-of-3 multisig
+    )
+    # Pre-encode every wire reply OUTSIDE the timed path: the remote's
+    # serialization cost is harness, not node.
+    encoded_blocks = {
+        b.header.hash: encode_message(net, MsgBlock(b)) for b in blocks
+    }
+    headers_reply = encode_message(
+        net, MsgHeaders(tuple((b.header, len(b.txs)) for b in blocks))
     )
 
-    def block_items(b):
-        outs = intra_block_amounts(b.txs)
-        items = []
-        for tx in b.txs:
-            amounts = {
-                idx: outs[(ti.prevout.txid, ti.prevout.index)]
-                for idx, ti in enumerate(tx.inputs)
-                if (ti.prevout.txid, ti.prevout.index) in outs
-            }
-            its, _ = extract_sig_items(tx, prevout_amounts=amounts or None)
-            items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
-        return items
+    async def fast_remote(to_node, from_node):
+        """Wire-speaking remote with pre-encoded replies."""
+        import random as _random
+        from tpunode.params import NODE_NETWORK
+        from tpunode.wire import NetworkAddress
 
-    async def replay() -> tuple[int, float, int]:
-        engine = VerifyEngine(VerifyConfig(batch_size=batch, max_wait=0.002))
-        store = MemoryHeaderStore(BCH_REGTEST)
-        sigs = 0
-        t0 = time.perf_counter()
-        async with engine:
-            pending = []
-            now = int(time.time())
-            for b in blocks:
-                nodes, best = connect_blocks(store, BCH_REGTEST, now, [b.header])
-                store.add_headers(nodes)
-                store.set_best(best)
-                items = block_items(b)
-                if items:
-                    sigs += len(items)
-                    pending.append(asyncio.ensure_future(engine.verify(items)))
-            results = await asyncio.gather(*pending)
-            dt = time.perf_counter() - t0
-            flat = [v for r in results for v in r]
-            assert all(flat), "IBD replay signatures must all verify"
-            # consensus-identical check on a sample vs the oracle
-            sample_items = []
-            for b in blocks[:2]:
-                sample_items.extend(block_items(b))
-            assert verify_batch_cpu(sample_items) == [True] * len(sample_items)
-            return sigs, dt, store.get_best().height
+        local = NetworkAddress.from_host_port("::1", 0, services=NODE_NETWORK)
+        ver = MsgVersion(
+            version=70012, services=NODE_NETWORK, timestamp=int(time.time()),
+            addr_recv=NetworkAddress.from_host_port("::1", 0), addr_from=local,
+            nonce=_random.getrandbits(64), user_agent=b"/ibdbench:0/",
+            start_height=len(blocks), relay=True,
+        )
+        to_node.put_nowait(encode_message(net, ver))
+        reader = _QueueReader(from_node)
+        with contextlib.suppress(EOFError):
+            while True:
+                raw_header = await reader.read_exact(HEADER_SIZE)
+                header = decode_message_header(net, raw_header)
+                payload = (
+                    await reader.read_exact(header.length) if header.length else b""
+                )
+                msg = decode_message(net, header, payload)
+                if isinstance(msg, MsgPing):
+                    to_node.put_nowait(encode_message(net, MsgPong(msg.nonce)))
+                elif isinstance(msg, MsgVersion):
+                    to_node.put_nowait(encode_message(net, MsgVerAck()))
+                elif isinstance(msg, MsgGetHeaders):
+                    to_node.put_nowait(headers_reply)
+                elif isinstance(msg, MsgGetData):
+                    for iv in msg.invs:
+                        if iv.type in (InvType.BLOCK, InvType.WITNESS_BLOCK):
+                            enc = encoded_blocks.get(iv.hash)
+                            if enc is not None:
+                                to_node.put_nowait(enc)
 
-    from tpunode.metrics import metrics as _metrics
+    def connect_factory(sa):
+        @contextlib.asynccontextmanager
+        async def factory():
+            to_node: asyncio.Queue = asyncio.Queue()
+            from_node: asyncio.Queue = asyncio.Queue()
+            task = asyncio.ensure_future(fast_remote(to_node, from_node))
+            try:
+                yield QueueConnection(to_node, from_node)
+            finally:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    await task
 
-    v0 = _metrics.get("verify.seconds") or 0.0
-    sigs, dt, height = asyncio.run(replay())
-    verify_s = (_metrics.get("verify.seconds") or 0.0) - v0
+        return factory
+
+    total_txs = n_blocks * (txs_per_block + 1)  # + coinbase per block
+
+    async def replay():
+        from tpunode import ChainSynced, PeerConnected
+        from tpunode.store import MemoryKV
+
+        pub = Publisher(name="ibd-bench")
+        cfg = NodeConfig(
+            net=net,
+            store=MemoryKV(),
+            pub=pub,
+            peers=["192.0.2.9:8333"],
+            discover=False,
+            connect=connect_factory,
+            verify=VerifyConfig(max_wait=0.004),
+            prevout_lookup=synth_amount,
+        )
+        stats = {
+            "verdicts": 0, "sigs": 0, "extracted": 0, "noncb_inputs": 0,
+            "invalid": 0, "shed": 0,
+        }
+        done = asyncio.Event()
+
+        async def count_events(events):
+            while True:
+                ev = await events.receive()
+                if isinstance(ev, TxVerdict):
+                    stats["verdicts"] += 1
+                    stats["sigs"] += len(ev.verdicts)
+                    stats["extracted"] += ev.stats.extracted
+                    stats["noncb_inputs"] += (
+                        ev.stats.total_inputs - ev.stats.coinbase
+                    )
+                    stats["invalid"] += 0 if ev.valid else 1
+                    if stats["verdicts"] >= total_txs:
+                        done.set()
+                elif isinstance(ev, VerifyShed):
+                    stats["shed"] += ev.dropped_txs
+        async with pub.subscription() as events:
+            async with Node(cfg) as node:
+                t0 = time.perf_counter()
+                peer = await asyncio.wait_for(
+                    events.receive_match(
+                        lambda ev: ev.peer if isinstance(ev, PeerConnected) else None
+                    ),
+                    30,
+                )
+                await asyncio.wait_for(
+                    events.receive_match(
+                        lambda ev: ev if isinstance(ev, ChainSynced) else None
+                    ),
+                    120,
+                )
+                header_s = time.perf_counter() - t0
+                assert node.chain.get_best().height == n_blocks
+                counter = asyncio.ensure_future(count_events(events))
+                try:
+                    t0 = time.perf_counter()
+                    hashes = [b.header.hash for b in blocks]
+                    for off in range(0, len(hashes), window):
+                        got = await get_blocks(
+                            net, 60, peer, hashes[off : off + window]
+                        )
+                        assert got is not None, f"block window {off} failed"
+                        # soft backpressure: stay under the node's shed bound
+                        while (
+                            stats["verdicts"]
+                            < (off + window - 40) * (txs_per_block + 1)
+                        ):
+                            await asyncio.sleep(0.001)
+                    await asyncio.wait_for(done.wait(), 600)
+                    block_s = time.perf_counter() - t0
+                finally:
+                    counter.cancel()
+        return header_s, block_s, stats
+
+    header_s, block_s, st = asyncio.run(replay())
+    assert st["shed"] == 0, f"backpressure shed {st['shed']} txs"
+    assert st["invalid"] == 0, "IBD replay signatures must all verify"
+    coverage = st["extracted"] / st["noncb_inputs"]
+    assert coverage >= 0.90, f"coverage {coverage:.2f} below target"
     _emit(
         {
             "metric": "config3_ibd_replay",
-            "value": round(dt, 3),
+            "value": round(header_s + block_s, 3),
             "unit": "seconds_wall",
-            "vs_baseline": round(sigs / dt, 1),
-            "blocks": len(blocks),
-            "height": height,
-            "sigs": sigs,
-            "sigs_per_sec": round(sigs / dt, 1),
-            "verify_engine_sigs_per_sec": (
-                round(sigs / verify_s, 1) if verify_s else None
-            ),
-            "note": "end-to-end wall incl. header consensus + pure-Python "
-                    "tx parse/extract/sighash on a 1-core host; the engine "
-                    "rate is the verify path alone",
+            "vs_baseline": round(st["sigs"] / block_s, 1),
+            "blocks": n_blocks,
+            "txs": st["verdicts"],
+            "sigs": st["sigs"],
+            "sigs_per_sec": round(st["sigs"] / block_s, 1),
+            "header_sync_s": round(header_s, 3),
+            "block_phase_s": round(block_s, 3),
+            "coverage": round(coverage, 4),
+            "note": "end-to-end through the full node: wire framing, "
+                    "lazy blocks, C++ extract, batch engine, TxVerdict bus",
             "device": _device_kind(),
         }
     )

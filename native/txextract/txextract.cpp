@@ -494,17 +494,28 @@ struct Cursor {
   }
 
   uint64_t varint() {
+    // Rejects non-minimal encodings (Bitcoin Core ReadCompactSize): txid
+    // and sighash here are dsha256 over RAW spans, so accepting e.g. an
+    // input count of "fd 01 00" would hash different bytes than the
+    // canonically re-serializing Python reference path.
     if (!need(1)) return 0;
     uint8_t first = *p++;
     if (first < 0xFD) return first;
+    uint64_t v, lo;
     if (first == 0xFD) {
       if (!need(2)) return 0;
-      uint64_t v = uint64_t(p[0]) | (uint64_t(p[1]) << 8);
+      v = uint64_t(p[0]) | (uint64_t(p[1]) << 8);
       p += 2;
-      return v;
+      lo = 0xFD;
+    } else if (first == 0xFE) {
+      v = u32();
+      lo = 0x10000;
+    } else {
+      v = u64();
+      lo = 0x100000000ULL;
     }
-    if (first == 0xFE) return u32();
-    return u64();
+    if (ok && v < lo) ok = false;
+    return ok ? v : 0;
   }
 
   const uint8_t *bytes(size_t n) {
@@ -515,15 +526,19 @@ struct Cursor {
   }
 };
 
+// Witness spans kept per input: enough for every template we extract
+// (multisig needs dummy + 16 sigs + script = 18); larger witnesses keep
+// their true count but only the first spans, and no template matches them.
+const int MAX_WIT_SPANS = 19;
+
 struct InSpan {
   const uint8_t *prevout;  // 36 bytes (txid + index)
   const uint8_t *script;
   uint32_t script_len;
   uint32_t sequence;
-  // witness (segwit txs): item count; spans kept only for the 2-item shape
   uint32_t wit_count = 0;
-  const uint8_t *w0 = nullptr, *w1 = nullptr;
-  uint32_t w0_len = 0, w1_len = 0;
+  const uint8_t *wit[MAX_WIT_SPANS];
+  uint32_t wit_len[MAX_WIT_SPANS];
 };
 
 struct OutSpan {
@@ -597,8 +612,10 @@ bool parse_tx(Cursor &c, TxSpan &tx, bool compute_txid) {
         uint64_t wlen = c.varint();
         if (!c.ok || wlen > c.remaining()) return false;
         const uint8_t *wp = c.bytes(wlen);
-        if (w == 0) { in.w0 = wp; in.w0_len = uint32_t(wlen); }
-        if (w == 1) { in.w1 = wp; in.w1_len = uint32_t(wlen); }
+        if (w < MAX_WIT_SPANS) {
+          in.wit[w] = wp;
+          in.wit_len[w] = uint32_t(wlen);
+        }
       }
     }
   }
@@ -647,17 +664,20 @@ bool parse_der(const uint8_t *sig, size_t len, uint8_t r[32], uint8_t s[32]) {
   return true;
 }
 
-// Parse a pushes-only script (opcodes 1-75, PUSHDATA1/2) — mirror of
-// txverify._parse_pushes.  Fills at most `max_out` spans; returns the push
-// count or -1 if any non-push opcode appears.
+// Parse a pushes-only script (OP_0, opcodes 1-75, PUSHDATA1/2) — mirror of
+// txverify._parse_pushes.  OP_0 parses as an empty push (the CHECKMULTISIG
+// dummy).  Fills at most `max_out` spans; returns the push count or -1 if
+// any non-push opcode appears.
 int parse_pushes(const uint8_t *script, size_t n,
-                 const uint8_t *out[4], size_t out_len[4], int max_out) {
+                 const uint8_t **out, size_t *out_len, int max_out) {
   int count = 0;
   size_t i = 0;
   while (i < n) {
     uint8_t op = script[i++];
     size_t ln;
-    if (op >= 1 && op <= 75) {
+    if (op == 0) {
+      ln = 0;
+    } else if (op >= 1 && op <= 75) {
       ln = op;
     } else if (op == 76 && i < n) {
       ln = script[i++];
@@ -676,6 +696,122 @@ int parse_pushes(const uint8_t *script, size_t n,
     i += ln;
   }
   return count;
+}
+
+// Bare multisig template: OP_m <key>*n OP_n OP_CHECKMULTISIG, keys 33/65
+// bytes — mirror of txverify._parse_multisig.
+struct MsigTemplate {
+  int m = 0, n = 0;
+  const uint8_t *keys[16];
+  size_t key_len[16];
+};
+
+bool parse_multisig(const uint8_t *s, size_t len, MsigTemplate &out) {
+  if (len < 3 || s[len - 1] != 0xAE) return false;
+  int n_op = s[len - 2], m_op = s[0];
+  if (n_op < 0x51 || n_op > 0x60 || m_op < 0x51 || m_op > 0x60) return false;
+  out.n = n_op - 0x50;
+  out.m = m_op - 0x50;
+  if (out.m > out.n) return false;
+  size_t i = 1, end = len - 2;
+  int k = 0;
+  while (i < end) {
+    size_t ln = s[i++];
+    if ((ln != 33 && ln != 65) || i + ln > end || k >= 16) return false;
+    out.keys[k] = s + i;
+    out.key_len[k] = ln;
+    ++k;
+    i += ln;
+  }
+  return k == out.n;
+}
+
+// The spend-template classifier shared by txx_scan (capacity) and
+// txx_extract (emission) — mirror of the template dispatch in
+// txverify.extract_sig_items.
+struct InTemplate {
+  enum Kind { UNSUPPORTED, SINGLE, MULTISIG } kind = UNSUPPORTED;
+  bool segwit = false;  // BIP143 digest (amount required)
+  const uint8_t *sig = nullptr;  // SINGLE
+  size_t sig_len = 0;
+  const uint8_t *pub = nullptr;
+  size_t pub_len = 0;
+  MsigTemplate ms;  // MULTISIG
+  const uint8_t *sigs[16];
+  size_t sig_lens[16];
+  const uint8_t *sc = nullptr;  // MULTISIG script_code (redeem/witness script)
+  size_t sc_len = 0;
+};
+
+// P2WSH multisig witness shape: [<empty dummy>, <sig>*m, script].
+bool is_msig_witness(const InSpan &in, InTemplate &t) {
+  if (in.wit_count < 3 || in.wit_count > 18) return false;
+  if (in.wit_len[0] != 0) return false;
+  uint32_t last = in.wit_count - 1;
+  if (!parse_multisig(in.wit[last], in.wit_len[last], t.ms)) return false;
+  if (int(in.wit_count) - 2 != t.ms.m) return false;
+  for (int i = 0; i < t.ms.m; ++i) {
+    t.sigs[i] = in.wit[1 + i];
+    t.sig_lens[i] = in.wit_len[1 + i];
+  }
+  t.sc = in.wit[last];
+  t.sc_len = in.wit_len[last];
+  return true;
+}
+
+void classify_input(const InSpan &in, InTemplate &t) {
+  if (in.script_len == 0 && in.wit_count == 2) {
+    // P2WPKH
+    t.kind = InTemplate::SINGLE;
+    t.segwit = true;
+    t.sig = in.wit[0]; t.sig_len = in.wit_len[0];
+    t.pub = in.wit[1]; t.pub_len = in.wit_len[1];
+    return;
+  }
+  if (in.script_len == 0 && is_msig_witness(in, t)) {
+    t.kind = InTemplate::MULTISIG;
+    t.segwit = true;
+    return;
+  }
+  const uint8_t *pushes[MAX_WIT_SPANS];
+  size_t plen[MAX_WIT_SPANS];
+  int np = parse_pushes(in.script, in.script_len, pushes, plen, MAX_WIT_SPANS);
+  if (np == 2 && (plen[1] == 33 || plen[1] == 65)) {
+    // P2PKH
+    t.kind = InTemplate::SINGLE;
+    t.sig = pushes[0]; t.sig_len = plen[0];
+    t.pub = pushes[1]; t.pub_len = plen[1];
+    return;
+  }
+  if (np == 1 && plen[0] == 22 && pushes[0][0] == 0x00 &&
+      pushes[0][1] == 0x14 && in.wit_count == 2) {
+    // P2SH-P2WPKH
+    t.kind = InTemplate::SINGLE;
+    t.segwit = true;
+    t.sig = in.wit[0]; t.sig_len = in.wit_len[0];
+    t.pub = in.wit[1]; t.pub_len = in.wit_len[1];
+    return;
+  }
+  if (np == 1 && plen[0] == 34 && pushes[0][0] == 0x00 &&
+      pushes[0][1] == 0x20 && is_msig_witness(in, t)) {
+    // P2SH-P2WSH multisig
+    t.kind = InTemplate::MULTISIG;
+    t.segwit = true;
+    return;
+  }
+  if (np >= 2 && np <= 18 && plen[0] == 0 &&
+      parse_multisig(pushes[np - 1], plen[np - 1], t.ms) &&
+      np - 2 == t.ms.m) {
+    // legacy P2SH multisig: OP_0 <sig>*m <redeemScript>
+    t.kind = InTemplate::MULTISIG;
+    for (int i = 0; i < t.ms.m; ++i) {
+      t.sigs[i] = pushes[1 + i];
+      t.sig_lens[i] = plen[1 + i];
+    }
+    t.sc = pushes[np - 1];
+    t.sc_len = plen[np - 1];
+    return;
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -854,23 +990,65 @@ struct OutpointHash {
 
 extern "C" {
 
-// Pass 0: walk tx structure, return tx count parsed and total input count
-// (the exact item-capacity upper bound for txx_extract).  tx_count == -1
-// parses to end of buffer.  Returns number of txs, or -1 on malformed data.
+// Pass 0: walk tx structure, return tx count parsed and the item-capacity
+// upper bound for txx_extract (1 per input; m*(n-m+1) candidates for a
+// multisig template input).  tx_count == -1 parses to end of buffer.
+// Returns number of txs, or -1 on malformed data.
 long txx_scan(const uint8_t *data, long len, long tx_count,
-              long *total_inputs_out) {
+              long *capacity_out) {
   Cursor c{data, data + len};
   long txs = 0;
-  long total_inputs = 0;
+  long capacity = 0;
   while (c.ok && (tx_count < 0 ? c.remaining() > 0 : txs < tx_count)) {
     TxSpan tx;
     if (!parse_tx(c, tx, /*compute_txid=*/false)) return -1;
-    total_inputs += long(tx.ins.size());
+    for (const InSpan &in : tx.ins) {
+      InTemplate t;
+      classify_input(in, t);
+      capacity += t.kind == InTemplate::MULTISIG
+                      ? long(t.ms.m) * (t.ms.n - t.ms.m + 1)
+                      : 1;
+    }
     ++txs;
   }
   if (tx_count >= 0 && txs != tx_count) return -1;
-  if (total_inputs_out) *total_inputs_out = total_inputs;
+  if (capacity_out) *capacity_out = capacity;
   return txs;
+}
+
+// Per-input prevout listing for the embedder's amount oracle: one row per
+// input in flat parse order (coinbase included, so indices align with
+// txx_extract's ext_amounts), carrying the prevout txid+vout and whether
+// the input could consume a BIP143 amount (bch: every non-coinbase input;
+// otherwise any input with a >=2-item witness — mirror of
+// txverify.wants_amount).  Lets block ingest resolve amounts through
+// NodeConfig.prevout_lookup without ever parsing txs in Python.
+// Returns total input count, or -1 malformed / -2 capacity exceeded.
+long txx_prevouts(const uint8_t *data, long len, long tx_count, int bch,
+                  long capacity, uint8_t *txids32, int64_t *vouts,
+                  uint8_t *wants) {
+  Cursor c{data, data + len};
+  long n = 0, flat = 0;
+  static const uint8_t ZERO_TXID[32] = {0};
+  while (c.ok && (tx_count < 0 ? c.remaining() > 0 : n < tx_count)) {
+    TxSpan tx;
+    if (!parse_tx(c, tx, /*compute_txid=*/false)) return -1;
+    for (const InSpan &in : tx.ins) {
+      if (flat >= capacity) return -2;
+      memcpy(txids32 + flat * 32, in.prevout, 32);
+      uint32_t vout;  // wire is little-endian; so is every target we build on
+      memcpy(&vout, in.prevout + 32, 4);
+      // int64 out: a vout >= 2^31 (junk or hostile) must reach the Python
+      // prevout_lookup as the true unsigned value, not a negative int
+      vouts[flat] = int64_t(vout);
+      bool cb = memcmp(in.prevout, ZERO_TXID, 32) == 0;
+      wants[flat] = (!cb && (bch || in.wit_count >= 2)) ? 1 : 0;
+      ++flat;
+    }
+    ++n;
+  }
+  if (tx_count >= 0 && n != tx_count) return -1;
+  return flat;
 }
 
 // Extract verifiable signature items from `tx_count` serialized txs.
@@ -885,16 +1063,24 @@ long txx_scan(const uint8_t *data, long len, long tx_count,
 //                precedence).  NULL = none.
 //
 // Per-item outputs (capacity rows each): z/px/py/r/s are 32-byte big-endian
-// rows; present[i]=0 marks an auto-invalid item (undecodable pubkey).
-// Per-tx outputs (tx_count rows): txids (32B), input/extract/coinbase/
-// unsupported counters.
+// rows; present[i]=0 marks an auto-invalid item (undecodable pubkey or
+// unparseable multisig sig).  item_sig/item_key/item_nsigs/item_nkeys
+// locate multisig candidates (0/0/1/1 for single-sig items) — mirror of
+// SigItem's candidate fields; combine per-signature verdicts with
+// txverify.msig_match.
+// Per-tx outputs (tx_count rows): txids (32B), tx_n_inputs, tx_extracted
+// (INPUTS extracted), tx_items (device items), tx_sigs (signatures),
+// tx_coinbase, tx_unsupported.
 //
 // Returns the item count, or -1 malformed data / -2 capacity exceeded.
 long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
                  const int64_t *ext_amounts, long n_ext, long capacity,
                  uint8_t *z, uint8_t *px, uint8_t *py, uint8_t *r, uint8_t *s,
                  uint8_t *present, int32_t *item_tx, int32_t *item_input,
+                 int32_t *item_sig, int32_t *item_key, int32_t *item_nsigs,
+                 int32_t *item_nkeys,
                  uint8_t *txids, int32_t *tx_n_inputs, int32_t *tx_extracted,
+                 int32_t *tx_items, int32_t *tx_sigs,
                  int32_t *tx_coinbase, int32_t *tx_unsupported) {
   bool bch = (flags & 1) != 0;
   bool intra = (flags & 2) != 0;
@@ -938,6 +1124,8 @@ long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
     TxSpan &tx = txs[ti];
     memcpy(txids + ti * 32, tx.txid, 32);
     int32_t n_inputs = 0, extracted = 0, coinbase = 0, unsupported = 0;
+    int32_t sigs = 0;
+    long tx_item_start = item;
     for (size_t idx = 0; idx < tx.ins.size(); ++idx, ++flat_input) {
       const InSpan &in = tx.ins[idx];
       ++n_inputs;
@@ -945,91 +1133,164 @@ long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
         ++coinbase;
         continue;
       }
-      const uint8_t *sig_blob = nullptr, *pub_blob = nullptr;
-      size_t sig_len = 0, pub_len = 0;
-      bool segwit_item = false;
-      if (in.script_len == 0 && in.wit_count == 2) {
-        sig_blob = in.w0;
-        sig_len = in.w0_len;
-        pub_blob = in.w1;
-        pub_len = in.w1_len;
-        segwit_item = true;
-      } else {
-        const uint8_t *pushes[4];
-        size_t push_len[4];
-        int np = parse_pushes(in.script, in.script_len, pushes, push_len, 4);
-        if (np == 2 && (push_len[1] == 33 || push_len[1] == 65)) {
-          sig_blob = pushes[0];
-          sig_len = push_len[0];
-          pub_blob = pushes[1];
-          pub_len = push_len[1];
-        }
-      }
-      if (sig_blob == nullptr || sig_len < 9) {
+      InTemplate t;
+      classify_input(in, t);
+      if (t.kind == InTemplate::UNSUPPORTED) {
         ++unsupported;
         continue;
       }
-      int hashtype = sig_blob[sig_len - 1];
-      uint8_t rbuf[32], sbuf[32];
-      if (!parse_der(sig_blob, sig_len - 1, rbuf, sbuf)) {
-        ++unsupported;
-        continue;
-      }
-      // script_code: the P2PKH template over hash160(pubkey)
-      uint8_t script_code[25];
-      script_code[0] = 0x76; script_code[1] = 0xA9; script_code[2] = 0x14;
-      hash160(pub_blob, pub_len, script_code + 3);
-      script_code[23] = 0x88; script_code[24] = 0xAC;
-      uint8_t digest[32];
-      if (segwit_item || (bch && (hashtype & SIGHASH_FORKID))) {
-        // amount required: intra-block map first, then ext_amounts.  The
-        // map keeps the raw 64-bit value (valid even above 2^63); only the
-        // ext sentinel uses sign (-1 = unknown).
-        int64_t amount = 0;
-        bool have_amount = false;
-        if (intra) {
-          OutpointKey key;
-          memcpy(key.b, in.prevout, 36);
-          auto it = amounts.find(key);
-          if (it != amounts.end()) {
-            amount = it->second;
-            have_amount = true;
-          }
-        }
-        if (!have_amount && ext_amounts != nullptr && flat_input < n_ext &&
-            ext_amounts[flat_input] >= 0) {
-          amount = ext_amounts[flat_input];
+
+      // amount resolution shared by both kinds (prevout is per-input):
+      // intra-block map first, then ext_amounts.  The map keeps the raw
+      // 64-bit value (valid even above 2^63); only the ext sentinel uses
+      // sign (-1 = unknown).
+      int64_t amount = 0;
+      bool have_amount = false;
+      if (intra) {
+        OutpointKey key;
+        memcpy(key.b, in.prevout, 36);
+        auto it = amounts.find(key);
+        if (it != amounts.end()) {
+          amount = it->second;
           have_amount = true;
         }
-        if (!have_amount) {
+      }
+      if (!have_amount && ext_amounts != nullptr && flat_input < n_ext &&
+          ext_amounts[flat_input] >= 0) {
+        amount = ext_amounts[flat_input];
+        have_amount = true;
+      }
+
+      if (t.kind == InTemplate::SINGLE) {
+        if (t.sig_len < 9) {
           ++unsupported;
           continue;
         }
-        bip143_sighash(tx, idx, script_code, 25, amount, hashtype, scratch,
-                       digest);
+        int hashtype = t.sig[t.sig_len - 1];
+        uint8_t rbuf[32], sbuf[32];
+        if (!parse_der(t.sig, t.sig_len - 1, rbuf, sbuf)) {
+          ++unsupported;
+          continue;
+        }
+        // script_code: the P2PKH template over hash160(pubkey)
+        uint8_t script_code[25];
+        script_code[0] = 0x76; script_code[1] = 0xA9; script_code[2] = 0x14;
+        hash160(t.pub, t.pub_len, script_code + 3);
+        script_code[23] = 0x88; script_code[24] = 0xAC;
+        uint8_t digest[32];
+        if (t.segwit || (bch && (hashtype & SIGHASH_FORKID))) {
+          if (!have_amount) {
+            ++unsupported;
+            continue;
+          }
+          bip143_sighash(tx, idx, script_code, 25, amount, hashtype, scratch,
+                         digest);
+        } else {
+          legacy_sighash(tx, idx, script_code, 25, hashtype, scratch, digest);
+        }
+        reduce_mod_n(digest);
+        if (item >= capacity) return -2;
+        memcpy(z + item * 32, digest, 32);
+        memcpy(r + item * 32, rbuf, 32);
+        memcpy(s + item * 32, sbuf, 32);
+        present[item] =
+            decode_pubkey(t.pub, t.pub_len, px + item * 32, py + item * 32)
+                ? 1
+                : 0;
+        if (!present[item]) {
+          memset(px + item * 32, 0, 32);
+          memset(py + item * 32, 0, 32);
+        }
+        item_tx[item] = int32_t(ti);
+        item_input[item] = int32_t(idx);
+        item_sig[item] = 0;
+        item_key[item] = 0;
+        item_nsigs[item] = 1;
+        item_nkeys[item] = 1;
+        ++item;
+        ++extracted;
+        ++sigs;
+        continue;
+      }
+
+      // MULTISIG: emit m*(n-m+1) candidate (sig, key) pairs.  A missing
+      // required amount mid-loop rolls the whole input back to unsupported
+      // (same precedence as txverify._msig_items).
+      int m = t.ms.m, n = t.ms.n;
+      long input_start = item;
+      // decode each key at most once per input
+      uint8_t kx[16][32], ky[16][32];
+      int kdec[16];
+      for (int k = 0; k < 16; ++k) kdec[k] = -1;
+      bool input_unsupported = false;
+      for (int i = 0; i < m && !input_unsupported; ++i) {
+        const uint8_t *sig_blob = t.sigs[i];
+        size_t sig_len = t.sig_lens[i];
+        uint8_t rbuf[32], sbuf[32], digest[32];
+        bool have_sig = sig_len >= 9 &&
+                        parse_der(sig_blob, sig_len - 1, rbuf, sbuf);
+        if (have_sig) {
+          int hashtype = sig_blob[sig_len - 1];
+          if (t.segwit || (bch && (hashtype & SIGHASH_FORKID))) {
+            if (!have_amount) {
+              input_unsupported = true;
+              break;
+            }
+            bip143_sighash(tx, idx, t.sc, t.sc_len, amount, hashtype, scratch,
+                           digest);
+          } else {
+            legacy_sighash(tx, idx, t.sc, t.sc_len, hashtype, scratch, digest);
+          }
+          reduce_mod_n(digest);
+        }
+        for (int j = i; j <= n - m + i; ++j) {
+          if (item >= capacity) return -2;
+          if (!have_sig) {
+            memset(z + item * 32, 0, 32);
+            memset(r + item * 32, 0, 32);
+            memset(s + item * 32, 0, 32);
+            memset(px + item * 32, 0, 32);
+            memset(py + item * 32, 0, 32);
+            present[item] = 0;
+          } else {
+            memcpy(z + item * 32, digest, 32);
+            memcpy(r + item * 32, rbuf, 32);
+            memcpy(s + item * 32, sbuf, 32);
+            if (kdec[j] < 0)
+              kdec[j] = decode_pubkey(t.ms.keys[j], t.ms.key_len[j], kx[j],
+                                      ky[j])
+                            ? 1
+                            : 0;
+            present[item] = uint8_t(kdec[j]);
+            if (kdec[j]) {
+              memcpy(px + item * 32, kx[j], 32);
+              memcpy(py + item * 32, ky[j], 32);
+            } else {
+              memset(px + item * 32, 0, 32);
+              memset(py + item * 32, 0, 32);
+            }
+          }
+          item_tx[item] = int32_t(ti);
+          item_input[item] = int32_t(idx);
+          item_sig[item] = i;
+          item_key[item] = j;
+          item_nsigs[item] = m;
+          item_nkeys[item] = n;
+          ++item;
+        }
+      }
+      if (input_unsupported) {
+        item = input_start;  // roll back any emitted candidates
+        ++unsupported;
       } else {
-        legacy_sighash(tx, idx, script_code, 25, hashtype, scratch, digest);
+        ++extracted;
+        sigs += m;
       }
-      reduce_mod_n(digest);
-      if (item >= capacity) return -2;
-      memcpy(z + item * 32, digest, 32);
-      memcpy(r + item * 32, rbuf, 32);
-      memcpy(s + item * 32, sbuf, 32);
-      present[item] =
-          decode_pubkey(pub_blob, pub_len, px + item * 32, py + item * 32)
-              ? 1
-              : 0;
-      if (!present[item]) {
-        memset(px + item * 32, 0, 32);
-        memset(py + item * 32, 0, 32);
-      }
-      item_tx[item] = int32_t(ti);
-      item_input[item] = int32_t(idx);
-      ++item;
-      ++extracted;
     }
     tx_n_inputs[ti] = n_inputs;
     tx_extracted[ti] = extracted;
+    tx_items[ti] = int32_t(item - tx_item_start);
+    tx_sigs[ti] = sigs;
     tx_coinbase[ti] = coinbase;
     tx_unsupported[ti] = unsupported;
   }

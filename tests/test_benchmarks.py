@@ -34,16 +34,20 @@ def _run(config: str) -> dict:
 def test_config1_block_cpu_baseline():
     res = _run("config1")
     assert res["metric"] == "config1_block800k_cpu_verify"
-    assert res["value"] > 0 and res["sigs"] == 128
+    # mixed workload: sig count varies with the template mix, coverage must
+    # clear the VERDICT r3 item 3 bar (config asserts it too)
+    assert res["value"] > 0 and res["sigs"] > 0
+    assert res["coverage"] >= 0.90
+    assert res["candidates"] >= res["sigs"]  # multisig windows fan out
 
 
 def test_config3_ibd_replay():
     res = _run("config3")
     assert res["metric"] == "config3_ibd_replay"
-    assert res["blocks"] == 50 and res["height"] == 50
-    # 100 txs: every 4th is a P2WPKH spend (1 BIP143 sig via the intra-block
-    # amount), the rest are legacy with 2 sigs each -> 75*2 + 25*1
-    assert res["sigs"] == 75 * 2 + 25
+    assert res["blocks"] == 50
+    assert res["txs"] == 50 * 3  # 2 mixed txs + coinbase per block
+    assert res["sigs"] > 0 and res["sigs_per_sec"] > 0
+    assert res["coverage"] >= 0.90
 
 
 def test_config4_mempool_firehose():

@@ -118,7 +118,7 @@ async def test_engine_survives_stalled_device_warmup(monkeypatch):
 
     hang = threading.Event()
     monkeypatch.setattr(
-        VerifyEngine, "_warmup_fn", staticmethod(lambda bs: hang.wait(30) or "x")
+        VerifyEngine, "_warmup_fn", staticmethod(lambda bs, db=0: hang.wait(30) or "x")
     )
     cfg = VerifyConfig(backend="auto", max_wait=0.0, min_tpu_batch=1)
     async with VerifyEngine(cfg) as eng:
@@ -131,7 +131,7 @@ async def test_engine_survives_stalled_device_warmup(monkeypatch):
 
 @pytest.mark.asyncio
 async def test_engine_failed_warmup_falls_back(monkeypatch):
-    def boom(bs):
+    def boom(bs, db=0):
         raise RuntimeError("no TPU device visible")
 
     monkeypatch.setattr(VerifyEngine, "_warmup_fn", staticmethod(boom))
@@ -145,7 +145,7 @@ async def test_engine_failed_warmup_falls_back(monkeypatch):
 
 @pytest.mark.asyncio
 async def test_engine_forced_tpu_errors_when_unavailable(monkeypatch):
-    def boom(bs):
+    def boom(bs, db=0):
         raise RuntimeError("no TPU device visible")
 
     monkeypatch.setattr(VerifyEngine, "_warmup_fn", staticmethod(boom))

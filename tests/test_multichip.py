@@ -52,3 +52,41 @@ def test_sharded_submesh():
     assert mesh.devices.size == 4
     items, expect = make_items(8)
     assert verify_batch_sharded(items, mesh=mesh) == expect
+
+
+def test_pallas_kernel_inside_shard_map_interpret():
+    """Pin the Pallas-inside-shard_map path (VERDICT r3 item 7): the Mosaic
+    kernel in interpret mode, small block, on a 2-shard CPU mesh — so the
+    in_specs / per-shard BLOCK alignment logic of multichip.py is exercised
+    without TPU hardware."""
+    import numpy as np
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tpunode.verify.kernel import ARG_IS_2D, prepare_batch
+    from tpunode.verify.multichip import sharded_verify_fn
+
+    mesh = make_mesh(2)
+    block = 8
+    items, expect = make_items(2 * block)  # one block per shard
+    prep = prepare_batch(items, pad_to=2 * block)
+    fn = sharded_verify_fn(mesh, kernel="pallas", interpret=True, block=block)
+    shard_2d = NamedSharding(mesh, P(None, "batch"))
+    shard_1d = NamedSharding(mesh, P("batch"))
+    args = [
+        jax.device_put(np.asarray(a), shard_2d if is2d else shard_1d)
+        for a, is2d in zip(prep.device_args, ARG_IS_2D)
+    ]
+    ok, total = fn(*args)
+    got = [bool(b) for b in np.asarray(ok)]
+    assert got == expect
+    assert int(total) == sum(expect)
+    # padding path: 3 items over 2 shards pads each shard to one block
+    items3, expect3 = make_items(3)
+    prep3 = prepare_batch(items3, pad_to=2 * block)
+    args3 = [
+        jax.device_put(np.asarray(a), shard_2d if is2d else shard_1d)
+        for a, is2d in zip(prep3.device_args, ARG_IS_2D)
+    ]
+    ok3, total3 = fn(*args3)
+    assert [bool(b) for b in np.asarray(ok3)[:3]] == expect3
+    assert int(total3) == sum(expect3)  # padded lanes reject for free

@@ -410,10 +410,10 @@ async def test_block_ingest_native_path_matches_python():
     native_calls = 0
     orig = node_mod.Node._verify_txs_native
 
-    async def counting(self, peer, txs_, raw):
+    async def counting(self, peer, raw, n_txs, block=None, txs=None):
         nonlocal native_calls
         native_calls += 1
-        return await orig(self, peer, txs_, raw)
+        return await orig(self, peer, raw, n_txs, block=block, txs=txs)
 
     async def run(block_msg) -> dict[bytes, object]:
         pub = Publisher(name="node-events")
@@ -498,3 +498,108 @@ async def run_single(tx):
                 return await events.receive_match(
                     lambda ev: ev if isinstance(ev, TxVerdict) else None
                 )
+
+
+@pytest.mark.asyncio
+async def test_native_block_ingest_never_parses_txs_in_python():
+    """The lazy-block native path (LazyBlock + scan_prevouts) must produce
+    TxVerdicts for a block without a single Python Tx.deserialize call —
+    the round-4 fix for the IBD ingest bottleneck (VERDICT r3 item 2)."""
+    import tpunode.node as node_mod
+    import tpunode.wire as wire_mod
+    from benchmarks.txgen import gen_mixed_txs, synth_amount
+    from tpunode import TxVerdict
+    from tpunode.peer import PeerMessage
+    from tpunode.verify.engine import VerifyConfig
+    from tpunode.wire import Block, BlockHeader, MsgBlock
+
+    if not node_mod._native_extract_available():
+        pytest.skip("native extractor unavailable")
+
+    txs = gen_mixed_txs(10, seed=0xDEF)
+    hdr = BlockHeader(1, b"\x00" * 32, b"\x00" * 32, 0, 0x207FFFFF, 0)
+    raw_block = Block(hdr, tuple(txs)).serialize()
+    from tpunode.util import Reader
+
+    msg = MsgBlock.deserialize_payload(Reader(raw_block))
+
+    parses = 0
+    orig_deser = wire_mod.Tx.deserialize.__func__
+
+    @classmethod
+    def counting_deser(cls, r):
+        nonlocal parses
+        parses += 1
+        return orig_deser(cls, r)
+
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:17486"],
+        connect=lambda sa: dummy_peer_connect(NET, all_blocks()),
+        verify=VerifyConfig(backend="cpu", max_wait=0.0),
+        prevout_lookup=synth_amount,
+    )
+    seen = {}
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(15):
+                peer = await wait_for_peer(events)
+                wire_mod.Tx.deserialize = counting_deser
+                try:
+                    node._peer_pub.publish(PeerMessage(peer, msg))
+                    while len(seen) < len(txs):
+                        ev = await events.receive()
+                        if isinstance(ev, TxVerdict):
+                            seen[ev.txid] = ev
+                finally:
+                    wire_mod.Tx.deserialize = classmethod(orig_deser)
+    assert parses == 0, f"block ingest parsed {parses} txs in Python"
+    assert {tx.txid for tx in txs} == set(seen)
+    # verdicts are real: the mixed workload's supported txs verify fully
+    for tx in txs:
+        ev = seen[tx.txid]
+        assert ev.error is None
+        if ev.stats.unsupported == 0:
+            assert ev.valid, tx.txid.hex()
+
+
+@pytest.mark.asyncio
+async def test_malformed_lazy_block_kills_peer_not_node():
+    """A block whose envelope decodes but whose tx region is malformed used
+    to die in eager decode; with lazy blocks it surfaces in verify ingest —
+    which must publish an error TxVerdict and kill the peer, never crash
+    the event router (code-review r4 finding 1)."""
+    from tpunode import TxVerdict
+    from tpunode.peer import PeerDisconnected, PeerMessage
+    from tpunode.verify.engine import VerifyConfig
+    from tpunode.wire import BlockHeader, LazyBlock, MsgBlock
+
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:17486"],
+        connect=lambda sa: dummy_peer_connect(NET, all_blocks()),
+        verify=VerifyConfig(backend="cpu", max_wait=0.0),
+    )
+    hdr = BlockHeader(1, b"\x00" * 32, b"\x00" * 32, 0, 0x207FFFFF, 0)
+    bad = MsgBlock(LazyBlock(hdr, 3, b"\x01\x02\x03"))  # truncated region
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(15):
+                peer = await wait_for_peer(events)
+                node._peer_pub.publish(PeerMessage(peer, bad))
+                saw_error = saw_disconnect = False
+                while not (saw_error and saw_disconnect):
+                    ev = await events.receive()
+                    if isinstance(ev, TxVerdict):
+                        assert ev.error is not None and not ev.valid
+                        saw_error = True
+                    elif isinstance(ev, PeerDisconnected):
+                        saw_disconnect = True
+                # node is still alive and queryable after the bad peer died
+                assert node.chain.get_best() is not None

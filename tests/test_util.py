@@ -73,3 +73,54 @@ def test_compact_bits_negative_is_zero():
 )
 def test_compact_bits_roundtrip_real_values(bits):
     assert target_to_bits(bits_to_target(bits)) == bits
+
+
+# --- varint minimality (Core ReadCompactSize) -----------------------------
+
+
+def test_varint_minimal_roundtrip():
+    from tpunode.util import Reader, write_varint
+
+    for v in (0, 1, 0xFC, 0xFD, 0xFFFF, 0x10000, 0xFFFFFFFF, 0x100000000):
+        r = Reader(write_varint(v))
+        assert r.varint() == v and r.remaining() == 0
+
+
+@pytest.mark.parametrize(
+    "enc",
+    [
+        b"\xfd\x01\x00",  # 1 encoded in 3 bytes
+        b"\xfd\xfc\x00",  # 0xFC encoded with 0xFD prefix
+        b"\xfe\xff\xff\x00\x00",  # 0xFFFF encoded in 5 bytes
+        b"\xff\x01\x00\x00\x00\x00\x00\x00\x00",  # 1 encoded in 9 bytes
+    ],
+)
+def test_varint_non_minimal_rejected(enc):
+    """A hostile peer re-encoding e.g. an input count non-minimally would
+    give raw-span hashers a different txid than canonical re-serializers;
+    both paths reject (ADVICE r3, Core ReadCompactSize)."""
+    from tpunode.util import Reader
+
+    with pytest.raises(ValueError):
+        Reader(enc).varint()
+
+
+def test_tx_with_non_minimal_input_count_rejected_both_paths():
+    from benchmarks.txgen import gen_signed_txs
+    from tpunode.util import Reader
+    from tpunode.wire import Tx
+
+    tx = gen_signed_txs(1, inputs_per_tx=2, seed=99)[0]
+    raw = tx.serialize()
+    assert raw[4] == 2  # input count varint
+    bad = raw[:4] + b"\xfd\x02\x00" + raw[5:]
+    with pytest.raises(ValueError):
+        Tx.deserialize(Reader(bad))
+    try:
+        from tpunode.txextract import extract_raw, have_native_extract
+    except Exception:
+        return
+    if have_native_extract():
+        assert extract_raw(raw, 1).n_txs == 1  # canonical form still parses
+        with pytest.raises(ValueError):
+            extract_raw(bad, 1)

@@ -178,3 +178,41 @@ def test_segwit_tx_roundtrip():
     # txid excludes witness data
     assert tx.txid == double_sha256(tx.serialize(include_witness=False))
     assert tx.wtxid != tx.txid
+
+
+def test_msgblock_decodes_lazily():
+    """MsgBlock decode must not parse txs (wire.LazyBlock): the tx region
+    stays raw until .txs is touched, then parses to exactly the eager form."""
+    from benchmarks.txgen import gen_signed_txs
+    from tpunode.params import BCH_REGTEST as NET
+    from tpunode.util import Reader
+    from tpunode.wire import (
+        Block,
+        BlockHeader,
+        LazyBlock,
+        MsgBlock,
+        decode_message,
+        decode_message_header,
+        encode_message,
+    )
+
+    txs = gen_signed_txs(4, inputs_per_tx=2, seed=0x1A2)
+    hdr = BlockHeader(1, b"\x11" * 32, b"\x22" * 32, 5, 0x207FFFFF, 9)
+    built = Block(hdr, tuple(txs))
+    raw = encode_message(NET, MsgBlock(built))
+    mh = decode_message_header(NET, raw[:24])
+    msg = decode_message(NET, mh, raw[24:])
+    assert isinstance(msg.block, LazyBlock)
+    assert "txs" not in msg.block.__dict__  # not parsed yet
+    assert msg.block.tx_count == 4
+    assert msg.block.serialize() == built.serialize()  # no parse needed
+    assert "txs" not in msg.block.__dict__
+    assert msg.block.txs == built.txs  # parses on demand
+    assert msg.block == built
+
+    # malformed tx region: decode succeeds, .txs raises
+    bad = LazyBlock(hdr, 4, msg.block.raw_txs[:-3])
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        bad.txs

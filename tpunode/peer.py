@@ -27,6 +27,7 @@ from .util import hash_to_hex
 from .wire import (
     Block,
     DecodeError,
+    LazyBlock,
     InvType,
     InvVector,
     MAX_PAYLOAD,
@@ -407,7 +408,7 @@ async def get_blocks(
     """Fetch full blocks by hash (reference Peer.hs:309-324)."""
     t = InvType.WITNESS_BLOCK if net.segwit else InvType.BLOCK
     out = await get_data(seconds, p, [InvVector(t, h) for h in block_hashes])
-    if out is None or not all(isinstance(x, Block) for x in out):
+    if out is None or not all(isinstance(x, (Block, LazyBlock)) for x in out):
         return None
     return out  # type: ignore[return-value]
 

@@ -27,7 +27,13 @@ import numpy as np
 
 from .txverify import ExtractStats
 
-__all__ = ["RawSigItems", "extract_raw", "load_txextract_lib", "have_native_extract"]
+__all__ = [
+    "RawSigItems",
+    "extract_raw",
+    "scan_prevouts",
+    "load_txextract_lib",
+    "have_native_extract",
+]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libtxextract.so")
@@ -80,11 +86,28 @@ def load_txextract_lib() -> ctypes.CDLL:
             u8,  # present
             i32,  # item_tx
             i32,  # item_input
+            i32,  # item_sig
+            i32,  # item_key
+            i32,  # item_nsigs
+            i32,  # item_nkeys
             u8,  # txids
             i32,  # tx_n_inputs
             i32,  # tx_extracted
+            i32,  # tx_items
+            i32,  # tx_sigs
             i32,  # tx_coinbase
             i32,  # tx_unsupported
+        ]
+        lib.txx_prevouts.restype = ctypes.c_long
+        lib.txx_prevouts.argtypes = [
+            ctypes.c_char_p,  # data
+            ctypes.c_long,  # len
+            ctypes.c_long,  # tx_count
+            ctypes.c_int,  # bch
+            ctypes.c_long,  # capacity
+            u8,  # txids (capacity, 32)
+            i64,  # vouts (int64: vout >= 2^31 must not go negative)
+            u8,  # wants
         ]
         lib._ext_amounts_t = i64  # kept for callers building arrays
         _lib = lib
@@ -111,9 +134,14 @@ class RawSigItems:
 
     Item rows (``count`` of each): ``z``/``px``/``py``/``r``/``s`` are
     ``(count, 32)`` uint8 big-endian; ``present[i] == 0`` marks an
-    auto-invalid item (undecodable pubkey — the None-pubkey analog).
-    ``item_tx``/``item_input`` locate each item; per-tx arrays carry txids
-    and the ExtractStats counters.
+    auto-invalid item (undecodable pubkey — the None-pubkey analog — or an
+    unparseable multisig signature).  ``item_tx``/``item_input`` locate
+    each item; ``item_sig``/``item_key``/``item_nsigs``/``item_nkeys``
+    mirror SigItem's multisig-candidate fields (0/0/1/1 for single-sig
+    items) — collapse device verdicts to per-signature verdicts with
+    :meth:`combine`.  Per-tx arrays carry txids and the ExtractStats
+    counters (``tx_extracted`` counts inputs, ``tx_items`` device items,
+    ``tx_sigs`` signatures).
     """
 
     count: int
@@ -125,9 +153,15 @@ class RawSigItems:
     present: np.ndarray
     item_tx: np.ndarray
     item_input: np.ndarray
+    item_sig: np.ndarray
+    item_key: np.ndarray
+    item_nsigs: np.ndarray
+    item_nkeys: np.ndarray
     txids: np.ndarray  # (n_txs, 32)
     tx_n_inputs: np.ndarray
     tx_extracted: np.ndarray
+    tx_items: np.ndarray
+    tx_sigs: np.ndarray
     tx_coinbase: np.ndarray
     tx_unsupported: np.ndarray
 
@@ -147,13 +181,49 @@ class RawSigItems:
             extracted=int(self.tx_extracted[tx_index]),
             coinbase=int(self.tx_coinbase[tx_index]),
             unsupported=int(self.tx_unsupported[tx_index]),
+            sigs=int(self.tx_sigs[tx_index]),
+            candidates=int(self.tx_items[tx_index]),
         )
 
     def tx_slices(self) -> list[slice]:
-        """Per-tx item ranges (items are emitted in (tx, input) order)."""
+        """Per-tx ITEM ranges (items are emitted in (tx, input) order)."""
         bounds = np.zeros(self.n_txs + 1, np.int64)
-        np.cumsum(self.tx_extracted, out=bounds[1:])
+        np.cumsum(self.tx_items, out=bounds[1:])
         return [slice(int(bounds[i]), int(bounds[i + 1])) for i in range(self.n_txs)]
+
+    def sig_slices(self) -> list[slice]:
+        """Per-tx SIGNATURE ranges within :meth:`combine`'s output."""
+        bounds = np.zeros(self.n_txs + 1, np.int64)
+        np.cumsum(self.tx_sigs, out=bounds[1:])
+        return [slice(int(bounds[i]), int(bounds[i + 1])) for i in range(self.n_txs)]
+
+    def combine(self, verdicts) -> list[bool]:
+        """Collapse per-candidate verdicts to per-signature verdicts (one
+        entry per extracted signature, in item order) — the array twin of
+        txverify.combine_verdicts, sharing its consensus walk."""
+        from .txverify import msig_match
+
+        out: list[bool] = []
+        k = 0
+        N = self.count
+        nsigs = self.item_nsigs
+        nkeys = self.item_nkeys
+        while k < N:
+            m = int(nsigs[k])
+            n = int(nkeys[k])
+            if m == 1 and n == 1:
+                out.append(bool(verdicts[k]))
+                k += 1
+                continue
+            span = m * (n - m + 1)
+            M: dict[tuple[int, int], bool] = {}
+            for idx in range(k, k + span):
+                M[(int(self.item_sig[idx]), int(self.item_key[idx]))] = bool(
+                    verdicts[idx]
+                )
+            out.extend(msig_match(m, n, lambda i, j: M.get((i, j), False)))
+            k += span
+        return out
 
     def to_verify_items(self):
         """Convert to the engine's ``VerifyItem`` tuples — for the oracle
@@ -180,6 +250,29 @@ class RawSigItems:
         return items
 
 
+def scan_prevouts(
+    data: bytes, tx_count: int = -1, bch: bool = False
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-input prevout rows for ``tx_count`` serialized txs, in flat
+    parse order (coinbase rows included so indices align with
+    ``extract_raw``'s ``ext_amounts``): ``(txids (N,32) uint8, vouts
+    (N,) int64, wants (N,) uint8)``.  ``wants[i]`` marks inputs whose
+    template could consume a BIP143 amount — the only rows worth a
+    ``prevout_lookup`` call.  Raises ValueError on malformed data."""
+    lib = load_txextract_lib()
+    capacity = max(1, len(data) // 41 + 1)  # an input is >= 41 wire bytes
+    txids = np.zeros((capacity, 32), np.uint8)
+    vouts = np.zeros(capacity, np.int64)
+    wants = np.zeros(capacity, np.uint8)
+    n = lib.txx_prevouts(
+        data, len(data), tx_count, 1 if bch else 0, capacity,
+        txids, vouts, wants,
+    )
+    if n < 0:
+        raise ValueError(f"txx_prevouts failed ({n})")
+    return txids[:n], vouts[:n], wants[:n]
+
+
 def extract_raw(
     data: bytes,
     tx_count: int = -1,
@@ -199,11 +292,11 @@ def extract_raw(
     Raises ValueError on malformed data.
     """
     lib = load_txextract_lib()
-    n_inputs = ctypes.c_long()
-    n_txs = lib.txx_scan(data, len(data), tx_count, ctypes.byref(n_inputs))
+    cap = ctypes.c_long()
+    n_txs = lib.txx_scan(data, len(data), tx_count, ctypes.byref(cap))
     if n_txs < 0:
         raise ValueError("malformed transaction data")
-    capacity = max(1, n_inputs.value)
+    capacity = max(1, cap.value)
     nt = max(1, n_txs)
     out = RawSigItems(
         count=0,
@@ -215,9 +308,15 @@ def extract_raw(
         present=np.zeros(capacity, np.uint8),
         item_tx=np.zeros(capacity, np.int32),
         item_input=np.zeros(capacity, np.int32),
+        item_sig=np.zeros(capacity, np.int32),
+        item_key=np.zeros(capacity, np.int32),
+        item_nsigs=np.zeros(capacity, np.int32),
+        item_nkeys=np.zeros(capacity, np.int32),
         txids=np.zeros((nt, 32), np.uint8),
         tx_n_inputs=np.zeros(nt, np.int32),
         tx_extracted=np.zeros(nt, np.int32),
+        tx_items=np.zeros(nt, np.int32),
+        tx_sigs=np.zeros(nt, np.int32),
         tx_coinbase=np.zeros(nt, np.int32),
         tx_unsupported=np.zeros(nt, np.int32),
     )
@@ -236,19 +335,25 @@ def extract_raw(
         data, len(data), n_txs, flags, ext_ptr, n_ext, capacity,
         out.z, out.px, out.py, out.r, out.s, out.present,
         out.item_tx, out.item_input,
+        out.item_sig, out.item_key, out.item_nsigs, out.item_nkeys,
         out.txids, out.tx_n_inputs, out.tx_extracted,
+        out.tx_items, out.tx_sigs,
         out.tx_coinbase, out.tx_unsupported,
     )
     if count < 0:
         raise ValueError(f"txx_extract failed ({count})")
     # trim to the actual item count (views, no copies)
     out.count = int(count)
-    for name in ("z", "px", "py", "r", "s"):
+    for name in (
+        "z", "px", "py", "r", "s", "present",
+        "item_tx", "item_input", "item_sig", "item_key",
+        "item_nsigs", "item_nkeys",
+    ):
         setattr(out, name, getattr(out, name)[:count])
-    out.present = out.present[:count]
-    out.item_tx = out.item_tx[:count]
-    out.item_input = out.item_input[:count]
     # per-tx arrays keep their true n_txs length
-    for name in ("txids", "tx_n_inputs", "tx_extracted", "tx_coinbase", "tx_unsupported"):
+    for name in (
+        "txids", "tx_n_inputs", "tx_extracted", "tx_items", "tx_sigs",
+        "tx_coinbase", "tx_unsupported",
+    ):
         setattr(out, name, getattr(out, name)[:n_txs])
     return out

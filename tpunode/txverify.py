@@ -12,6 +12,17 @@ tuples for the batch verify engine:
 * **P2WPKH** — witness is ``[DER-sig, pubkey]``; BIP143 needs the input
   amount, so these become items only when the caller can supply amounts
   (``prevout_amounts``).
+* **P2SH-P2WPKH** — scriptSig is one push of the ``0x0014<h160>`` redeem
+  script, witness ``[DER-sig, pubkey]``; same BIP143 digest as P2WPKH.
+* **P2SH multisig** — scriptSig is ``OP_0 <sig>*m <redeemScript>`` where
+  the redeem script is ``OP_m <key>*n OP_n OP_CHECKMULTISIG``; each sig is
+  dispatched as up to ``n-m+1`` candidate (sig, key) pairs, and per-sig
+  validity comes out of the consensus matching walk (:func:`combine_verdicts`)
+  over the batch verdicts — the matching that OP_CHECKMULTISIG does serially,
+  done data-parallel.
+* **P2WSH multisig** (and **P2SH-P2WSH**) — witness is
+  ``[<empty>, <sig>*m, witnessScript]`` with the same multisig template;
+  BIP143 digests, so amounts are required.
 
 Inputs that don't match a computable template are counted, not verified —
 this engine is a streaming signature pre-verifier (the reference node doesn't
@@ -23,7 +34,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional, Sequence
 
 from .sighash import SIGHASH_FORKID, bip143_sighash, legacy_sighash
 from .verify.ecdsa_cpu import Point, decode_pubkey, parse_der_signature
@@ -35,18 +46,20 @@ __all__ = [
     "ExtractStats",
     "intra_block_amounts",
     "wants_amount",
+    "combine_verdicts",
+    "msig_match",
 ]
 
 
 def wants_amount(tx: Tx, idx: int, bch: bool) -> bool:
-    """Could input ``idx`` consume a BIP143 prevout amount?  True for the
-    P2WPKH witness shape and for any input on a FORKID (BCH) network;
-    legacy inputs elsewhere never use amounts, so callers can skip their
-    (possibly expensive) amount lookups."""
+    """Could input ``idx`` consume a BIP143 prevout amount?  True for any
+    input carrying a witness (every segwit template digests BIP143) and for
+    any input on a FORKID (BCH) network; legacy non-FORKID inputs never use
+    amounts, so callers can skip their (possibly expensive) amount lookups."""
     if bch:
         return True
     wit = tx.witnesses[idx] if idx < len(tx.witnesses) else ()
-    return not tx.inputs[idx].script and len(wit) == 2
+    return len(wit) >= 2
 
 
 def intra_block_amounts(txs) -> dict[tuple[bytes, int], int]:
@@ -67,7 +80,14 @@ def _hash160(b: bytes) -> bytes:
 
 @dataclass(frozen=True)
 class SigItem:
-    """One verifiable signature: inputs to ECDSA verify."""
+    """One device verify candidate: inputs to ECDSA verify.
+
+    Single-sig templates produce exactly one item per signature.  Multisig
+    inputs produce one item per candidate (signature, key) pair —
+    ``sig_index``/``key_index`` locate the pair, ``num_sigs``/``num_keys``
+    are the input's (m, n) — and :func:`combine_verdicts` collapses the
+    candidates back to per-signature verdicts via the consensus walk.
+    """
 
     pubkey: Optional[Point]  # None = undecodable key (auto-invalid)
     z: int  # sighash digest
@@ -75,26 +95,41 @@ class SigItem:
     s: int
     txid: bytes
     input_index: int
+    sig_index: int = 0
+    key_index: int = 0
+    num_sigs: int = 1
+    num_keys: int = 1
 
 
 @dataclass
 class ExtractStats:
     total_inputs: int = 0
-    extracted: int = 0
+    extracted: int = 0  # inputs whose signatures became verify items
     coinbase: int = 0
     unsupported: int = 0
+    sigs: int = 0  # actual signatures extracted (m per multisig input)
+    candidates: int = 0  # device items (> sigs when multisig windows fan out)
+
+    @property
+    def coverage(self) -> float:
+        """Extracted fraction of the signature-bearing inputs."""
+        denom = self.total_inputs - self.coinbase
+        return self.extracted / denom if denom else 1.0
 
 
 def _parse_pushes(script: bytes) -> Optional[list[bytes]]:
-    """Parse a script consisting only of plain data pushes (opcodes 1-75 and
-    PUSHDATA1/2); returns None if anything else appears."""
+    """Parse a script consisting only of plain data pushes (OP_0, opcodes
+    1-75 and PUSHDATA1/2); returns None if anything else appears.  OP_0
+    parses as an empty push (the CHECKMULTISIG dummy)."""
     out = []
     i = 0
     n = len(script)
     while i < n:
         op = script[i]
         i += 1
-        if 1 <= op <= 75:
+        if op == 0:  # OP_0: empty push (multisig dummy element)
+            ln = 0
+        elif 1 <= op <= 75:
             ln = op
         elif op == 76 and i < n:  # OP_PUSHDATA1
             ln = script[i]
@@ -111,8 +146,43 @@ def _parse_pushes(script: bytes) -> Optional[list[bytes]]:
     return out
 
 
+def _parse_multisig(script: bytes) -> Optional[tuple[int, list[bytes]]]:
+    """Parse the bare multisig template ``OP_m <key>*n OP_n OP_CHECKMULTISIG``
+    (keys 33 or 65 bytes); returns (m, keys) or None."""
+    if len(script) < 3 or script[-1] != 0xAE:  # OP_CHECKMULTISIG
+        return None
+    n_op, m_op = script[-2], script[0]
+    if not (0x51 <= n_op <= 0x60 and 0x51 <= m_op <= 0x60):
+        return None
+    n, m = n_op - 0x50, m_op - 0x50
+    if m > n:
+        return None
+    keys = []
+    i, end = 1, len(script) - 2
+    while i < end:
+        ln = script[i]
+        i += 1
+        if ln not in (33, 65) or i + ln > end:
+            return None
+        keys.append(script[i : i + ln])
+        i += ln
+    if len(keys) != n:
+        return None
+    return m, keys
+
+
 def _p2pkh_script_code(pubkey: bytes) -> bytes:
     return b"\x76\xa9\x14" + _hash160(pubkey) + b"\x88\xac"
+
+
+def _is_multisig_witness(wit: tuple) -> Optional[tuple[int, list[bytes]]]:
+    """P2WSH multisig witness shape: [<empty dummy>, <sig>*m, script]."""
+    if len(wit) < 3 or wit[0] != b"":
+        return None
+    ms = _parse_multisig(wit[-1])
+    if ms is None or len(wit) - 2 != ms[0]:
+        return None
+    return ms
 
 
 def extract_sig_items(
@@ -122,41 +192,74 @@ def extract_sig_items(
 ) -> tuple[list[SigItem], ExtractStats]:
     """Extract batch-verifiable signatures from one transaction.
 
-    ``prevout_amounts`` maps input index -> satoshi amount (enables P2WPKH).
-    ``bch`` selects the FORKID (BIP143-style) digest for legacy templates.
+    ``prevout_amounts`` maps input index -> satoshi amount (enables the
+    BIP143 templates: P2WPKH, P2SH-P2WPKH, P2WSH).  ``bch`` selects the
+    FORKID (BIP143-style) digest for legacy templates.
     """
     items: list[SigItem] = []
     stats = ExtractStats()
-    txid = tx.txid
     for idx, txin in enumerate(tx.inputs):
         stats.total_inputs += 1
         if txin.prevout.txid == b"\x00" * 32:
             stats.coinbase += 1
             continue
-        # P2WPKH: empty scriptSig, two-element witness
         wit = tx.witnesses[idx] if idx < len(tx.witnesses) else ()
+        new: Optional[list[SigItem]] = None
         if not txin.script and len(wit) == 2:
-            sig_blob, pub_blob = wit
-            parsed = _try_item(tx, idx, sig_blob, pub_blob, prevout_amounts, bch, segwit=True)
-            if parsed is not None:
-                items.append(parsed)
-                stats.extracted += 1
-                continue
+            # P2WPKH: empty scriptSig, [sig, pubkey] witness
+            new = _single_item(tx, idx, wit[0], wit[1], prevout_amounts, bch,
+                               segwit=True)
+        elif not txin.script and (ms := _is_multisig_witness(wit)):
+            # P2WSH multisig
+            new = _msig_items(tx, idx, list(wit[1:-1]), ms[0], ms[1], wit[-1],
+                              prevout_amounts, bch, segwit=True)
+        else:
+            pushes = _parse_pushes(txin.script)
+            if pushes is None:
+                pass
+            elif len(pushes) == 2 and len(pushes[1]) in (33, 65):
+                # P2PKH: scriptSig = <sig> <pubkey>
+                new = _single_item(tx, idx, pushes[0], pushes[1],
+                                   prevout_amounts, bch, segwit=False)
+            elif (
+                len(pushes) == 1
+                and len(pushes[0]) == 22
+                and pushes[0][:2] == b"\x00\x14"
+                and len(wit) == 2
+            ):
+                # P2SH-P2WPKH: redeem = v0 keyhash program, witness as P2WPKH
+                new = _single_item(tx, idx, wit[0], wit[1], prevout_amounts,
+                                   bch, segwit=True)
+            elif (
+                len(pushes) == 1
+                and len(pushes[0]) == 34
+                and pushes[0][:2] == b"\x00\x20"
+                and (ms := _is_multisig_witness(wit))
+            ):
+                # P2SH-P2WSH multisig
+                new = _msig_items(tx, idx, list(wit[1:-1]), ms[0], ms[1],
+                                  wit[-1], prevout_amounts, bch, segwit=True)
+            elif (
+                len(pushes) >= 2
+                and pushes[0] == b""
+                and (ms := _parse_multisig(pushes[-1])) is not None
+                and len(pushes) - 2 == ms[0]
+            ):
+                # P2SH multisig: OP_0 <sig>*m <redeemScript>
+                new = _msig_items(tx, idx, pushes[1:-1], ms[0], ms[1],
+                                  pushes[-1], prevout_amounts, bch,
+                                  segwit=False)
+        if new is None:
             stats.unsupported += 1
-            continue
-        # P2PKH: scriptSig = <sig> <pubkey>
-        pushes = _parse_pushes(txin.script)
-        if pushes and len(pushes) == 2 and len(pushes[1]) in (33, 65):
-            parsed = _try_item(tx, idx, pushes[0], pushes[1], prevout_amounts, bch, segwit=False)
-            if parsed is not None:
-                items.append(parsed)
-                stats.extracted += 1
-                continue
-        stats.unsupported += 1
+        else:
+            items.extend(new)
+            stats.extracted += 1
+            stats.sigs += new[0].num_sigs if new else 0
+            stats.candidates += len(new)
     return items, stats
 
 
-def _try_item(
+def _single_item(
     tx: Tx,
     idx: int,
     sig_blob: bytes,
@@ -164,7 +267,7 @@ def _try_item(
     prevout_amounts: Optional[dict[int, int]],
     bch: bool,
     segwit: bool,
-) -> Optional[SigItem]:
+) -> Optional[list[SigItem]]:
     if len(sig_blob) < 9:
         return None
     hashtype = sig_blob[-1]
@@ -180,4 +283,103 @@ def _try_item(
     else:
         z = legacy_sighash(tx, idx, script_code, hashtype)
     pub = decode_pubkey(pub_blob)
-    return SigItem(pubkey=pub, z=z, r=r, s=s, txid=tx.txid, input_index=idx)
+    return [SigItem(pubkey=pub, z=z, r=r, s=s, txid=tx.txid, input_index=idx)]
+
+
+def _msig_items(
+    tx: Tx,
+    idx: int,
+    sigs: list[bytes],
+    m: int,
+    keys: list[bytes],
+    script_code: bytes,
+    prevout_amounts: Optional[dict[int, int]],
+    bch: bool,
+    segwit: bool,
+) -> Optional[list[SigItem]]:
+    """Candidate items for one m-of-n input: sig i against keys
+    ``i..n-m+i`` (the only keys the order-preserving consensus walk can
+    pair it with).  A DER-unparseable sig yields auto-invalid candidates
+    (it matches no key, exactly as in the interpreter).  Returns None —
+    whole input unsupported — only when a required amount is missing."""
+    n = len(keys)
+    txid = tx.txid
+    out: list[SigItem] = []
+    decoded = [None] * n  # decode each key once, lazily
+    for i, sig_blob in enumerate(sigs):
+        rs = None
+        z = 0
+        if len(sig_blob) >= 9:
+            hashtype = sig_blob[-1]
+            rs = parse_der_signature(sig_blob[:-1])
+            if rs is not None:
+                if segwit or (bch and hashtype & SIGHASH_FORKID):
+                    if prevout_amounts is None or idx not in prevout_amounts:
+                        return None
+                    z = bip143_sighash(
+                        tx, idx, script_code, prevout_amounts[idx], hashtype
+                    )
+                else:
+                    z = legacy_sighash(tx, idx, script_code, hashtype)
+        for j in range(i, n - m + i + 1):
+            if rs is None:
+                item = SigItem(None, 0, 0, 0, txid, idx, i, j, m, n)
+            else:
+                if decoded[j] is None:
+                    decoded[j] = decode_pubkey(keys[j])
+                item = SigItem(
+                    decoded[j], z, rs[0], rs[1], txid, idx, i, j, m, n
+                )
+            out.append(item)
+    return out
+
+
+def msig_match(m: int, n: int, ok: Callable[[int, int], bool]) -> list[bool]:
+    """The consensus CHECKMULTISIG matching walk (Bitcoin Core
+    interpreter.cpp OP_CHECKMULTISIG): compare from the top of the stack —
+    last signature against last key — discarding a key on mismatch, and
+    fail once the signatures left outnumber the keys left.  ``ok(i, j)``
+    is the verify verdict for (sig i, key j); returns per-sig matched
+    flags (the input is valid iff all are True)."""
+    matched = [False] * m
+    i, j = m - 1, n - 1
+    while i >= 0 and j >= i:
+        if ok(i, j):
+            matched[i] = True
+            i -= 1
+        j -= 1
+    return matched
+
+
+def combine_verdicts(
+    items: Sequence[SigItem], verdicts: Sequence[bool]
+) -> list[bool]:
+    """Collapse per-candidate device verdicts to per-SIGNATURE verdicts, in
+    item order: single-sig items pass through; each multisig input's
+    candidate block runs the consensus walk.  ``len(result)`` equals the
+    extraction's ``stats.sigs``."""
+    out: list[bool] = []
+    k = 0
+    N = len(items)
+    while k < N:
+        it = items[k]
+        if it.num_sigs == 1 and it.num_keys == 1:
+            out.append(bool(verdicts[k]))
+            k += 1
+            continue
+        M: dict[tuple[int, int], bool] = {}
+        end = k
+        while (
+            end < N
+            and items[end].input_index == it.input_index
+            and items[end].txid == it.txid
+        ):
+            M[(items[end].sig_index, items[end].key_index)] = bool(
+                verdicts[end]
+            )
+            end += 1
+        out.extend(
+            msig_match(it.num_sigs, it.num_keys, lambda i, j: M.get((i, j), False))
+        )
+        k = end
+    return out

@@ -116,14 +116,25 @@ class Reader:
         return int.from_bytes(self.read(2), "big")
 
     def varint(self) -> int:
+        # Non-minimal encodings are rejected (Bitcoin Core ReadCompactSize):
+        # a hostile peer encoding e.g. an input count as fd 01 00 would
+        # otherwise produce a different txid/sighash on paths that hash raw
+        # spans than on paths that re-serialize canonically.
         first = self.u8()
         if first < 0xFD:
             return first
         if first == 0xFD:
-            return self.u16()
-        if first == 0xFE:
-            return self.u32()
-        return self.u64()
+            v = self.u16()
+            lo = 0xFD
+        elif first == 0xFE:
+            v = self.u32()
+            lo = 0x10000
+        else:
+            v = self.u64()
+            lo = 0x100000000
+        if v < lo:
+            raise ValueError(f"non-minimal varint: {v} encoded with 0x{first:02x}")
+        return v
 
     def varstr(self) -> bytes:
         return self.read(self.varint())

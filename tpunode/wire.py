@@ -37,6 +37,7 @@ __all__ = [
     "Tx",
     "BlockHeader",
     "Block",
+    "LazyBlock",
     "MsgVersion",
     "MsgVerAck",
     "MsgPing",
@@ -353,6 +354,10 @@ class Block:
     # extract fast path without re-serializing.  Not part of value identity.
     raw_txs: Optional[bytes] = field(default=None, compare=False, repr=False)
 
+    @property
+    def tx_count(self) -> int:
+        return len(self.txs)
+
     def serialize(self) -> bytes:
         return (
             self.header.serialize()
@@ -367,6 +372,53 @@ class Block:
         start = r.pos
         txs = tuple(Tx.deserialize(r) for _ in range(n))
         return cls(header, txs, raw_txs=r.slice_from(start))
+
+
+class LazyBlock:
+    """A block whose tx region stays raw wire bytes until ``.txs`` is
+    touched.  ``MsgBlock`` decodes to this, so receiving a full block
+    costs no Python tx parsing on the event loop: the verify-ingest fast
+    path hands ``raw_txs`` + ``tx_count`` straight to the native extractor
+    (tpunode/txextract.py), and only an embedder that actually reads
+    ``.txs`` pays the parse (which then validates the region fully and
+    yields exactly what an eager Block carries).
+
+    The reference parses every message eagerly in its conduit
+    (Peer.hs:247-279) because its node never looks inside block bodies at
+    all; this framework's north-star hook does, and at spec rates (32 MB
+    blocks, ~150k sigs) eager Python parsing was the round-3 IBD
+    bottleneck (PERF.md gap analysis).
+    """
+
+    def __init__(self, header: BlockHeader, tx_count: int, raw_txs: bytes):
+        self.header = header
+        self.tx_count = tx_count
+        self.raw_txs = raw_txs
+
+    @cached_property
+    def txs(self) -> tuple[Tx, ...]:
+        r = Reader(self.raw_txs)
+        txs = tuple(Tx.deserialize(r) for _ in range(self.tx_count))
+        if r.remaining():
+            raise ValueError("trailing bytes in block tx region")
+        return txs
+
+    def serialize(self) -> bytes:
+        return (
+            self.header.serialize()
+            + write_varint(self.tx_count)
+            + self.raw_txs
+        )
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, (Block, LazyBlock))
+            and self.header == other.header
+            and self.txs == tuple(other.txs)
+        )
+
+    def __repr__(self) -> str:
+        return f"LazyBlock(header={self.header!r}, tx_count={self.tx_count})"
 
 
 def build_merkle_root(txids: list[bytes]) -> bytes:
@@ -617,14 +669,18 @@ class MsgHeaders:
 @dataclass(frozen=True)
 class MsgBlock:
     command = "block"
-    block: Block
+    block: "Block | LazyBlock"
 
     def serialize_payload(self) -> bytes:
         return self.block.serialize()
 
     @classmethod
     def deserialize_payload(cls, r: Reader) -> "MsgBlock":
-        return cls(Block.deserialize(r))
+        # Lazy: the tx region is the rest of the payload by definition, so
+        # no parsing happens here (see LazyBlock).
+        header = BlockHeader.deserialize(r)
+        n = r.varint()
+        return cls(LazyBlock(header, n, r.read(r.remaining())))
 
 
 @dataclass(frozen=True)
