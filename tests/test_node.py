@@ -461,17 +461,25 @@ async def test_block_ingest_native_path_matches_python():
         invalid_seen |= not nv.valid
     assert invalid_seen, "fixture must exercise invalid signatures"
 
-    # mempool path: a wire-round-tripped tx goes native too
+    # mempool path: a wire-round-tripped tx rides the native batch
+    # accumulator (round 4), not the per-message python path
     one = Tx.deserialize(Reader(txs[0].serialize()))
     assert one.raw is not None
-    node_mod.Node._verify_txs_native = counting
+    drain_calls = 0
+    orig_drain = node_mod.Node._drain_tx_accum
+
+    async def counting_drain(self):
+        nonlocal drain_calls
+        drain_calls += 1
+        return await orig_drain(self)
+
+    node_mod.Node._drain_tx_accum = counting_drain
     try:
-        native_calls = 0
         got = await run_single(one)
-        assert native_calls == 1
+        assert drain_calls == 1
         assert got.valid is not None
     finally:
-        node_mod.Node._verify_txs_native = orig
+        node_mod.Node._drain_tx_accum = orig_drain
 
 
 async def run_single(tx):
@@ -603,3 +611,62 @@ async def test_malformed_lazy_block_kills_peer_not_node():
                         saw_disconnect = True
                 # node is still alive and queryable after the bad peer died
                 assert node.chain.get_best() is not None
+
+
+@pytest.mark.asyncio
+async def test_tx_accumulator_isolates_malformed_tx():
+    """The mempool accumulator batches many tx messages into one native
+    extract; a malformed tx must fail only itself (its peer dies, its
+    verdict is an error) while the rest of the batch still verdicts."""
+    import tpunode.node as node_mod
+    from benchmarks.txgen import gen_mixed_txs, synth_amount
+    from tpunode import TxVerdict
+    from tpunode.peer import PeerDisconnected, PeerMessage
+    from tpunode.util import Reader
+    from tpunode.verify.engine import VerifyConfig
+    from tpunode.wire import LazyTx, MsgTx
+
+    if not node_mod._native_extract_available():
+        pytest.skip("native extractor unavailable")
+
+    txs = gen_mixed_txs(8, seed=0xBAD)
+    good = [MsgTx.deserialize_payload(Reader(t.serialize())) for t in txs]
+    bad = MsgTx(LazyTx(b"\x01\x00\x00\x00\xff\xee"))  # malformed region
+
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:17486"],
+        connect=lambda sa: dummy_peer_connect(NET, all_blocks()),
+        verify=VerifyConfig(backend="cpu", max_wait=0.0),
+        prevout_lookup=synth_amount,
+    )
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(20):
+                peer = await wait_for_peer(events)
+                for m in good[:4]:
+                    node._peer_pub.publish(PeerMessage(peer, m))
+                node._peer_pub.publish(PeerMessage(peer, bad))
+                for m in good[4:]:
+                    node._peer_pub.publish(PeerMessage(peer, m))
+                seen = {}
+                err = None
+                disconnected = False
+                while len(seen) < len(txs) or err is None or not disconnected:
+                    ev = await events.receive()
+                    if isinstance(ev, TxVerdict):
+                        if ev.error is not None:
+                            err = ev
+                        else:
+                            seen[ev.txid] = ev
+                    elif isinstance(ev, PeerDisconnected):
+                        disconnected = True
+    assert {t.txid for t in txs} == set(seen)
+    for t in txs:
+        ev = seen[t.txid]
+        if ev.stats.unsupported == 0:
+            assert ev.valid
+    assert err.txid == b"" and "extract" in err.error

@@ -187,6 +187,12 @@ class Node:
             name="verify-ingest", on_death=self._verify_task_died
         )
         self._verify_pending = 0
+        # mempool-tx batch accumulator (see _submit_verify_tx)
+        self._tx_accum: list = []
+        self._tx_drain: Optional[asyncio.Task] = None
+        # shed-event aggregation (a flood must not also flood the bus)
+        self._shed_count = 0
+        self._shed_last_pub = 0.0
 
     @staticmethod
     def _verify_task_died(task, exc) -> None:
@@ -281,7 +287,7 @@ class Node:
                 elif isinstance(msg, MsgHeaders):
                     chain.headers(p, [h for h, _ in msg.headers])
                 elif self.verify_engine is not None and isinstance(msg, MsgTx):
-                    self._submit_verify(p, txs=[msg.tx], raw=msg.tx.raw)
+                    self._submit_verify_tx(p, msg.tx)
                 elif self.verify_engine is not None and isinstance(msg, MsgBlock):
                     # the block stays lazy (wire.LazyBlock): the native path
                     # never parses its txs in Python
@@ -294,6 +300,126 @@ class Node:
     # guard: a flooding peer gets its excess dropped, mirroring how the
     # connect loop bounds the peer fleet rather than growing it).
     MAX_VERIFY_PENDING = 64
+    # Mempool firehose bound: txs queued in the ingest accumulator.
+    MAX_TX_ACCUM = 16384
+
+    def _publish_shed(self, peer, n_txs: int) -> None:
+        """Aggregate + rate-limit VerifyShed: under a sustained flood the
+        shed path fires per message, and publishing each one would flood
+        the user bus worse than the flood being shed.  At most ~2
+        events/sec; dropped_txs carries the count accumulated since the
+        last one."""
+        import time as _time
+
+        self._shed_count += n_txs
+        now = _time.monotonic()
+        if now - self._shed_last_pub >= 0.5:
+            self._shed_last_pub = now
+            self.cfg.pub.publish(
+                VerifyShed(
+                    peer,
+                    self._shed_count,
+                    len(self._tx_accum) + self._verify_pending,
+                )
+            )
+            self._shed_count = 0
+
+    def _submit_verify_tx(self, peer, tx) -> None:
+        """Mempool-tx ingest: append the tx's raw wire bytes to the batch
+        accumulator and make sure a drain task is running.  Coalescing many
+        single-tx messages into one native extract + one engine batch is
+        what lifts the firehose off the per-message task/thread overhead
+        that bounded round 3 at ~820 sigs/s (VERDICT r3 item 5).  Falls
+        back to the per-message Python path when raw bytes or the native
+        extractor are unavailable."""
+        raw = tx.raw
+        if raw is None or not _native_extract_available():
+            self._submit_verify(peer, txs=[tx], raw=raw)
+            return
+        if len(self._tx_accum) >= self.MAX_TX_ACCUM:
+            metrics.inc("node.verify_dropped")
+            self._publish_shed(peer, 1)
+            return
+        self._tx_accum.append((peer, tx, raw))
+        if self._tx_drain is None or self._tx_drain.done():
+            self._tx_drain = self._verify_tasks.add_child(
+                self._drain_tx_accum(), name="verify-tx-drain"
+            )
+
+    async def _drain_tx_accum(self) -> None:
+        """Drain the mempool accumulator in batches: one C++ extract over
+        the concatenated raw txs (``intra_amounts`` off — mempool txs are
+        independent, exactly like the old per-message path), one engine
+        batch, per-tx TxVerdicts.  A malformed tx poisons only itself: on
+        batch extract failure each tx retries individually
+        (:meth:`_verify_txs_native`), so one hostile peer cannot fail
+        other peers' verdicts."""
+        from .txextract import extract_raw, scan_prevouts
+
+        bch = self.cfg.net.bch
+        # Bounded drain batches: one giant extract+verify would add seconds
+        # of verdict latency under flood; ~2k txs keeps the engine fed in
+        # device-batch-sized bites while verdicts keep flowing.
+        DRAIN_BATCH = 2048
+        while self._tx_accum:
+            batch = self._tx_accum[:DRAIN_BATCH]
+            del self._tx_accum[:DRAIN_BATCH]
+            concat = b"".join(r for _, _, r in batch)
+            try:
+                ext: Optional[list[int]] = None
+                if self.cfg.prevout_lookup is not None:
+                    pv_txids, pv_vouts, pv_wants = await asyncio.to_thread(
+                        scan_prevouts, concat, len(batch), bch
+                    )
+                    lookup = self.cfg.prevout_lookup
+                    ext = [-1] * len(pv_wants)
+                    for i in pv_wants.nonzero()[0]:
+                        amt = lookup(pv_txids[i].tobytes(), int(pv_vouts[i]))
+                        if amt is not None:
+                            ext[int(i)] = amt
+                items = await asyncio.to_thread(
+                    extract_raw,
+                    concat,
+                    len(batch),
+                    bch=bch,
+                    intra_amounts=False,
+                    ext_amounts=ext,
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # isolate the offender: each tx goes through the single-tx
+                # native path on its own (error verdicts + peer kill there)
+                for peer, tx, raw in batch:
+                    await self._verify_txs_native(
+                        peer, raw, 1, txs=[tx], tracked=False
+                    )
+                continue
+            metrics.inc("node.verify_txs", len(batch))
+            metrics.inc("node.verify_inputs", int(items.tx_n_inputs.sum()))
+            verdicts: list[bool] = []
+            if items.count:
+                try:
+                    assert self.verify_engine is not None
+                    verdicts = await self.verify_engine.verify_raw(items)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    metrics.inc("node.verify_errors")
+                    for ti, (peer, _, _) in enumerate(batch):
+                        self.cfg.pub.publish(
+                            TxVerdict(peer, items.txid(ti), False, (),
+                                      items.stats(ti), error=f"engine: {e}")
+                        )
+                    continue
+            per_sig = items.combine(verdicts)
+            sig_slices = items.sig_slices()
+            for ti, (peer, _, _) in enumerate(batch):
+                vs = tuple(per_sig[sig_slices[ti]])
+                self.cfg.pub.publish(
+                    TxVerdict(peer, items.txid(ti), all(vs), vs,
+                              items.stats(ti))
+                )
 
     def _submit_verify(
         self,
@@ -315,9 +441,7 @@ class Node:
         n_txs = block.tx_count if block is not None else len(txs)
         if self._verify_pending >= self.MAX_VERIFY_PENDING:
             metrics.inc("node.verify_dropped", n_txs)
-            self.cfg.pub.publish(
-                VerifyShed(peer, n_txs, self._verify_pending)
-            )
+            self._publish_shed(peer, n_txs)
             return
         self._verify_pending += 1
         if block is not None:
@@ -351,6 +475,7 @@ class Node:
         n_txs: int,
         block=None,
         txs: Optional[list[Tx]] = None,
+        tracked: bool = True,  # False: caller owns _verify_pending
     ) -> None:
         """Native-extract fast path of :meth:`_verify_txs`: parse + sighash +
         DER + pubkey decode run in C++ over the original wire bytes
@@ -369,16 +494,14 @@ class Node:
         def _publish_extract_error(e: Exception) -> None:
             metrics.inc("node.verify_errors")
             txids: list[bytes] = []
-            if txs is not None:
-                txids = [tx.txid for tx in txs]
-            else:
-                try:
-                    txids = [tx.txid for tx in block.txs]
-                except Exception:
-                    # block region unparseable: one aggregate verdict, and
-                    # the peer dies as it would have under eager decode
-                    txids = [b""]
-                    peer.kill(CannotDecodePayload(f"block: {e}"))
+            try:
+                src = txs if txs is not None else block.txs
+                txids = [tx.txid for tx in src]
+            except Exception:
+                # tx region unparseable (lazy tx/block): one aggregate
+                # verdict, and the peer dies as under eager decode
+                txids = [b""]
+                peer.kill(CannotDecodePayload(str(e)))
             for txid in txids:
                 self.cfg.pub.publish(
                     TxVerdict(peer, txid, False, (), ExtractStats(),
@@ -447,7 +570,8 @@ class Node:
                     TxVerdict(peer, items.txid(ti), all(vs), vs, items.stats(ti))
                 )
         finally:
-            self._verify_pending -= 1
+            if tracked:
+                self._verify_pending -= 1
 
     async def _verify_txs(self, peer, txs: list[Tx]) -> None:
         """Verify every tx of one message.  All txs' signatures are submitted

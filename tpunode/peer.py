@@ -28,6 +28,7 @@ from .wire import (
     Block,
     DecodeError,
     LazyBlock,
+    LazyTx,
     InvType,
     InvVector,
     MAX_PAYLOAD,
@@ -419,7 +420,7 @@ async def get_txs(
     """Fetch transactions by txid (reference Peer.hs:329-344)."""
     t = InvType.WITNESS_TX if net.segwit else InvType.TX
     out = await get_data(seconds, p, [InvVector(t, h) for h in tx_hashes])
-    if out is None or not all(isinstance(x, Tx) for x in out):
+    if out is None or not all(isinstance(x, (Tx, LazyTx)) for x in out):
         return None
     return out  # type: ignore[return-value]
 

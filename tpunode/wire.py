@@ -38,6 +38,7 @@ __all__ = [
     "BlockHeader",
     "Block",
     "LazyBlock",
+    "LazyTx",
     "MsgVersion",
     "MsgVerAck",
     "MsgPing",
@@ -683,17 +684,63 @@ class MsgBlock:
         return cls(LazyBlock(header, n, r.read(r.remaining())))
 
 
+class LazyTx:
+    """A transaction whose parse is deferred: ``raw`` holds the exact wire
+    bytes; touching any other attribute parses once and delegates to the
+    eager :class:`Tx`.  ``MsgTx`` decodes to this, so a mempool firehose
+    costs no Python tx parsing on the event loop — the native verify
+    ingest consumes ``raw`` directly (tpunode/txextract.py), and only code
+    that actually inspects the tx pays the parse (which validates the
+    payload fully, surfacing what eager decode would have)."""
+
+    __slots__ = ("raw", "_tx")
+
+    def __init__(self, raw: bytes):
+        self.raw = raw
+        self._tx: Optional[Tx] = None
+
+    def _parsed(self) -> Tx:
+        tx = self._tx
+        if tx is None:
+            r = Reader(self.raw)
+            tx = Tx.deserialize(r)
+            if r.remaining():
+                raise ValueError("trailing bytes after tx payload")
+            self._tx = tx
+        return tx
+
+    def serialize(self, include_witness: bool = True) -> bytes:
+        if include_witness:
+            return self.raw
+        return self._parsed().serialize(include_witness=False)
+
+    def __getattr__(self, name):
+        # reached only for names not on LazyTx itself (raw/_tx/serialize)
+        return getattr(self._parsed(), name)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, LazyTx):
+            return self.raw == other.raw
+        if isinstance(other, Tx):
+            return self._parsed() == other
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"LazyTx({len(self.raw)} bytes)"
+
+
 @dataclass(frozen=True)
 class MsgTx:
     command = "tx"
-    tx: Tx
+    tx: "Tx | LazyTx"
 
     def serialize_payload(self) -> bytes:
         return self.tx.serialize()
 
     @classmethod
     def deserialize_payload(cls, r: Reader) -> "MsgTx":
-        return cls(Tx.deserialize(r))
+        # Lazy: the payload IS the tx by definition (see LazyTx).
+        return cls(LazyTx(r.read(r.remaining())))
 
 
 @dataclass(frozen=True)
