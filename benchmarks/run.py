@@ -70,7 +70,7 @@ def config1() -> None:
         extracted += st.extracted
         sigs += st.sigs
     rate, engine, out = cpu_single_core_bench(
-        [(i.pubkey, i.z, i.r, i.s) for i in items]
+        [i.verify_item for i in items]
     )
     per_sig = combine_verdicts(items, out)
     assert all(per_sig), "baseline block must verify fully"
@@ -377,7 +377,7 @@ def config4() -> None:
     n_txs = 40 if SMALL else 1024  # unique; tiled across peers
     duration = 3.0 if SMALL else 15.0
     batch = 128 if SMALL else 4096
-    txs = gen_mixed_txs(n_txs, seed=0xF12E, invalid_every=63)
+    txs = gen_mixed_txs(n_txs, seed=0xF12E, invalid_every=63, schnorr_every=6)
     net = BCH_REGTEST
     # pre-encode outside the measurement: the pump's serialization cost is
     # harness, not node

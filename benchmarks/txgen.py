@@ -27,7 +27,13 @@ from tpunode.params import Network
 from tpunode.sighash import SIGHASH_ALL, bip143_sighash, legacy_sighash
 from tpunode.txverify import _hash160, _p2pkh_script_code
 from tpunode.util import Reader, double_sha256
-from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+from tpunode.verify.ecdsa_cpu import (
+    CURVE_N,
+    GENERATOR,
+    point_mul,
+    sign,
+    sign_schnorr,
+)
 from tpunode.wire import (
     Block,
     BlockHeader,
@@ -169,6 +175,7 @@ def gen_mixed_txs(
     seed: int = 0x1213,
     invalid_every: int = 0,
     inputs_per_tx: int = 2,
+    schnorr_every: int = 0,
 ) -> list[Tx]:
     """``count`` txs drawn from the realistic script-type mix (_MIX): P2PKH,
     P2WPKH, P2SH-P2WPKH, 2-of-3 P2SH multisig, 2-of-3 P2WSH multisig, plus
@@ -176,7 +183,9 @@ def gen_mixed_txs(
     tx complicates serialization for no benchmark value).  BIP143 inputs
     are signed against ``synth_amount(prevout)``; pass ``synth_amount`` as
     the prevout lookup when verifying.  ``invalid_every`` corrupts every
-    Nth tx's first signature."""
+    Nth tx's first signature.  ``schnorr_every`` > 0 makes every Nth tx a
+    BCH-Schnorr-signed P2PKH spend (65-byte sig, ALL|FORKID hashtype —
+    verify with ``bch=True``)."""
     rng = random.Random(seed)
     privs = [rng.getrandbits(256) % CURVE_N or 1 for _ in range(3)]
     pubs = [point_mul(p, GENERATOR) for p in privs]
@@ -187,6 +196,8 @@ def gen_mixed_txs(
     for t in range(count):
         roll = rng.random()
         kind = next(k for w, k in _MIX if roll < w)
+        if schnorr_every and t % schnorr_every == schnorr_every - 1:
+            kind = "p2pkh-schnorr"
         corrupt = invalid_every and t % invalid_every == invalid_every - 1
         prevouts = tuple(
             OutPoint(rng.randbytes(32), rng.randrange(4))
@@ -216,6 +227,23 @@ def gen_mixed_txs(
         wit_stacks: list[tuple[bytes, ...]] = []
         for i, po in enumerate(prevouts):
             amount = synth_amount(po.txid, po.index)
+            if kind == "p2pkh-schnorr":
+                # BCH Schnorr over the FORKID (BIP143-style) digest
+                ht = SIGHASH_ALL | 0x40  # SIGHASH_FORKID
+                z = bip143_sighash(unsigned, i, out_script, amount, ht)
+                r, s = sign_schnorr(
+                    privs[0], z, rng.getrandbits(256) % CURVE_N or 1
+                )
+                if corrupt and i == 0:
+                    s = (s + 1) % CURVE_N
+                sig_blob = (
+                    r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([ht])
+                )
+                signed_ins.append(
+                    TxIn(po, _push(sig_blob) + _push(blobs[0]), 0xFFFFFFFF)
+                )
+                wit_stacks.append(())
+                continue
             if kind == "p2pkh":
                 z = legacy_sighash(unsigned, i, out_script, SIGHASH_ALL)
             elif kind == "p2sh-msig":
@@ -319,7 +347,7 @@ def gen_chain(
             f"{net.magic:08x}-{n_blocks}x{txs_per_block}"
             f"-i{inputs_per_tx}-s{seed:x}"
             + (f"-w{segwit_every}" if segwit_every else "")
-            + ("-mix" if mix else "")
+            + (("-mixs" if net.bch else "-mix") if mix else "")
         )
         cache = f"{os.path.splitext(cache)[0]}-{key}.bin"
         path = cache_path(cache)
@@ -339,7 +367,12 @@ def gen_chain(
     t0 = net.genesis.timestamp
     if mix:
         all_txs = gen_mixed_txs(
-            n_blocks * txs_per_block, seed=seed, inputs_per_tx=inputs_per_tx
+            n_blocks * txs_per_block,
+            seed=seed,
+            inputs_per_tx=inputs_per_tx,
+            # BCH networks: every 4th tx Schnorr-signed (the realistic
+            # post-2019 mix is Schnorr-heavy); verify with bch=True
+            schnorr_every=4 if net.bch else 0,
         )
     else:
         all_txs = gen_signed_txs(

@@ -394,6 +394,87 @@ bool verify_one(const uint8_t *px, const uint8_t *py, const uint8_t *z32,
   return false;
 }
 
+// Euler's criterion: a^((p-1)/2) == 1 (mod p) — the jacobi(y) = 1
+// acceptance test of BCH Schnorr.  Square-and-multiply over the constant
+// exponent, MSB first.
+bool fe_euler_is_one(const Fe &a) {
+  // (p-1)/2, big-endian limb order for MSB-first iteration
+  static const uint64_t E[4] = {0x7FFFFFFFFFFFFFFFULL, 0xFFFFFFFFFFFFFFFFULL,
+                                0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFF7FFFFE17ULL};
+  Fe acc{{1, 0, 0, 0}};
+  bool started = false;
+  for (int w = 0; w < 4; ++w) {
+    for (int b = 63; b >= 0; --b) {
+      if (started) acc = FP.sqr(acc);
+      if ((E[w] >> b) & 1) {
+        if (started)
+          acc = FP.mul(acc, a);
+        else {
+          acc = a;
+          started = true;
+        }
+      }
+    }
+  }
+  Fe one{{1, 0, 0, 0}};
+  return fe_eq(acc, one);
+}
+
+// BCH Schnorr verification (2019-05 upgrade spec): with the PRECOMPUTED
+// challenge e (= SHA256(r || P_comp || m) mod n, hashed by the extractor),
+// compute R = s*G + (n - e)*P and accept iff R is finite, x(R) == r over
+// Fp, and jacobi(y(R)) == 1.  Same window MSM as verify_one.
+bool verify_one_schnorr(const uint8_t *px, const uint8_t *py,
+                        const uint8_t *e32, const uint8_t *r32,
+                        const uint8_t *s32) {
+  Fe qx = fe_from_be(px), qy = fe_from_be(py);
+  Fe r = fe_from_be(r32);
+  if (ge(r, FP.m)) return false;  // r is an Fp x-coordinate
+  Fe s = fe_from_be(s32);
+  if (ge(s, FN.m)) return false;  // s a scalar (zero allowed by spec)
+  if (ge(qx, FP.m) || ge(qy, FP.m)) return false;
+  Fe lhs = FP.sqr(qy);
+  Fe rhs = FP.add(FP.mul(FP.sqr(qx), qx), Fe{{7, 0, 0, 0}});
+  if (!fe_eq(lhs, rhs)) return false;
+
+  Fe e = fe_from_be(e32);
+  while (ge(e, FN.m)) sub_mod_raw(e, FN.m);
+  // u2 = n - e (mod n)
+  Fe u2{{0, 0, 0, 0}};
+  if (!is_zero(e)) {
+    u2 = Fe{{FN.m[0], FN.m[1], FN.m[2], FN.m[3]}};
+    sub_mod_raw(u2, e.v);
+  }
+  const Fe &u1 = s;
+
+  Pt tq[16];
+  tq[0] = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
+  tq[1] = Pt{qx, qy, {{1, 0, 0, 0}}};
+  for (int i = 2; i < 16; ++i) tq[i] = pt_add(tq[i - 1], tq[1]);
+
+  Pt acc = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
+  for (int w4 = 63; w4 >= 0; --w4) {
+    if (!pt_inf(acc)) {
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+    }
+    int limb = w4 / 16, shift = (w4 % 16) * 4;
+    int d1 = (int)((u1.v[limb] >> shift) & 0xF);
+    int d2 = (int)((u2.v[limb] >> shift) & 0xF);
+    if (d1) acc = pt_add(acc, TAB.g[d1]);
+    if (d2) acc = pt_add(acc, tq[d2]);
+  }
+  if (pt_inf(acc)) return false;
+  // x(R) == r over Fp (Jacobian: X == r * Z^2)
+  Fe zz = FP.sqr(acc.z);
+  if (!fe_eq(FP.mul(r, zz), acc.x)) return false;
+  // jacobi(y(R)) with y = Y/Z^3: jacobi(Y/Z^3) = jacobi(Y)*jacobi(Z) =
+  // jacobi(Y*Z) (the symbol is multiplicative; squares vanish)
+  return fe_euler_is_one(FP.mul(acc.y, acc.z));
+}
+
 }  // namespace
 
 namespace {
@@ -444,20 +525,24 @@ void secp_dbg_mulg(const uint8_t *k32, uint8_t *x_out, uint8_t *y_out) {
 
 // Inputs: concatenated 32-byte big-endian arrays, one entry per signature.
 //   px, py: affine public key coordinates
-//   z: message digests; r, s: signature scalars
+//   z: message digests (ECDSA) or precomputed challenges (Schnorr)
+//   r, s: signature scalars
+//   present: per-row algorithm, or NULL for all-ECDSA: 0 = auto-invalid,
+//            1 = ECDSA, 2 = BCH Schnorr (RawBatch.present semantics)
 // Output: out[i] = 1 if valid else 0.  Returns number of valid signatures.
 int secp_verify_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
-                      const uint8_t *r, const uint8_t *s, int count,
-                      uint8_t *out) {
-  // Montgomery batch inversion of all s scalars: one field inversion for the
-  // whole batch plus 3 multiplications per element.
+                      const uint8_t *r, const uint8_t *s,
+                      const uint8_t *present, int count, uint8_t *out) {
+  // Montgomery batch inversion of the ECDSA rows' s scalars: one field
+  // inversion for the whole batch plus 3 multiplications per element.
   Fe *sv = new Fe[count];
   Fe *prefix = new Fe[count];
   bool *s_ok = new bool[count];
   Fe run{{1, 0, 0, 0}};
   for (int i = 0; i < count; ++i) {
+    bool schnorr = present != nullptr && present[i] == 2;
     Fe si = fe_from_be(s + 32 * i);
-    s_ok[i] = !(is_zero(si) || ge(si, FN.m));
+    s_ok[i] = !schnorr && !(is_zero(si) || ge(si, FN.m));
     sv[i] = s_ok[i] ? si : Fe{{1, 0, 0, 0}};
     run = FN.mul(run, sv[i]);
     prefix[i] = run;
@@ -471,8 +556,16 @@ int secp_verify_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
   }
   int valid = 0;
   for (int i = 0; i < count; ++i) {
-    bool ok = s_ok[i] && verify_one(px + 32 * i, py + 32 * i, z + 32 * i,
-                                    r + 32 * i, w[i]);
+    bool ok;
+    if (present != nullptr && present[i] == 0) {
+      ok = false;
+    } else if (present != nullptr && present[i] == 2) {
+      ok = verify_one_schnorr(px + 32 * i, py + 32 * i, z + 32 * i,
+                              r + 32 * i, s + 32 * i);
+    } else {
+      ok = s_ok[i] && verify_one(px + 32 * i, py + 32 * i, z + 32 * i,
+                                 r + 32 * i, w[i]);
+    }
     out[i] = ok ? 1 : 0;
     valid += ok;
   }
@@ -639,8 +732,10 @@ inline void write_limbs(const Fe &a, int32_t *out, int size, int lane) {
 extern "C" {
 
 // Host prep for one device batch.  All byte inputs are 32-byte big-endian,
-// one entry per item; ``present[i]`` nonzero means the pubkey decoded to a
-// finite point and r/s passed Python-side range checks.  int32 outputs are
+// one entry per item; ``present[i]`` carries the RawBatch algorithm code
+// (0 = absent, 1 = ECDSA, 2 = BCH Schnorr — for Schnorr, ``z`` is the
+// precomputed challenge e, u1 = s and u2 = n - e need no inversion, and
+// ``r`` is an Fp x-coordinate with no r+n candidate).  int32 outputs are
 // (rows, size) C-contiguous, zero-initialized by the caller; lanes >= count
 // stay zero.  Returns the number of GLV bound violations (0 = success;
 // cannot occur for in-range scalars — a nonzero return means a bug and the
@@ -651,17 +746,24 @@ int secp_prepare_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
                        int32_t *d1a, int32_t *d1b, int32_t *d2a, int32_t *d2b,
                        uint8_t *negs, int32_t *qx, int32_t *qy, int32_t *r1,
                        int32_t *r2, uint8_t *r2_valid, uint8_t *host_valid,
-                       int nthreads) {
-  // ---- serial: validity + Montgomery batch inversion of s ----
+                       uint8_t *schnorr, int nthreads) {
+  // ---- serial: validity + Montgomery batch inversion of s (ECDSA rows) ----
   std::vector<Fe> sv(count), prefix(count), w(count);
-  std::vector<uint8_t> ok(count);
+  std::vector<uint8_t> ok(count), is_sch(count);
   Fe run{{1, 0, 0, 0}};
   for (int i = 0; i < count; ++i) {
     Fe si = fe_from_be(s + 32 * i);
     Fe ri = fe_from_be(r + 32 * i);
-    ok[i] = present[i] && !is_zero(si) && !ge(si, FN.m) && !is_zero(ri) &&
-            !ge(ri, FN.m);
-    sv[i] = ok[i] ? si : Fe{{1, 0, 0, 0}};
+    is_sch[i] = present[i] == 2;
+    if (is_sch[i]) {
+      // spec ranges: r < p, s < n; zero allowed for both
+      ok[i] = !ge(si, FN.m) && !ge(ri, FP.m);
+      sv[i] = Fe{{1, 0, 0, 0}};  // no inversion needed
+    } else {
+      ok[i] = present[i] && !is_zero(si) && !ge(si, FN.m) && !is_zero(ri) &&
+              !ge(ri, FN.m);
+      sv[i] = ok[i] ? si : Fe{{1, 0, 0, 0}};
+    }
     run = FN.mul(run, sv[i]);
     prefix[i] = run;
   }
@@ -681,8 +783,19 @@ int secp_prepare_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
       Fe zi = fe_from_be(z + 32 * i);
       while (ge(zi, FN.m)) sub_mod_raw(zi, FN.m);
       Fe ri = fe_from_be(r + 32 * i);
-      Fe u1 = FN.mul(zi, w[i]);
-      Fe u2 = FN.mul(ri, w[i]);
+      Fe u1, u2;
+      if (is_sch[i]) {
+        schnorr[i] = 1;
+        u1 = fe_from_be(s + 32 * i);  // u1 = s (< n, checked)
+        u2 = Fe{{0, 0, 0, 0}};        // u2 = n - e (mod n)
+        if (!is_zero(zi)) {
+          u2 = Fe{{FN.m[0], FN.m[1], FN.m[2], FN.m[3]}};
+          sub_mod_raw(u2, zi.v);
+        }
+      } else {
+        u1 = FN.mul(zi, w[i]);
+        u2 = FN.mul(ri, w[i]);
+      }
       Half h[4];
       uint64_t c1[3], c2[3];
       glv_c(GLV_G1, u1, c1);
@@ -703,12 +816,14 @@ int secp_prepare_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
       write_limbs(fe_from_be(px + 32 * i), qx, size, i);
       write_limbs(fe_from_be(py + 32 * i), qy, size, i);
       write_limbs(ri, r1, size, i);
-      // r + n < p ?
-      Fe rn = ri;
-      uint64_t carry = mp_add(rn.v, 4, FN.m, 4);
-      if (!carry && !ge(rn, FP.m)) {
-        write_limbs(rn, r2, size, i);
-        r2_valid[i] = 1;
+      // r + n < p ?  (ECDSA-only: Schnorr compares x(R) to r over Fp)
+      if (!is_sch[i]) {
+        Fe rn = ri;
+        uint64_t carry = mp_add(rn.v, 4, FN.m, 4);
+        if (!carry && !ge(rn, FP.m)) {
+          write_limbs(rn, r2, size, i);
+          r2_valid[i] = 1;
+        }
       }
     }
   };

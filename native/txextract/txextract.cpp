@@ -22,6 +22,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -961,6 +962,38 @@ void bip143_sighash(TxSpan &tx, size_t index, const uint8_t *script_code,
   dsha256(buf.data(), buf.size(), out);
 }
 
+// Per-extract-call decoded-pubkey cache: decompression costs a field sqrt
+// (~a modexp), and real workloads reuse keys heavily (one wallet key funds
+// many inputs; multisig windows retry the same keys).  Bounded so a block
+// full of distinct garbage keys cannot balloon memory.
+struct PubkeyEntry {
+  uint8_t px[32], py[32];
+  bool ok;
+};
+using PubkeyCache = std::unordered_map<std::string, PubkeyEntry>;
+const size_t PUBKEY_CACHE_MAX = 1 << 17;
+
+bool decode_pubkey_cached(PubkeyCache &cache, const uint8_t *data, size_t len,
+                          uint8_t px[32], uint8_t py[32]) {
+  if (cache.size() >= PUBKEY_CACHE_MAX)
+    return decode_pubkey(data, len, px, py);
+  std::string key(reinterpret_cast<const char *>(data), len);
+  auto it = cache.find(key);
+  if (it == cache.end()) {
+    PubkeyEntry e;
+    e.ok = decode_pubkey(data, len, e.px, e.py);
+    if (!e.ok) {
+      memset(e.px, 0, 32);
+      memset(e.py, 0, 32);
+    }
+    it = cache.emplace(std::move(key), e).first;
+  }
+  if (!it->second.ok) return false;
+  memcpy(px, it->second.px, 32);
+  memcpy(py, it->second.py, 32);
+  return true;
+}
+
 // ---------------------------------------------------------------------------
 // Intra-block prevout amount map: (txid, vout) -> satoshis.
 // ---------------------------------------------------------------------------
@@ -1118,6 +1151,7 @@ long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
   static const uint8_t ZERO_TXID[32] = {0};
   std::vector<uint8_t> scratch;
   scratch.reserve(4096);
+  PubkeyCache pubcache;
   long item = 0;
   long flat_input = 0;  // index into ext_amounts
   for (size_t ti = 0; ti < txs.size(); ++ti) {
@@ -1167,8 +1201,14 @@ long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
           continue;
         }
         int hashtype = t.sig[t.sig_len - 1];
+        // BCH consensus: a 65-byte signature blob (64 + hashtype) IS
+        // Schnorr (2019-05 upgrade) — r ∥ s raw, no DER.
+        bool is_schnorr = bch && t.sig_len == 65;
         uint8_t rbuf[32], sbuf[32];
-        if (!parse_der(t.sig, t.sig_len - 1, rbuf, sbuf)) {
+        if (is_schnorr) {
+          memcpy(rbuf, t.sig, 32);
+          memcpy(sbuf, t.sig + 32, 32);
+        } else if (!parse_der(t.sig, t.sig_len - 1, rbuf, sbuf)) {
           ++unsupported;
           continue;
         }
@@ -1188,18 +1228,49 @@ long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
         } else {
           legacy_sighash(tx, idx, script_code, 25, hashtype, scratch, digest);
         }
-        reduce_mod_n(digest);
         if (item >= capacity) return -2;
-        memcpy(z + item * 32, digest, 32);
         memcpy(r + item * 32, rbuf, 32);
         memcpy(s + item * 32, sbuf, 32);
-        present[item] =
-            decode_pubkey(t.pub, t.pub_len, px + item * 32, py + item * 32)
-                ? 1
-                : 0;
-        if (!present[item]) {
-          memset(px + item * 32, 0, 32);
-          memset(py + item * 32, 0, 32);
+        if (is_schnorr) {
+          // challenge e = SHA256(r ∥ P_compressed ∥ m) mod n, hashed over
+          // the UNREDUCED sighash (mirror of ecdsa_cpu.schnorr_challenge);
+          // undecodable pubkey -> auto-invalid row with z = 0.
+          uint8_t pxb[32], pyb[32];
+          bool okp = decode_pubkey_cached(pubcache, t.pub, t.pub_len, pxb,
+                                          pyb);
+          if (okp) {
+            uint8_t pre[97];
+            memcpy(pre, rbuf, 32);
+            pre[32] = uint8_t(0x02 | (pyb[31] & 1));
+            memcpy(pre + 33, pxb, 32);
+            memcpy(pre + 65, digest, 32);
+            uint8_t e32[32];
+            Sha256 h;
+            h.update(pre, 97);
+            h.final(e32);
+            reduce_mod_n(e32);
+            memcpy(z + item * 32, e32, 32);
+            memcpy(px + item * 32, pxb, 32);
+            memcpy(py + item * 32, pyb, 32);
+            present[item] = 2;
+          } else {
+            memset(z + item * 32, 0, 32);
+            memset(px + item * 32, 0, 32);
+            memset(py + item * 32, 0, 32);
+            present[item] = 0;
+          }
+        } else {
+          reduce_mod_n(digest);
+          memcpy(z + item * 32, digest, 32);
+          present[item] =
+              decode_pubkey_cached(pubcache, t.pub, t.pub_len, px + item * 32,
+                                   py + item * 32)
+                  ? 1
+                  : 0;
+          if (!present[item]) {
+            memset(px + item * 32, 0, 32);
+            memset(py + item * 32, 0, 32);
+          }
         }
         item_tx[item] = int32_t(ti);
         item_input[item] = int32_t(idx);
@@ -1257,8 +1328,8 @@ long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
             memcpy(r + item * 32, rbuf, 32);
             memcpy(s + item * 32, sbuf, 32);
             if (kdec[j] < 0)
-              kdec[j] = decode_pubkey(t.ms.keys[j], t.ms.key_len[j], kx[j],
-                                      ky[j])
+              kdec[j] = decode_pubkey_cached(pubcache, t.ms.keys[j],
+                                             t.ms.key_len[j], kx[j], ky[j])
                             ? 1
                             : 0;
             present[item] = uint8_t(kdec[j]);

@@ -53,7 +53,7 @@ def _extract_and_verify(tx, bch=False):
     items, stats = extract_sig_items(
         tx, prevout_amounts=_amounts_for(tx, bch) or None, bch=bch
     )
-    verdicts = verify_batch_cpu([(i.pubkey, i.z, i.r, i.s) for i in items])
+    verdicts = verify_batch_cpu([i.verify_item for i in items])
     return items, stats, combine_verdicts(items, verdicts)
 
 

@@ -304,7 +304,7 @@ def test_verdicts_match_cpu_backend():
     raw = extract_raw(_serialize_all(txs), len(txs))
     native_items = raw.to_verify_items()
     py_items, _ = _python_reference(txs)
-    expected = verify_batch_cpu([(i.pubkey, i.z, i.r, i.s) for i in py_items])
+    expected = verify_batch_cpu([i.verify_item for i in py_items])
     got_oracle = verify_batch_cpu(native_items)
     assert got_oracle == expected
     nv = load_native_verifier()

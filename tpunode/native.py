@@ -19,10 +19,42 @@ from typing import Iterator, Optional, Sequence
 
 from .store import BatchOp, delete_op, put_op
 
-__all__ = ["NativeKV", "load_kvstore_lib"]
+__all__ = ["NativeKV", "load_kvstore_lib", "ensure_native_lib"]
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libkvstore.so")
+
+
+def ensure_native_lib(lib_path: str, src_subdir: str) -> str:
+    """Build ``lib_path`` via make when missing or older than its sources.
+
+    The mtime check protects against a stale .so with an old C ABI after a
+    source change, without making every process invoke (or even require) a
+    build toolchain: a host with a prebuilt, up-to-date .so and no
+    make/g++ loads fine, and a failed rebuild falls back to an existing
+    .so only when it is NOT stale (a stale one would corrupt calls)."""
+    native_dir = os.path.join(_REPO_ROOT, "native")
+    srcs = [os.path.join(native_dir, "Makefile")]
+    src_dir = os.path.join(native_dir, src_subdir)
+    if os.path.isdir(src_dir):
+        srcs += [
+            os.path.join(src_dir, f)
+            for f in os.listdir(src_dir)
+            if f.endswith((".cpp", ".h", ".hpp"))
+        ]
+    stale = not os.path.exists(lib_path) or any(
+        os.path.getmtime(s) > os.path.getmtime(lib_path)
+        for s in srcs
+        if os.path.exists(s)
+    )
+    if stale:
+        subprocess.run(
+            ["make", "-C", native_dir,
+             os.path.join("build", os.path.basename(lib_path))],
+            check=True,
+            capture_output=True,
+        )
+    return lib_path
 
 _REC = struct.Struct("<BII")
 _SCAN_HDR = struct.Struct("<II")
@@ -39,13 +71,7 @@ def load_kvstore_lib() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            subprocess.run(
-                ["make", "-C", os.path.join(_REPO_ROOT, "native"),
-                 "build/libkvstore.so"],
-                check=True,
-                capture_output=True,
-            )
+        ensure_native_lib(_LIB_PATH, "kvstore")
         lib = ctypes.CDLL(_LIB_PATH)
         lib.kv_open.restype = ctypes.c_void_p
         lib.kv_open.argtypes = [ctypes.c_char_p]

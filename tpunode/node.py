@@ -193,6 +193,7 @@ class Node:
         # shed-event aggregation (a flood must not also flood the bus)
         self._shed_count = 0
         self._shed_last_pub = 0.0
+        self._shed_flush: Optional[asyncio.Task] = None
 
     @staticmethod
     def _verify_task_died(task, exc) -> None:
@@ -308,21 +309,37 @@ class Node:
         shed path fires per message, and publishing each one would flood
         the user bus worse than the flood being shed.  At most ~2
         events/sec; dropped_txs carries the count accumulated since the
-        last one."""
+        last one.  Counts accumulated inside the window are flushed by a
+        delayed task so a burst that then stops is still reported."""
         import time as _time
 
         self._shed_count += n_txs
         now = _time.monotonic()
         if now - self._shed_last_pub >= 0.5:
-            self._shed_last_pub = now
-            self.cfg.pub.publish(
-                VerifyShed(
-                    peer,
-                    self._shed_count,
-                    len(self._tx_accum) + self._verify_pending,
-                )
+            self._flush_shed(peer)
+        elif self._shed_flush is None or self._shed_flush.done():
+
+            async def flush_later():
+                await asyncio.sleep(0.5)
+                if self._shed_count:
+                    self._flush_shed(peer)
+
+            self._shed_flush = self._verify_tasks.add_child(
+                flush_later(), name="shed-flush"
             )
-            self._shed_count = 0
+
+    def _flush_shed(self, peer) -> None:
+        import time as _time
+
+        self._shed_last_pub = _time.monotonic()
+        self.cfg.pub.publish(
+            VerifyShed(
+                peer,
+                self._shed_count,
+                len(self._tx_accum) + self._verify_pending,
+            )
+        )
+        self._shed_count = 0
 
     def _submit_verify_tx(self, peer, tx) -> None:
         """Mempool-tx ingest: append the tx's raw wire bytes to the batch
@@ -586,17 +603,21 @@ class Node:
         per_tx: list[tuple[Tx, ExtractStats, list, Optional[asyncio.Task]]] = []
         try:
             for tx in txs:
-                amounts: dict[int, int] = {}
-                for idx, txin in enumerate(tx.inputs):
-                    if not wants_amount(tx, idx, self.cfg.net.bch):
-                        continue  # legacy non-FORKID input: amount unused
-                    key = (txin.prevout.txid, txin.prevout.index)
-                    amt = block_outs.get(key)
-                    if amt is None and self.cfg.prevout_lookup is not None:
-                        amt = self.cfg.prevout_lookup(*key)
-                    if amt is not None:
-                        amounts[idx] = amt
                 try:
+                    # everything touching tx attributes goes inside the
+                    # guard: a malformed LazyTx (wire.LazyTx) raises on
+                    # first attribute access, which must become an error
+                    # verdict + peer kill, never a dead ingest task
+                    amounts: dict[int, int] = {}
+                    for idx, txin in enumerate(tx.inputs):
+                        if not wants_amount(tx, idx, self.cfg.net.bch):
+                            continue  # legacy non-FORKID input: amount unused
+                        key = (txin.prevout.txid, txin.prevout.index)
+                        amt = block_outs.get(key)
+                        if amt is None and self.cfg.prevout_lookup is not None:
+                            amt = self.cfg.prevout_lookup(*key)
+                        if amt is not None:
+                            amounts[idx] = amt
                     items, stats = extract_sig_items(
                         tx,
                         prevout_amounts=amounts or None,
@@ -604,8 +625,13 @@ class Node:
                     )
                 except Exception as e:
                     metrics.inc("node.verify_errors")
+                    try:
+                        txid = tx.txid
+                    except Exception:
+                        txid = b""  # unparseable lazy tx: aggregate verdict
+                        peer.kill(CannotDecodePayload(f"tx: {e}"))
                     self.cfg.pub.publish(
-                        TxVerdict(peer, tx.txid, False, (), ExtractStats(),
+                        TxVerdict(peer, txid, False, (), ExtractStats(),
                                   error=f"extract: {e}")
                     )
                     continue
@@ -615,7 +641,7 @@ class Node:
                 if items:
                     task = asyncio.ensure_future(
                         self.verify_engine.verify(
-                            [(i.pubkey, i.z, i.r, i.s) for i in items]
+                            [i.verify_item for i in items]
                         )
                     )
                 per_tx.append((tx, stats, items, task))

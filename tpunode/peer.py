@@ -368,11 +368,18 @@ async def get_data(
                 while remaining:
                     msg = await inbox.receive_match(select)
                     iv = remaining[0]
-                    if (
-                        isinstance(msg, MsgTx)
-                        and _is_tx_type(iv.type)
-                        and msg.tx.txid == iv.hash
-                    ):
+                    try:
+                        tx_match = (
+                            isinstance(msg, MsgTx)
+                            and _is_tx_type(iv.type)
+                            and msg.tx.txid == iv.hash
+                        )
+                    except ValueError:
+                        # lazy tx whose payload does not parse: the eager
+                        # decode used to kill the peer before we ever saw
+                        # it; preserve the returns-None-on-garbage contract
+                        return None
+                    if tx_match:
                         acc.append(msg.tx)
                         remaining.pop(0)
                     elif (

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from dataclasses import dataclass
 from typing import Optional, Sequence
@@ -49,13 +48,9 @@ def load_txextract_lib() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            subprocess.run(
-                ["make", "-C", os.path.join(_REPO_ROOT, "native"),
-                 "build/libtxextract.so"],
-                check=True,
-                capture_output=True,
-            )
+        from .native import ensure_native_lib
+
+        ensure_native_lib(_LIB_PATH, "txextract")
         lib = ctypes.CDLL(_LIB_PATH)
         from numpy.ctypeslib import ndpointer
 
@@ -226,8 +221,9 @@ class RawSigItems:
         return out
 
     def to_verify_items(self):
-        """Convert to the engine's ``VerifyItem`` tuples — for the oracle
-        backend and cross-checks; the fast paths consume the arrays."""
+        """Convert to the engine's ``VerifyItem`` tuples (5-tuples tagged
+        "schnorr" for ``present == 2`` rows) — for the oracle backend and
+        cross-checks; the fast paths consume the arrays."""
         from .verify.ecdsa_cpu import Point
 
         items = []
@@ -239,14 +235,13 @@ class RawSigItems:
                 )
             else:
                 q = None
-            items.append(
-                (
-                    q,
-                    int.from_bytes(self.z[i].tobytes(), "big"),
-                    int.from_bytes(self.r[i].tobytes(), "big"),
-                    int.from_bytes(self.s[i].tobytes(), "big"),
-                )
+            tup = (
+                q,
+                int.from_bytes(self.z[i].tobytes(), "big"),
+                int.from_bytes(self.r[i].tobytes(), "big"),
+                int.from_bytes(self.s[i].tobytes(), "big"),
             )
+            items.append(tup + ("schnorr",) if self.present[i] == 2 else tup)
         return items
 
 

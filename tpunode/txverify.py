@@ -37,7 +37,12 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
 from .sighash import SIGHASH_FORKID, bip143_sighash, legacy_sighash
-from .verify.ecdsa_cpu import Point, decode_pubkey, parse_der_signature
+from .verify.ecdsa_cpu import (
+    Point,
+    decode_pubkey,
+    parse_der_signature,
+    schnorr_challenge,
+)
 from .wire import Tx
 
 __all__ = [
@@ -90,7 +95,7 @@ class SigItem:
     """
 
     pubkey: Optional[Point]  # None = undecodable key (auto-invalid)
-    z: int  # sighash digest
+    z: int  # sighash digest (ECDSA) or precomputed challenge e (Schnorr)
     r: int
     s: int
     txid: bytes
@@ -99,6 +104,17 @@ class SigItem:
     key_index: int = 0
     num_sigs: int = 1
     num_keys: int = 1
+    # "ecdsa" | "schnorr" — BCH interprets any 65-byte signature blob as
+    # Schnorr (2019-05 upgrade); single-sig templates only (Schnorr in
+    # CHECKMULTISIG was consensus-invalid in the 2019 rules this mirrors,
+    # so 65-byte multisig sigs stay auto-invalid candidates)
+    algo: str = "ecdsa"
+
+    @property
+    def verify_item(self) -> tuple:
+        """The engine's VerifyItem tuple form (5-tuple when Schnorr)."""
+        t = (self.pubkey, self.z, self.r, self.s)
+        return t + ("schnorr",) if self.algo == "schnorr" else t
 
 
 @dataclass
@@ -271,10 +287,16 @@ def _single_item(
     if len(sig_blob) < 9:
         return None
     hashtype = sig_blob[-1]
-    rs = parse_der_signature(sig_blob[:-1])
-    if rs is None:
-        return None
-    r, s = rs
+    # BCH consensus: a 65-byte signature blob (64 + hashtype) IS Schnorr.
+    schnorr = bch and len(sig_blob) == 65
+    if schnorr:
+        r = int.from_bytes(sig_blob[0:32], "big")
+        s = int.from_bytes(sig_blob[32:64], "big")
+    else:
+        rs = parse_der_signature(sig_blob[:-1])
+        if rs is None:
+            return None
+        r, s = rs
     script_code = _p2pkh_script_code(pub_blob)
     if segwit or (bch and hashtype & SIGHASH_FORKID):
         if prevout_amounts is None or idx not in prevout_amounts:
@@ -283,6 +305,11 @@ def _single_item(
     else:
         z = legacy_sighash(tx, idx, script_code, hashtype)
     pub = decode_pubkey(pub_blob)
+    if schnorr:
+        if pub is None:
+            return [SigItem(None, 0, r, s, tx.txid, idx, algo="schnorr")]
+        e = schnorr_challenge(r, pub, z)
+        return [SigItem(pub, e, r, s, tx.txid, idx, algo="schnorr")]
     return [SigItem(pubkey=pub, z=z, r=r, s=s, txid=tx.txid, input_index=idx)]
 
 

@@ -10,7 +10,8 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
+
+import numpy as np
 from typing import Optional, Sequence
 
 from .ecdsa_cpu import Point
@@ -22,13 +23,9 @@ _LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libsecp_cpu.so")
 
 
 def _ensure_built() -> str:
-    if not os.path.exists(_LIB_PATH):
-        subprocess.run(
-            ["make", "-C", os.path.join(_REPO_ROOT, "native"), "build/libsecp_cpu.so"],
-            check=True,
-            capture_output=True,
-        )
-    return _LIB_PATH
+    from ..native import ensure_native_lib
+
+    return ensure_native_lib(_LIB_PATH, "secp256k1")
 
 
 class NativeVerifier:
@@ -39,13 +36,14 @@ class NativeVerifier:
         self._lib = ctypes.CDLL(path)
         self._lib.secp_verify_batch.restype = ctypes.c_int
         self._lib.secp_verify_batch.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_int,
-            ctypes.c_char_p,
+            ctypes.c_char_p,  # px
+            ctypes.c_char_p,  # py
+            ctypes.c_char_p,  # z (digest or schnorr challenge)
+            ctypes.c_char_p,  # r
+            ctypes.c_char_p,  # s
+            ctypes.c_char_p,  # present/algo (None = all ecdsa)
+            ctypes.c_int,  # count
+            ctypes.c_char_p,  # out
         ]
         import numpy as _np
         from numpy.ctypeslib import ndpointer
@@ -73,6 +71,7 @@ class NativeVerifier:
             i32,  # r2
             u8,  # r2_valid
             u8,  # host_valid
+            u8,  # schnorr
             ctypes.c_int,  # nthreads
         ]
 
@@ -106,77 +105,48 @@ class NativeVerifier:
             "r2": np.zeros((24, size), np.int32),
             "r2_valid": np.zeros(size, np.uint8),
             "host_valid": np.zeros(size, np.uint8),
+            "schnorr": np.zeros(size, np.uint8),
         }
         bad = self._lib.secp_prepare_batch(
             px, py, z, r, s, present, count, size,
             out["d1a"], out["d1b"], out["d2a"], out["d2b"], out["negs"],
             out["qx"], out["qy"], out["r1"], out["r2"],
-            out["r2_valid"], out["host_valid"], nthreads,
+            out["r2_valid"], out["host_valid"], out["schnorr"], nthreads,
         )
         if bad:
             raise ValueError(f"native prep: {bad} GLV half-scalars out of range")
         return out
 
-    def verify_batch(
-        self, items: Sequence[tuple[Optional[Point], int, int, int]]
-    ) -> list[bool]:
-        """items: (pubkey|None, z, r, s) tuples — same shape as the oracle's
+    def verify_batch(self, items: Sequence[tuple]) -> list[bool]:
+        """items: (pubkey|None, z, r, s) ECDSA tuples or 5-tuples tagged
+        "schnorr" (z = precomputed challenge) — same shape as the oracle's
         ``verify_batch_cpu``.  ``None`` pubkeys are auto-invalid (matching
         the oracle and kernel.prepare_batch's host_valid mask)."""
         n = len(items)
         if n == 0:
             return []
-        px = bytearray()
-        py = bytearray()
-        zs = bytearray()
-        rs = bytearray()
-        ss = bytearray()
-        from .ecdsa_cpu import CURVE_N
+        # Range checks on the ORIGINAL ints happen in pack_items: r/s from
+        # lax DER can exceed 2^256, and truncating them mod 2^256 could
+        # alias a hostile value onto a valid one — the oracle/TPU paths
+        # reject such items, so this backend must too (never pack-then-
+        # check).  pack_items zeroes those rows with present=0.
+        from .raw import pack_items
 
-        degenerate = [False] * n
-        for i, (q, z, r, s) in enumerate(items):
-            # Range-check the ORIGINAL ints before packing: r/s from lax DER
-            # can exceed 2^256, and truncating them mod 2^256 could alias a
-            # hostile value onto a valid one — the oracle/TPU paths reject
-            # such items, so this backend must too (never pack-then-check).
-            if (
-                q is None
-                or q.infinity
-                or not (0 < r < CURVE_N)
-                or not (0 < s < CURVE_N)
-            ):
-                degenerate[i] = True
-                px += b"\x00" * 32
-                py += b"\x00" * 32
-                zs += b"\x00" * 32
-                rs += b"\x00" * 32
-                ss += b"\x00" * 32
-                continue
-            px += q.x.to_bytes(32, "big")
-            py += q.y.to_bytes(32, "big")
-            zs += (z % CURVE_N).to_bytes(32, "big")
-            rs += r.to_bytes(32, "big")
-            ss += s.to_bytes(32, "big")
-        out = ctypes.create_string_buffer(n)
-        self._lib.secp_verify_batch(
-            bytes(px), bytes(py), bytes(zs), bytes(rs), bytes(ss), n, out
-        )
-        return [
-            (not degenerate[i]) and out.raw[i] == 1 for i in range(n)
-        ]
+        return self.verify_raw(pack_items(items))
 
     def verify_raw(self, raw) -> list[bool]:
         """Verify a packed :class:`tpunode.verify.raw.RawBatch` — the
-        zero-copy path from the native extractor.  ``present == 0`` rows
-        carry zeros, which already fail the in-engine r-range check; the
-        mask is ANDed anyway so the contract doesn't depend on that."""
+        zero-copy path from the native extractor.  ``present`` carries the
+        per-row algorithm (0 absent, 1 ecdsa, 2 schnorr) straight into the
+        C engine."""
         n = len(raw)
         if n == 0:
             return []
         out = ctypes.create_string_buffer(n)
+        present = np.ascontiguousarray(raw.present, dtype=np.uint8)
         self._lib.secp_verify_batch(
             raw.px.tobytes(), raw.py.tobytes(), raw.z.tobytes(),
-            raw.r.tobytes(), raw.s.tobytes(), n, out,
+            raw.r.tobytes(), raw.s.tobytes(), present.tobytes(), n, out,
         )
         return [bool(raw.present[i]) and out.raw[i] == 1 for i in range(n)]
 

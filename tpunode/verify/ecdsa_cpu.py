@@ -23,6 +23,11 @@ __all__ = [
     "sign",
     "verify",
     "verify_batch_cpu",
+    "jacobi",
+    "schnorr_challenge",
+    "sign_schnorr",
+    "verify_schnorr",
+    "verify_schnorr_e",
 ]
 
 # Curve: y^2 = x^3 + 7 over F_p
@@ -180,8 +185,88 @@ def verify(pubkey: Optional[Point], z: int, r: int, s: int) -> bool:
     return R.x % CURVE_N == r
 
 
+# --- BCH Schnorr (2019-05 upgrade spec) ------------------------------------
+#
+# Signature is 64 bytes r ∥ s (r an Fp x-coordinate, s a scalar).  Verify:
+# with e = SHA256(ser256(r) ∥ ser_compressed(P) ∥ ser256(m)) mod n, compute
+# R' = s·G − e·P and accept iff R' is finite, jacobi(y(R')) = 1, and
+# x(R') = r.  Same dual-scalar MSM shape as ECDSA (u1 = s, u2 = n − e), so
+# the batch kernel verifies both algorithms with one program.  The
+# reference's libsecp256k1 grew this capability for BCH the same year
+# (stack.yaml:5,9 pulls the BCH-era library).
+
+
+def jacobi(a: int) -> int:
+    """Legendre/Jacobi symbol of ``a`` mod p via Euler's criterion."""
+    if a % CURVE_P == 0:
+        return 0
+    return 1 if pow(a, (CURVE_P - 1) // 2, CURVE_P) == 1 else -1
+
+
+def _compress(p: Point) -> bytes:
+    return bytes([2 + (p.y & 1)]) + p.x.to_bytes(32, "big")
+
+
+def schnorr_challenge(r: int, pubkey: Point, m: int) -> int:
+    """e = SHA256(r ∥ P_compressed ∥ m) mod n (single SHA256 per the BCH
+    2019 schnorr spec — not BIP340's tagged hash)."""
+    import hashlib
+
+    digest = hashlib.sha256(
+        r.to_bytes(32, "big") + _compress(pubkey) + m.to_bytes(32, "big")
+    ).digest()
+    return int.from_bytes(digest, "big") % CURVE_N
+
+
+def sign_schnorr(priv: int, m: int, nonce: int) -> tuple[int, int]:
+    """Deterministic-nonce test signing helper (NOT for production use)."""
+    k = nonce % CURVE_N or 1
+    R = point_mul(k, GENERATOR)
+    if jacobi(R.y) != 1:
+        k = CURVE_N - k
+        R = Point(R.x, CURVE_P - R.y)
+    r = R.x
+    pub = point_mul(priv, GENERATOR)
+    e = schnorr_challenge(r, pub, m)
+    s = (k + e * priv) % CURVE_N
+    return r, s
+
+
+def verify_schnorr_e(
+    pubkey: Optional[Point], e: int, r: int, s: int
+) -> bool:
+    """Schnorr verification from a precomputed challenge ``e`` — the form
+    batch items carry (extraction computes e, so no hashing downstream)."""
+    if not (0 <= r < CURVE_P and 0 <= s < CURVE_N):
+        return False
+    if pubkey is None or pubkey.infinity or not pubkey.on_curve():
+        return False
+    R = point_add(
+        point_mul(s, GENERATOR), point_mul(CURVE_N - e % CURVE_N, pubkey)
+    )
+    if R.infinity:
+        return False
+    return jacobi(R.y) == 1 and R.x == r
+
+
+def verify_schnorr(pubkey: Optional[Point], m: int, r: int, s: int) -> bool:
+    """Full Schnorr verification over the message hash ``m``."""
+    if pubkey is None or pubkey.infinity:
+        return False
+    return verify_schnorr_e(pubkey, schnorr_challenge(r, pubkey, m), r, s)
+
+
 def verify_batch_cpu(
-    items: Sequence[tuple[Optional[Point], int, int, int]],
+    items: Sequence[tuple],
 ) -> list[bool]:
-    """Sequential batch verify: list of (pubkey|None, z, r, s)."""
-    return [verify(q, z, r, s) for q, z, r, s in items]
+    """Sequential batch verify.  Items are ``(pubkey|None, z, r, s)`` for
+    ECDSA or ``(pubkey|None, e, r, s, "schnorr")`` for BCH Schnorr (``e``
+    the precomputed challenge)."""
+    out = []
+    for item in items:
+        if len(item) >= 5 and item[4] == "schnorr":
+            out.append(verify_schnorr_e(item[0], item[1], item[2], item[3]))
+        else:
+            q, z, r, s = item[:4]
+            out.append(verify(q, z, r, s))
+    return out

@@ -82,7 +82,14 @@ def _device_warmup(batch_size: int, device_batch: int = 0) -> str:
     devs = [d for d in jax.devices() if d.platform == "tpu"]
     if not devs:
         raise RuntimeError("no TPU device visible")
-    from .ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
+    from .ecdsa_cpu import (
+        CURVE_N,
+        GENERATOR,
+        point_mul,
+        schnorr_challenge,
+        sign,
+        sign_schnorr,
+    )
     from .kernel import verify_batch_tpu
 
     items = []
@@ -91,6 +98,13 @@ def _device_warmup(batch_size: int, device_batch: int = 0) -> str:
         priv = (0xA11CE + i) % CURVE_N
         pub = point_mul(priv, GENERATOR)
         z = (0xD00D << i) % CURVE_N
+        if i % 4 == 1:  # schnorr lanes compile+check in the same program
+            r, s = sign_schnorr(priv, z, 0xC0FFEE + i)
+            if i % 3 == 2:
+                z ^= 1
+            items.append((pub, schnorr_challenge(r, pub, z), r, s, "schnorr"))
+            expect.append(i % 3 != 2)
+            continue
         r, s = sign(priv, z, 0xC0FFEE + i)
         if i % 3 == 2:
             z ^= 1

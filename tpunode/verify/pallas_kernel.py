@@ -37,7 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from . import field as F
 from . import pallas_field as PF
 from .curve import pt_add, pt_double
-from .kernel import BETA, G_TABLE, LG_TABLE, WINDOWS
+from .kernel import _EULER_DIGITS, BETA, G_TABLE, LG_TABLE, WINDOWS
 
 __all__ = ["verify_blocked", "verify_blocked_impl", "BLOCK"]
 
@@ -92,10 +92,12 @@ def _kernel(
     qy_ref,
     r1_ref,
     r2_ref,
-    flags_ref,  # (2, B) int32: [r2_valid, host_valid]
+    flags_ref,  # (3, B) int32: [r2_valid, host_valid, schnorr]
+    euler_ref,  # (1, 64) int32: Euler exponent 4-bit digits, MSB first
     out_ref,  # (1, B) int32
     qtab_ref,  # scratch (16, 3, L, B)
     lqtab_ref,  # scratch (16, 3, L, B)
+    powtab_ref,  # scratch (16, L, B): Euler pow window table
 ):
     b = out_ref.shape[-1]
     L = F.NLIMBS
@@ -162,13 +164,44 @@ def _kernel(
     acc = lax.fori_loop(0, WINDOWS, window, inf)
 
     # ---- projective check x(R) ∈ {r, r+n} and curve membership ------------
-    X, Z = acc[0], acc[2]
+    X, Y, Z = acc[0], acc[1], acc[2]
     not_inf = ~PF.is_zero(Z)
     m1 = PF.eq(X, PF.mul(r1_ref[:], Z))
     m2 = PF.eq(X, PF.mul(r2_ref[:], Z)) & (flags_ref[0:1] != 0)
     seven = PF.const_col(_SEVEN_LIMBS, b)
     on_curve = PF.eq(PF.sqr(qy), PF.mul(PF.sqr(qx), qx) + seven)
-    valid = (flags_ref[1:2] != 0) & on_curve & not_inf & (m1 | m2)
+
+    # ---- jacobi(y(R)) for Schnorr lanes -----------------------------------
+    # y = Y/Z so jacobi(y) = jacobi(Y·Z); Euler pow t^((p-1)/2) == 1 as a
+    # windowed 4-bit exponentiation: the digit sequence is a compile-time
+    # constant (_EULER_DIGITS), the 16-entry power table lives in VMEM.
+    t = PF.mul(Y, Z)
+    powtab_ref[0] = one
+    powtab_ref[1] = t
+
+    def pow_build(k, carry):
+        powtab_ref[pl.ds(k, 1)] = PF.mul(powtab_ref[pl.ds(k - 1, 1)][0], t)[
+            None
+        ]
+        return carry
+
+    lax.fori_loop(2, 16, pow_build, 0)
+
+    def pow_window(w, pacc):
+        pacc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(pacc))))
+        d = euler_ref[0, w]
+        sel = None
+        for tv in range(16):
+            contrib = jnp.where(d == tv, powtab_ref[tv], 0)
+            sel = contrib if sel is None else sel + contrib
+        return PF.mul(pacc, sel)
+
+    pacc = lax.fori_loop(0, 64, pow_window, one)
+    jac_ok = PF.eq(pacc, one)
+
+    is_sch = flags_ref[2:3] != 0
+    algo_ok = jnp.where(is_sch, m1 & jac_ok, m1 | m2)
+    valid = (flags_ref[1:2] != 0) & on_curve & not_inf & algo_ok
     out_ref[:] = valid.astype(jnp.int32)
 
 
@@ -187,30 +220,36 @@ def verify_blocked_impl(
     r2,
     r2_valid,
     host_valid,
+    schnorr,
     *,
     interpret: bool = False,
     block: int = BLOCK,
 ) -> jnp.ndarray:
     """Un-jitted kernel body — reused inside shard_map by multichip.py
     (a jitted callee cannot be shard_mapped).  See :func:`verify_blocked`."""
-    BLOCK = block
+    blk = block
     bsz = qx.shape[-1]
-    if bsz % BLOCK != 0:
-        raise ValueError(f"batch {bsz} not a multiple of BLOCK={BLOCK}")
-    grid = bsz // BLOCK
+    if bsz % blk != 0:
+        raise ValueError(f"batch {bsz} not a multiple of BLOCK={blk}")
+    grid = bsz // blk
 
     negs = jnp.stack(
         [a.astype(jnp.int32) for a in (n1a, n1b, n2a, n2b)], axis=0
     )
     flags = jnp.stack(
-        [r2_valid.astype(jnp.int32), host_valid.astype(jnp.int32)], axis=0
+        [
+            r2_valid.astype(jnp.int32),
+            host_valid.astype(jnp.int32),
+            schnorr.astype(jnp.int32),
+        ],
+        axis=0,
     )
 
     def col(rows):  # BlockSpec for a (rows, B) input walked along lanes
-        return pl.BlockSpec((rows, BLOCK), lambda i: (0, i))
+        return pl.BlockSpec((rows, blk), lambda i: (0, i))
 
     tab_spec = pl.BlockSpec(
-        (16, 3, F.NLIMBS, BLOCK), lambda i: (0, 0, 0, 0)
+        (16, 3, F.NLIMBS, blk), lambda i: (0, 0, 0, 0)
     )
     out = pl.pallas_call(
         _kernel,
@@ -228,17 +267,19 @@ def verify_blocked_impl(
             col(F.NLIMBS),
             col(F.NLIMBS),
             col(F.NLIMBS),
-            col(2),
+            col(3),
+            pl.BlockSpec((1, 64), lambda i: (0, 0)),
         ],
         out_specs=col(1),
         scratch_shapes=[
-            pltpu.VMEM((16, 3, F.NLIMBS, BLOCK), jnp.int32),
-            pltpu.VMEM((16, 3, F.NLIMBS, BLOCK), jnp.int32),
+            pltpu.VMEM((16, 3, F.NLIMBS, blk), jnp.int32),
+            pltpu.VMEM((16, 3, F.NLIMBS, blk), jnp.int32),
+            pltpu.VMEM((16, F.NLIMBS, blk), jnp.int32),
         ],
         interpret=interpret,
     )(
-        _const_table(_G_NP, BLOCK),
-        _const_table(_LG_NP, BLOCK),
+        _const_table(_G_NP, blk),
+        _const_table(_LG_NP, blk),
         d1a.astype(jnp.int32),
         d1b.astype(jnp.int32),
         d2a.astype(jnp.int32),
@@ -249,6 +290,7 @@ def verify_blocked_impl(
         r1,
         r2,
         flags,
+        jnp.asarray(_EULER_DIGITS).reshape(1, 64),
     )
     return out[0].astype(jnp.bool_)
 

@@ -3,11 +3,20 @@
 ``RawBatch`` is the zero-Python-int interchange format between the native
 extractor (tpunode/txextract.py), the C++ CPU verifier (``secp_verify_batch``)
 and the TPU prep (``secp_prepare_batch``): five ``(N, 32)`` uint8 arrays of
-big-endian values plus a per-item ``present`` flag.  Tuple items (the
-engine's ``VerifyItem``) pack into it with the same degenerate-item rules the
-CPU backend always applied (None/infinity pubkey, out-of-range r/s — checked
-on the ORIGINAL ints, so oversized lax-DER values can't alias); rows with
-``present == 0`` verify False on every backend.
+big-endian values plus a per-item ``present`` flag carrying the algorithm:
+
+* ``present == 0``: auto-invalid row (zeros elsewhere) — verifies False on
+  every backend;
+* ``present == 1``: ECDSA — ``z`` is the sighash digest, ``r``/``s`` the
+  DER scalars;
+* ``present == 2``: BCH Schnorr — ``z`` is the PRECOMPUTED challenge ``e``
+  (extraction hashes it once; no backend re-hashes), ``r`` the Fp
+  x-coordinate, ``s`` the scalar.
+
+Tuple items (the engine's ``VerifyItem``) pack into it with the same
+degenerate-item rules the CPU backend always applied (None/infinity pubkey,
+out-of-range r/s — checked on the ORIGINAL ints, so oversized lax-DER
+values can't alias).
 """
 
 from __future__ import annotations
@@ -17,7 +26,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .ecdsa_cpu import CURVE_N, Point
+from .ecdsa_cpu import CURVE_N, CURVE_P, Point
 
 __all__ = ["RawBatch", "pack_items", "as_raw_batch", "concat_raw"]
 
@@ -31,7 +40,7 @@ class RawBatch:
     z: np.ndarray
     r: np.ndarray
     s: np.ndarray
-    present: np.ndarray  # (N,) uint8; 0 = auto-invalid row (zeros elsewhere)
+    present: np.ndarray  # (N,) uint8; 0 = absent, 1 = ecdsa, 2 = schnorr
 
     def __len__(self) -> int:
         return len(self.present)
@@ -46,34 +55,33 @@ class RawBatch:
             present=self.present[lo:hi],
         )
 
-    def to_tuples(self) -> list[tuple[Optional[Point], int, int, int]]:
+    def to_tuples(self) -> list[tuple]:
         """VerifyItem tuples (oracle backend / cross-checks).  Rows with
         ``present == 0`` become ``(None, 0, 0, 0)`` — same verdict (False)
-        as whatever degenerate original they packed from."""
+        as whatever degenerate original they packed from; ``present == 2``
+        rows come back as 5-tuples tagged ``"schnorr"``."""
         out = []
         for i in range(len(self)):
             if not self.present[i]:
                 out.append((None, 0, 0, 0))
                 continue
-            out.append(
-                (
-                    Point(
-                        int.from_bytes(self.px[i].tobytes(), "big"),
-                        int.from_bytes(self.py[i].tobytes(), "big"),
-                    ),
-                    int.from_bytes(self.z[i].tobytes(), "big"),
-                    int.from_bytes(self.r[i].tobytes(), "big"),
-                    int.from_bytes(self.s[i].tobytes(), "big"),
-                )
+            tup = (
+                Point(
+                    int.from_bytes(self.px[i].tobytes(), "big"),
+                    int.from_bytes(self.py[i].tobytes(), "big"),
+                ),
+                int.from_bytes(self.z[i].tobytes(), "big"),
+                int.from_bytes(self.r[i].tobytes(), "big"),
+                int.from_bytes(self.s[i].tobytes(), "big"),
             )
+            out.append(tup + ("schnorr",) if self.present[i] == 2 else tup)
         return out
 
 
-def pack_items(
-    items: Sequence[tuple[Optional[Point], int, int, int]]
-) -> RawBatch:
-    """Pack VerifyItem tuples, applying the degenerate-row rules on the
-    original ints (mirrors NativeVerifier.verify_batch's packing)."""
+def pack_items(items: Sequence[tuple]) -> RawBatch:
+    """Pack VerifyItem tuples (4-tuples ECDSA, 5-tuples tagged "schnorr"),
+    applying the degenerate-row rules on the original ints (mirrors
+    NativeVerifier.verify_batch's packing)."""
     n = len(items)
     px = np.zeros((n, 32), np.uint8)
     py = np.zeros((n, 32), np.uint8)
@@ -81,15 +89,20 @@ def pack_items(
     r = np.zeros((n, 32), np.uint8)
     s = np.zeros((n, 32), np.uint8)
     present = np.zeros(n, np.uint8)
-    for i, (q, zi, ri, si) in enumerate(items):
-        if (
-            q is None
-            or q.infinity
-            or not (0 < ri < CURVE_N)
-            or not (0 < si < CURVE_N)
-        ):
+    for i, item in enumerate(items):
+        q, zi, ri, si = item[:4]
+        schnorr = len(item) >= 5 and item[4] == "schnorr"
+        if q is None or q.infinity:
             continue
-        present[i] = 1
+        if schnorr:
+            # spec ranges: r an Fp element, s a scalar; zero allowed
+            if not (0 <= ri < CURVE_P and 0 <= si < CURVE_N):
+                continue
+            present[i] = 2
+        else:
+            if not (0 < ri < CURVE_N and 0 < si < CURVE_N):
+                continue
+            present[i] = 1
         px[i] = np.frombuffer(q.x.to_bytes(32, "big"), np.uint8)
         py[i] = np.frombuffer(q.y.to_bytes(32, "big"), np.uint8)
         z[i] = np.frombuffer((zi % CURVE_N).to_bytes(32, "big"), np.uint8)
