@@ -1,0 +1,173 @@
+"""Unit tests for bench.py's orchestration (the driver artifact).
+
+Rounds 1-3 each lost the headline number to a different avoidable failure
+(VERDICT r3 weak #1), so the probe -> ladder -> fallback logic is pinned
+here with a stubbed worker runner — no jax, no subprocesses.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _run_main(monkeypatch, bench, script):
+    """Run bench.main() with a scripted _run_worker; returns (json, calls)."""
+    calls = []
+
+    def fake_run_worker(mode, timeout, env_extra=None):
+        calls.append((mode, timeout, dict(env_extra or {})))
+        for match, result in script:
+            if match(mode, env_extra or {}):
+                return dict(result)
+        raise AssertionError(f"unexpected worker call: {mode} {env_extra}")
+
+    monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
+    monkeypatch.setattr(
+        bench,
+        "cpu_single_core_bench",
+        lambda items: (5000.0, "native-cpp", [True] * len(items)),
+        raising=False,
+    )
+    # cpu_single_core_bench / make_triples are imported inside main();
+    # patch at the source (make_triples would otherwise pure-Python-sign
+    # 512 items per test)
+    import benchmarks.common as common
+
+    monkeypatch.setattr(
+        common, "cpu_single_core_bench",
+        lambda items: (5000.0, "native-cpp", [True] * len(items)),
+    )
+    monkeypatch.setattr(common, "make_triples", lambda n, **kw: [(None, 0, 0, 0)] * n)
+
+    out = []
+    monkeypatch.setattr(
+        "builtins.print", lambda *a, **k: out.append(" ".join(map(str, a)))
+    )
+    rc = 0
+    try:
+        bench.main()
+    except SystemExit as e:
+        rc = e.code
+    line = json.loads(out[-1])
+    return line, calls, rc
+
+
+def _is_probe(mode, env):
+    return mode == "--probe"
+
+
+def _batch(n):
+    return lambda mode, env: (
+        mode == "--worker" and env.get("TPUNODE_BENCH_BATCH") == str(n)
+        and env.get("TPUNODE_BENCH_REQUIRE_TPU") == "1"
+    )
+
+
+def _is_fallback(mode, env):
+    return mode == "--worker" and env.get("TPUNODE_BENCH_FORCE_CPU") == "1"
+
+
+def test_happy_path_first_ladder_step(monkeypatch):
+    bench = _load_bench()
+    line, calls, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 3.0}),
+            (_batch(32768), {"ok": True, "rate": 200000.0, "device": "tpu:v5e",
+                             "kernel": "pallas", "batch": 32768}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 200000.0
+    assert line["vs_baseline"] == 40.0
+    assert line["device"] == "tpu:v5e"
+    # ladder stopped after the first success: probe + one worker call
+    assert len(calls) == 2
+
+
+def test_degrades_down_the_ladder(monkeypatch):
+    bench = _load_bench()
+    line, calls, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 3.0}),
+            (_batch(32768), {"ok": False, "error": "timed out after 270s"}),
+            (_batch(8192), {"ok": False, "error": "timed out after 150s"}),
+            (_batch(4096), {"ok": True, "rate": 50000.0, "device": "tpu:v5e",
+                            "kernel": "pallas", "batch": 4096}),
+        ],
+    )
+    assert line["value"] == 50000.0 and rc == 0
+    assert "tpu@32768" in line["attempts"] and "tpu@8192" in line["attempts"]
+
+
+def test_dead_tunnel_fast_fails_to_cpu(monkeypatch):
+    bench = _load_bench()
+    line, calls, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": False, "error": "timed out after 120s"}),
+            (_batch(4096), {"ok": False, "error": "timed out after 150s"}),
+            (_is_fallback, {"ok": True, "rate": 500.0, "device": "cpu:cpu",
+                            "kernel": "xla", "batch": 2048}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 500.0
+    assert line["device"] == "cpu:cpu"
+    assert "tpu_error" in line  # labeled, not silent
+    # dead tunnel: only ONE last-chance tpu attempt (small batch), then cpu
+    tpu_attempts = [c for c in calls if _batch(32768)(*c[:1], c[2]) or
+                    c[2].get("TPUNODE_BENCH_REQUIRE_TPU") == "1"]
+    assert len(tpu_attempts) == 1
+
+
+def test_fatal_mismatch_never_masked(monkeypatch):
+    """A device/oracle verdict mismatch must abort with rc=1 — never retried
+    or hidden behind the cpu fallback."""
+    bench = _load_bench()
+    line, calls, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 3.0}),
+            (_batch(32768), {"ok": False, "fatal": True,
+                             "error": "device/oracle verdict mismatch"}),
+        ],
+    )
+    assert rc == 1
+    assert line["value"] == 0.0
+    assert len(calls) == 2  # no retry, no fallback
+
+
+def test_output_is_single_json_line_with_required_keys(monkeypatch):
+    bench = _load_bench()
+    line, _, _ = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": False, "error": "nope"}),
+            (_batch(4096), {"ok": False, "error": "nope"}),
+            (_is_fallback, {"ok": False, "error": "also nope"}),
+        ],
+    )
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in line
+    assert isinstance(line["value"], (int, float))  # numeric even on total loss
