@@ -87,8 +87,34 @@ def point_double(p: Point) -> Point:
     return Point(x, y)
 
 
+# Fixed-base window table for G, built lazily: table[w][d] = d * 16^w * G.
+# The oracle favors clarity, but G-multiplies dominate test signing and
+# benchmark workload generation (hours of wall over a round); the windowed
+# path is ~6x faster and bit-identical (cross-checked against the generic
+# ladder in tests and against OpenSSL).
+_G_TABLE: list[list[Point]] = []
+
+
+def _g_table() -> list[list[Point]]:
+    if not _G_TABLE:
+        base = GENERATOR
+        for _ in range(64):
+            row = [INFINITY]
+            for _d in range(15):
+                row.append(point_add(row[-1], base))
+            _G_TABLE.append(row)
+            base = point_double(point_double(point_double(point_double(base))))
+    return _G_TABLE
+
+
 def point_mul(k: int, p: Point) -> Point:
     k %= CURVE_N
+    if p == GENERATOR:
+        table = _g_table()
+        acc = INFINITY
+        for w in range(64):
+            acc = point_add(acc, table[w][(k >> (4 * w)) & 0xF])
+        return acc
     acc = INFINITY
     addend = p
     while k:
