@@ -1114,23 +1114,127 @@ long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
                  int32_t *item_nkeys,
                  uint8_t *txids, int32_t *tx_n_inputs, int32_t *tx_extracted,
                  int32_t *tx_items, int32_t *tx_sigs,
+                 int32_t *tx_coinbase, int32_t *tx_unsupported);
+
+// Handle API: parse once, then run prevout listing and extraction (and any
+// retries) over the SAME parsed spans — block ingest with the amount
+// oracle previously parsed the region three times (scan for capacity,
+// prevouts, extract).  The handle owns a copy of the wire bytes so spans
+// stay valid independent of the caller's buffer lifetime.
+struct TxxHandle {
+  std::vector<uint8_t> data;
+  std::vector<TxSpan> txs;
+  long capacity = 0;  // candidate item bound
+  long inputs = 0;    // total input count (ext_amounts row count)
+};
+
+void *txx_parse(const uint8_t *data, long len, long tx_count) {
+  TxxHandle *h = new TxxHandle;
+  h->data.assign(data, data + len);
+  h->txs.reserve(tx_count > 0 ? size_t(tx_count) : 16);
+  Cursor c{h->data.data(), h->data.data() + len};
+  long n = 0;
+  while (c.ok && (tx_count < 0 ? c.remaining() > 0 : n < tx_count)) {
+    h->txs.emplace_back();
+    if (!parse_tx(c, h->txs.back(), /*compute_txid=*/true)) {
+      delete h;
+      return nullptr;
+    }
+    ++n;
+  }
+  if (tx_count >= 0 && n != tx_count) {
+    delete h;
+    return nullptr;
+  }
+  for (const TxSpan &tx : h->txs) {
+    for (const InSpan &in : tx.ins) {
+      InTemplate t;
+      classify_input(in, t);
+      h->capacity += t.kind == InTemplate::MULTISIG
+                         ? long(t.ms.m) * (t.ms.n - t.ms.m + 1)
+                         : 1;
+      ++h->inputs;
+    }
+  }
+  return h;
+}
+
+void txx_parse_free(void *h) { delete static_cast<TxxHandle *>(h); }
+
+long txx_parsed_txs(void *h) {
+  return long(static_cast<TxxHandle *>(h)->txs.size());
+}
+long txx_parsed_capacity(void *h) {
+  return static_cast<TxxHandle *>(h)->capacity;
+}
+long txx_parsed_inputs(void *h) {
+  return static_cast<TxxHandle *>(h)->inputs;
+}
+
+long txx_prevouts_h(void *hp, int bch, long capacity, uint8_t *txids32,
+                    int64_t *vouts, uint8_t *wants) {
+  TxxHandle *h = static_cast<TxxHandle *>(hp);
+  long flat = 0;
+  static const uint8_t ZERO_TXID[32] = {0};
+  for (const TxSpan &tx : h->txs) {
+    for (const InSpan &in : tx.ins) {
+      if (flat >= capacity) return -2;
+      memcpy(txids32 + flat * 32, in.prevout, 32);
+      uint32_t vout;
+      memcpy(&vout, in.prevout + 32, 4);
+      vouts[flat] = int64_t(vout);
+      bool cb = memcmp(in.prevout, ZERO_TXID, 32) == 0;
+      wants[flat] = (!cb && (bch || in.wit_count >= 2)) ? 1 : 0;
+      ++flat;
+    }
+  }
+  return flat;
+}
+
+long txx_extract_h(void *hp, int flags, const int64_t *ext_amounts,
+                   long n_ext, long capacity, uint8_t *z, uint8_t *px,
+                   uint8_t *py, uint8_t *r, uint8_t *s, uint8_t *present,
+                   int32_t *item_tx, int32_t *item_input, int32_t *item_sig,
+                   int32_t *item_key, int32_t *item_nsigs,
+                   int32_t *item_nkeys, uint8_t *txids,
+                   int32_t *tx_n_inputs, int32_t *tx_extracted,
+                   int32_t *tx_items, int32_t *tx_sigs, int32_t *tx_coinbase,
+                   int32_t *tx_unsupported);
+
+// Legacy one-shot entry: parse + extract in one call.
+long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
+                 const int64_t *ext_amounts, long n_ext, long capacity,
+                 uint8_t *z, uint8_t *px, uint8_t *py, uint8_t *r, uint8_t *s,
+                 uint8_t *present, int32_t *item_tx, int32_t *item_input,
+                 int32_t *item_sig, int32_t *item_key, int32_t *item_nsigs,
+                 int32_t *item_nkeys,
+                 uint8_t *txids, int32_t *tx_n_inputs, int32_t *tx_extracted,
+                 int32_t *tx_items, int32_t *tx_sigs,
                  int32_t *tx_coinbase, int32_t *tx_unsupported) {
+  void *h = txx_parse(data, len, tx_count);
+  if (h == nullptr) return -1;
+  long out = txx_extract_h(h, flags, ext_amounts, n_ext, capacity, z, px, py,
+                           r, s, present, item_tx, item_input, item_sig,
+                           item_key, item_nsigs, item_nkeys, txids,
+                           tx_n_inputs, tx_extracted, tx_items, tx_sigs,
+                           tx_coinbase, tx_unsupported);
+  txx_parse_free(h);
+  return out;
+}
+
+// Extraction body over an already-parsed handle.
+long txx_extract_h(void *hp, int flags, const int64_t *ext_amounts,
+                   long n_ext, long capacity, uint8_t *z, uint8_t *px,
+                   uint8_t *py, uint8_t *r, uint8_t *s, uint8_t *present,
+                   int32_t *item_tx, int32_t *item_input, int32_t *item_sig,
+                   int32_t *item_key, int32_t *item_nsigs,
+                   int32_t *item_nkeys, uint8_t *txids,
+                   int32_t *tx_n_inputs, int32_t *tx_extracted,
+                   int32_t *tx_items, int32_t *tx_sigs, int32_t *tx_coinbase,
+                   int32_t *tx_unsupported) {
+  std::vector<TxSpan> &txs = static_cast<TxxHandle *>(hp)->txs;
   bool bch = (flags & 1) != 0;
   bool intra = (flags & 2) != 0;
-
-  // pass 1: parse every tx, compute txids, build the amount map
-  std::vector<TxSpan> txs;
-  txs.reserve(tx_count > 0 ? size_t(tx_count) : 16);
-  {
-    Cursor c{data, data + len};
-    long n = 0;
-    while (c.ok && (tx_count < 0 ? c.remaining() > 0 : n < tx_count)) {
-      txs.emplace_back();
-      if (!parse_tx(c, txs.back(), /*compute_txid=*/true)) return -1;
-      ++n;
-    }
-    if (tx_count >= 0 && n != tx_count) return -1;
-  }
   std::unordered_map<OutpointKey, int64_t, OutpointHash> amounts;
   if (intra) {
     size_t total_outs = 0;

@@ -371,7 +371,7 @@ class Node:
         batch extract failure each tx retries individually
         (:meth:`_verify_txs_native`), so one hostile peer cannot fail
         other peers' verdicts."""
-        from .txextract import extract_raw, scan_prevouts
+        from .txextract import ParsedTxRegion
 
         bch = self.cfg.net.bch
         # Bounded drain batches: one giant extract+verify would add seconds
@@ -383,25 +383,29 @@ class Node:
             del self._tx_accum[:DRAIN_BATCH]
             concat = b"".join(r for _, _, r in batch)
             try:
-                ext: Optional[list[int]] = None
-                if self.cfg.prevout_lookup is not None:
-                    pv_txids, pv_vouts, pv_wants = await asyncio.to_thread(
-                        scan_prevouts, concat, len(batch), bch
-                    )
-                    lookup = self.cfg.prevout_lookup
-                    ext = [-1] * len(pv_wants)
-                    for i in pv_wants.nonzero()[0]:
-                        amt = lookup(pv_txids[i].tobytes(), int(pv_vouts[i]))
-                        if amt is not None:
-                            ext[int(i)] = amt
-                items = await asyncio.to_thread(
-                    extract_raw,
-                    concat,
-                    len(batch),
-                    bch=bch,
-                    intra_amounts=False,
-                    ext_amounts=ext,
+                region = await asyncio.to_thread(
+                    ParsedTxRegion, concat, len(batch)
                 )
+                try:
+                    ext: Optional[list[int]] = None
+                    if self.cfg.prevout_lookup is not None:
+                        pv_txids, pv_vouts, pv_wants = region.scan_prevouts(bch)
+                        lookup = self.cfg.prevout_lookup
+                        ext = [-1] * len(pv_wants)
+                        for i in pv_wants.nonzero()[0]:
+                            amt = lookup(
+                                pv_txids[i].tobytes(), int(pv_vouts[i])
+                            )
+                            if amt is not None:
+                                ext[int(i)] = amt
+                    items = await asyncio.to_thread(
+                        region.extract,
+                        bch=bch,
+                        intra_amounts=False,
+                        ext_amounts=ext,
+                    )
+                finally:
+                    region.close()
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -504,7 +508,7 @@ class Node:
         malformed-region extract error fails the whole message's txs
         (the Python path can fail per tx)."""
         assert self.verify_engine is not None
-        from .txextract import extract_raw, scan_prevouts
+        from .txextract import ParsedTxRegion
 
         bch = self.cfg.net.bch
 
@@ -525,7 +529,18 @@ class Node:
                               error=f"extract: {e}")
                 )
 
+        region: Optional[ParsedTxRegion] = None
         try:
+            # ONE native parse feeds both the prevout listing and the
+            # extraction (ParsedTxRegion; the amount-oracle path used to
+            # parse the region twice more).
+            try:
+                region = await asyncio.to_thread(ParsedTxRegion, raw, n_txs)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                _publish_extract_error(e)
+                return
             # Out-of-block BIP143 amounts via the embedder's oracle,
             # flattened per input in parse order.  The native side consults
             # its intra-block map FIRST, so resolving every amount-capable
@@ -534,15 +549,7 @@ class Node:
             # the oracle would have said).
             ext: Optional[list[int]] = None
             if self.cfg.prevout_lookup is not None:
-                try:
-                    pv_txids, pv_vouts, pv_wants = await asyncio.to_thread(
-                        scan_prevouts, raw, n_txs, bch
-                    )
-                except asyncio.CancelledError:
-                    raise
-                except Exception as e:
-                    _publish_extract_error(e)
-                    return
+                pv_txids, pv_vouts, pv_wants = region.scan_prevouts(bch)
                 lookup = self.cfg.prevout_lookup
                 ext = [-1] * len(pv_wants)
                 for i in pv_wants.nonzero()[0]:
@@ -551,9 +558,7 @@ class Node:
                         ext[int(i)] = amt
             try:
                 items = await asyncio.to_thread(
-                    extract_raw,
-                    raw,
-                    n_txs,
+                    region.extract,
                     bch=bch,
                     intra_amounts=n_txs > 1,
                     ext_amounts=ext,
@@ -587,6 +592,8 @@ class Node:
                     TxVerdict(peer, items.txid(ti), all(vs), vs, items.stats(ti))
                 )
         finally:
+            if region is not None:
+                region.close()
             if tracked:
                 self._verify_pending -= 1
 

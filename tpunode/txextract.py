@@ -28,6 +28,7 @@ from .txverify import ExtractStats
 
 __all__ = [
     "RawSigItems",
+    "ParsedTxRegion",
     "extract_raw",
     "scan_prevouts",
     "load_txextract_lib",
@@ -103,6 +104,26 @@ def load_txextract_lib() -> ctypes.CDLL:
             u8,  # txids (capacity, 32)
             i64,  # vouts (int64: vout >= 2^31 must not go negative)
             u8,  # wants
+        ]
+        # handle API: one parse feeds prevout listing + extraction
+        lib.txx_parse.restype = ctypes.c_void_p
+        lib.txx_parse.argtypes = [ctypes.c_char_p, ctypes.c_long, ctypes.c_long]
+        lib.txx_parse_free.argtypes = [ctypes.c_void_p]
+        for name in ("txx_parsed_txs", "txx_parsed_capacity", "txx_parsed_inputs"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_long
+            fn.argtypes = [ctypes.c_void_p]
+        lib.txx_prevouts_h.restype = ctypes.c_long
+        lib.txx_prevouts_h.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_long, u8, i64, u8,
+        ]
+        lib.txx_extract_h.restype = ctypes.c_long
+        lib.txx_extract_h.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_long,
+            ctypes.c_long,
+            u8, u8, u8, u8, u8, u8,  # z px py r s present
+            i32, i32, i32, i32, i32, i32,  # item_*
+            u8, i32, i32, i32, i32, i32, i32,  # txids + tx_*
         ]
         lib._ext_amounts_t = i64  # kept for callers building arrays
         _lib = lib
@@ -268,6 +289,126 @@ def scan_prevouts(
     return txids[:n], vouts[:n], wants[:n]
 
 
+class ParsedTxRegion:
+    """One native parse of a raw tx region, reusable for prevout listing
+    and extraction (the parse used to run 2-3 times per block when the
+    amount oracle was in play; code-review r4 finding 5).  Use as a
+    context manager or rely on __del__; the handle owns a copy of the
+    bytes, so the caller's buffer may be released."""
+
+    def __init__(self, data: bytes, tx_count: int = -1):
+        self._lib = load_txextract_lib()
+        self._h = self._lib.txx_parse(data, len(data), tx_count)
+        if not self._h:
+            raise ValueError("malformed transaction data")
+        self.n_txs = int(self._lib.txx_parsed_txs(self._h))
+        self.capacity = int(self._lib.txx_parsed_capacity(self._h))
+        self.n_inputs = int(self._lib.txx_parsed_inputs(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.txx_parse_free(self._h)
+            self._h = None
+
+    def __enter__(self) -> "ParsedTxRegion":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def scan_prevouts(
+        self, bch: bool = False
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Same rows as module-level :func:`scan_prevouts`, zero re-parse."""
+        assert self._h, "region closed"
+        cap = max(1, self.n_inputs)
+        txids = np.zeros((cap, 32), np.uint8)
+        vouts = np.zeros(cap, np.int64)
+        wants = np.zeros(cap, np.uint8)
+        n = self._lib.txx_prevouts_h(
+            self._h, 1 if bch else 0, cap, txids, vouts, wants
+        )
+        if n < 0:
+            raise ValueError(f"txx_prevouts_h failed ({n})")
+        return txids[:n], vouts[:n], wants[:n]
+
+    def extract(
+        self,
+        bch: bool = False,
+        intra_amounts: bool = True,
+        ext_amounts: Optional[Sequence[int]] = None,
+    ) -> RawSigItems:
+        """Same result as :func:`extract_raw`, zero re-parse."""
+        assert self._h, "region closed"
+        capacity = max(1, self.capacity)
+        nt = max(1, self.n_txs)
+        out = RawSigItems(
+            count=0,
+            z=np.zeros((capacity, 32), np.uint8),
+            px=np.zeros((capacity, 32), np.uint8),
+            py=np.zeros((capacity, 32), np.uint8),
+            r=np.zeros((capacity, 32), np.uint8),
+            s=np.zeros((capacity, 32), np.uint8),
+            present=np.zeros(capacity, np.uint8),
+            item_tx=np.zeros(capacity, np.int32),
+            item_input=np.zeros(capacity, np.int32),
+            item_sig=np.zeros(capacity, np.int32),
+            item_key=np.zeros(capacity, np.int32),
+            item_nsigs=np.zeros(capacity, np.int32),
+            item_nkeys=np.zeros(capacity, np.int32),
+            txids=np.zeros((nt, 32), np.uint8),
+            tx_n_inputs=np.zeros(nt, np.int32),
+            tx_extracted=np.zeros(nt, np.int32),
+            tx_items=np.zeros(nt, np.int32),
+            tx_sigs=np.zeros(nt, np.int32),
+            tx_coinbase=np.zeros(nt, np.int32),
+            tx_unsupported=np.zeros(nt, np.int32),
+        )
+        flags = (1 if bch else 0) | (2 if intra_amounts else 0)
+        if ext_amounts is not None:
+            ext = np.asarray(
+                [(-1 if a is None else a) for a in ext_amounts], np.int64
+            )
+            ext_ptr = ext.ctypes.data_as(ctypes.c_void_p)
+            n_ext = len(ext)
+        else:
+            ext = None  # noqa: F841 — keep the array alive through the call
+            ext_ptr = None
+            n_ext = 0
+        count = self._lib.txx_extract_h(
+            self._h, flags, ext_ptr, n_ext, capacity,
+            out.z, out.px, out.py, out.r, out.s, out.present,
+            out.item_tx, out.item_input,
+            out.item_sig, out.item_key, out.item_nsigs, out.item_nkeys,
+            out.txids, out.tx_n_inputs, out.tx_extracted,
+            out.tx_items, out.tx_sigs,
+            out.tx_coinbase, out.tx_unsupported,
+        )
+        if count < 0:
+            raise ValueError(f"txx_extract_h failed ({count})")
+        # trim to the actual item count (views, no copies)
+        out.count = int(count)
+        for name in (
+            "z", "px", "py", "r", "s", "present",
+            "item_tx", "item_input", "item_sig", "item_key",
+            "item_nsigs", "item_nkeys",
+        ):
+            setattr(out, name, getattr(out, name)[:count])
+        # per-tx arrays keep their true n_txs length
+        for name in (
+            "txids", "tx_n_inputs", "tx_extracted", "tx_items", "tx_sigs",
+            "tx_coinbase", "tx_unsupported",
+        ):
+            setattr(out, name, getattr(out, name)[: self.n_txs])
+        return out
+
+
 def extract_raw(
     data: bytes,
     tx_count: int = -1,
@@ -284,71 +425,12 @@ def extract_raw(
     or ``None`` entries meaning unknown — consulted after the intra map,
     mirroring node._verify_txs's block_outs -> prevout_lookup precedence.
 
+    One-shot convenience over :class:`ParsedTxRegion` (use that directly
+    to combine prevout listing + extraction over a single parse).
+
     Raises ValueError on malformed data.
     """
-    lib = load_txextract_lib()
-    cap = ctypes.c_long()
-    n_txs = lib.txx_scan(data, len(data), tx_count, ctypes.byref(cap))
-    if n_txs < 0:
-        raise ValueError("malformed transaction data")
-    capacity = max(1, cap.value)
-    nt = max(1, n_txs)
-    out = RawSigItems(
-        count=0,
-        z=np.zeros((capacity, 32), np.uint8),
-        px=np.zeros((capacity, 32), np.uint8),
-        py=np.zeros((capacity, 32), np.uint8),
-        r=np.zeros((capacity, 32), np.uint8),
-        s=np.zeros((capacity, 32), np.uint8),
-        present=np.zeros(capacity, np.uint8),
-        item_tx=np.zeros(capacity, np.int32),
-        item_input=np.zeros(capacity, np.int32),
-        item_sig=np.zeros(capacity, np.int32),
-        item_key=np.zeros(capacity, np.int32),
-        item_nsigs=np.zeros(capacity, np.int32),
-        item_nkeys=np.zeros(capacity, np.int32),
-        txids=np.zeros((nt, 32), np.uint8),
-        tx_n_inputs=np.zeros(nt, np.int32),
-        tx_extracted=np.zeros(nt, np.int32),
-        tx_items=np.zeros(nt, np.int32),
-        tx_sigs=np.zeros(nt, np.int32),
-        tx_coinbase=np.zeros(nt, np.int32),
-        tx_unsupported=np.zeros(nt, np.int32),
-    )
-    flags = (1 if bch else 0) | (2 if intra_amounts else 0)
-    if ext_amounts is not None:
-        ext = np.asarray(
-            [(-1 if a is None else a) for a in ext_amounts], np.int64
+    with ParsedTxRegion(data, tx_count) as region:
+        return region.extract(
+            bch=bch, intra_amounts=intra_amounts, ext_amounts=ext_amounts
         )
-        ext_ptr = ext.ctypes.data_as(ctypes.c_void_p)
-        n_ext = len(ext)
-    else:
-        ext = None  # noqa: F841 — keep the array alive through the call
-        ext_ptr = None
-        n_ext = 0
-    count = lib.txx_extract(
-        data, len(data), n_txs, flags, ext_ptr, n_ext, capacity,
-        out.z, out.px, out.py, out.r, out.s, out.present,
-        out.item_tx, out.item_input,
-        out.item_sig, out.item_key, out.item_nsigs, out.item_nkeys,
-        out.txids, out.tx_n_inputs, out.tx_extracted,
-        out.tx_items, out.tx_sigs,
-        out.tx_coinbase, out.tx_unsupported,
-    )
-    if count < 0:
-        raise ValueError(f"txx_extract failed ({count})")
-    # trim to the actual item count (views, no copies)
-    out.count = int(count)
-    for name in (
-        "z", "px", "py", "r", "s", "present",
-        "item_tx", "item_input", "item_sig", "item_key",
-        "item_nsigs", "item_nkeys",
-    ):
-        setattr(out, name, getattr(out, name)[:count])
-    # per-tx arrays keep their true n_txs length
-    for name in (
-        "txids", "tx_n_inputs", "tx_extracted", "tx_items", "tx_sigs",
-        "tx_coinbase", "tx_unsupported",
-    ):
-        setattr(out, name, getattr(out, name)[:n_txs])
-    return out
