@@ -45,6 +45,10 @@ class NativeVerifier:
             ctypes.c_int,  # count
             ctypes.c_char_p,  # out
         ]
+        self._lib.secp_verify_batch_mt.restype = ctypes.c_int
+        self._lib.secp_verify_batch_mt.argtypes = (
+            self._lib.secp_verify_batch.argtypes + [ctypes.c_int]  # nthreads
+        )
         import numpy as _np
         from numpy.ctypeslib import ndpointer
 
@@ -134,20 +138,29 @@ class NativeVerifier:
 
         return self.verify_raw(pack_items(items))
 
-    def verify_raw(self, raw) -> list[bool]:
+    def verify_raw(self, raw, nthreads: int = 1) -> list[bool]:
         """Verify a packed :class:`tpunode.verify.raw.RawBatch` — the
         zero-copy path from the native extractor.  ``present`` carries the
         per-row algorithm (0 absent, 1 ecdsa, 2 schnorr) straight into the
-        C engine."""
+        C engine.  ``nthreads`` != 1 splits rows across OS threads (0 =
+        hardware concurrency) — the engine passes VerifyConfig.cpu_threads
+        so multi-core hosts scale the fallback path."""
         n = len(raw)
         if n == 0:
             return []
         out = ctypes.create_string_buffer(n)
         present = np.ascontiguousarray(raw.present, dtype=np.uint8)
-        self._lib.secp_verify_batch(
-            raw.px.tobytes(), raw.py.tobytes(), raw.z.tobytes(),
-            raw.r.tobytes(), raw.s.tobytes(), present.tobytes(), n, out,
-        )
+        if nthreads == 1:
+            self._lib.secp_verify_batch(
+                raw.px.tobytes(), raw.py.tobytes(), raw.z.tobytes(),
+                raw.r.tobytes(), raw.s.tobytes(), present.tobytes(), n, out,
+            )
+        else:
+            self._lib.secp_verify_batch_mt(
+                raw.px.tobytes(), raw.py.tobytes(), raw.z.tobytes(),
+                raw.r.tobytes(), raw.s.tobytes(), present.tobytes(), n, out,
+                nthreads,
+            )
         return [bool(raw.present[i]) and out.raw[i] == 1 for i in range(n)]
 
 

@@ -141,6 +141,9 @@ class VerifyConfig:
     # while the C++ engine verifies ~4.8k sigs/s — crossover near
     # batch_size/4.  Small remainder chunks also route to CPU.
     min_tpu_batch: int = 1024
+    # CPU-fallback verify parallelism: 1 = serial (the measurement-honest
+    # default on this 1-core dev box), 0 = all hardware threads, N = N OS
+    # threads (secp_verify_batch_mt; each MSM row is independent).
     cpu_threads: int = 1
     # device warmup discipline
     warmup_timeout: float = 600.0  # backend=tpu: max wait for warmup
@@ -366,7 +369,8 @@ class VerifyEngine:
                 out = self._run_tpu(payloads)  # counts tpu/cpu items per chunk
             elif backend == "cpu" and self._cpu is not None:
                 out = self._cpu.verify_raw(
-                    concat_raw([as_raw_batch(p) for p in payloads])
+                    concat_raw([as_raw_batch(p) for p in payloads]),
+                    nthreads=self.cfg.cpu_threads,
                 )
                 metrics.inc("verify.cpu_items", total)
             else:
