@@ -1044,7 +1044,9 @@ long txx_scan(const uint8_t *data, long len, long tx_count,
     }
     ++txs;
   }
-  if (tx_count >= 0 && txs != tx_count) return -1;
+  // exact consumption: trailing bytes after tx_count txs are malformed
+  // (mirror of wire.LazyBlock/LazyTx, which raise on trailing bytes)
+  if (tx_count >= 0 && (txs != tx_count || c.remaining() > 0)) return -1;
   if (capacity_out) *capacity_out = capacity;
   return txs;
 }
@@ -1080,7 +1082,7 @@ long txx_prevouts(const uint8_t *data, long len, long tx_count, int bch,
     }
     ++n;
   }
-  if (tx_count >= 0 && n != tx_count) return -1;
+  if (tx_count >= 0 && (n != tx_count || c.remaining() > 0)) return -1;
   return flat;
 }
 
@@ -1142,7 +1144,9 @@ void *txx_parse(const uint8_t *data, long len, long tx_count) {
     }
     ++n;
   }
-  if (tx_count >= 0 && n != tx_count) {
+  if (tx_count >= 0 && (n != tx_count || c.remaining() > 0)) {
+    // exact consumption: trailing bytes after tx_count txs are malformed
+    // (mirror of wire.LazyBlock/LazyTx, which raise on trailing bytes)
     delete h;
     return nullptr;
   }
