@@ -475,6 +475,60 @@ bool verify_one_schnorr(const uint8_t *px, const uint8_t *py,
   return fe_euler_is_one(FP.mul(acc.y, acc.z));
 }
 
+// BIP340 (taproot) verification from a precomputed tagged challenge: the
+// same MSM, acceptance x(R) == r over Fp AND y(R) EVEN (not jacobi).
+// The pubkey columns carry the lift_x'd even-y point.
+bool verify_one_bip340(const uint8_t *px, const uint8_t *py,
+                       const uint8_t *e32, const uint8_t *r32,
+                       const uint8_t *s32) {
+  Fe qx = fe_from_be(px), qy = fe_from_be(py);
+  Fe r = fe_from_be(r32);
+  if (ge(r, FP.m)) return false;
+  Fe s = fe_from_be(s32);
+  if (ge(s, FN.m)) return false;
+  if (ge(qx, FP.m) || ge(qy, FP.m)) return false;
+  Fe lhs = FP.sqr(qy);
+  Fe rhs = FP.add(FP.mul(FP.sqr(qx), qx), Fe{{7, 0, 0, 0}});
+  if (!fe_eq(lhs, rhs)) return false;
+
+  Fe e = fe_from_be(e32);
+  while (ge(e, FN.m)) sub_mod_raw(e, FN.m);
+  Fe u2{{0, 0, 0, 0}};
+  if (!is_zero(e)) {
+    u2 = Fe{{FN.m[0], FN.m[1], FN.m[2], FN.m[3]}};
+    sub_mod_raw(u2, e.v);
+  }
+  const Fe &u1 = s;
+
+  Pt tq[16];
+  tq[0] = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
+  tq[1] = Pt{qx, qy, {{1, 0, 0, 0}}};
+  for (int i = 2; i < 16; ++i) tq[i] = pt_add(tq[i - 1], tq[1]);
+
+  Pt acc = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
+  for (int w4 = 63; w4 >= 0; --w4) {
+    if (!pt_inf(acc)) {
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+      acc = pt_double(acc);
+    }
+    int limb = w4 / 16, shift = (w4 % 16) * 4;
+    int d1 = (int)((u1.v[limb] >> shift) & 0xF);
+    int d2 = (int)((u2.v[limb] >> shift) & 0xF);
+    if (d1) acc = pt_add(acc, TAB.g[d1]);
+    if (d2) acc = pt_add(acc, tq[d2]);
+  }
+  if (pt_inf(acc)) return false;
+  Fe zz = FP.sqr(acc.z);
+  if (!fe_eq(FP.mul(r, zz), acc.x)) return false;
+  // evenness needs the affine y = Y / Z^3
+  Fe zi = FP.inv(acc.z);
+  Fe zi2 = FP.sqr(zi);
+  Fe y_aff = FP.mul(acc.y, FP.mul(zi2, zi));
+  return (y_aff.v[0] & 1) == 0;
+}
+
 // Verify rows [lo, hi) (shared by the serial entry and the threaded one);
 // returns the number of valid rows in the range.
 int secp_verify_rows(const uint8_t *px, const uint8_t *py, const uint8_t *z,
@@ -489,6 +543,9 @@ int secp_verify_rows(const uint8_t *px, const uint8_t *py, const uint8_t *z,
     } else if (present != nullptr && present[i] == 2) {
       ok = verify_one_schnorr(px + 32 * i, py + 32 * i, z + 32 * i,
                               r + 32 * i, s + 32 * i);
+    } else if (present != nullptr && present[i] == 3) {
+      ok = verify_one_bip340(px + 32 * i, py + 32 * i, z + 32 * i,
+                             r + 32 * i, s + 32 * i);
     } else {
       ok = s_ok[i] && verify_one(px + 32 * i, py + 32 * i, z + 32 * i,
                                  r + 32 * i, w[i]);
@@ -564,7 +621,7 @@ int secp_verify_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
   bool *s_ok = new bool[count];
   Fe run{{1, 0, 0, 0}};
   for (int i = 0; i < count; ++i) {
-    bool schnorr = present != nullptr && present[i] == 2;
+    bool schnorr = present != nullptr && present[i] >= 2;
     Fe si = fe_from_be(s + 32 * i);
     s_ok[i] = !schnorr && !(is_zero(si) || ge(si, FN.m));
     sv[i] = s_ok[i] ? si : Fe{{1, 0, 0, 0}};
@@ -760,7 +817,7 @@ int secp_verify_batch_mt(const uint8_t *px, const uint8_t *py,
   std::vector<char> s_okv(count);
   Fe run{{1, 0, 0, 0}};
   for (int i = 0; i < count; ++i) {
-    bool schnorr = present != nullptr && present[i] == 2;
+    bool schnorr = present != nullptr && present[i] >= 2;
     Fe si = fe_from_be(s + 32 * i);
     s_okv[i] = !schnorr && !(is_zero(si) || ge(si, FN.m));
     sv[i] = s_okv[i] ? si : Fe{{1, 0, 0, 0}};
@@ -808,7 +865,7 @@ int secp_prepare_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
                        int32_t *d1a, int32_t *d1b, int32_t *d2a, int32_t *d2b,
                        uint8_t *negs, int32_t *qx, int32_t *qy, int32_t *r1,
                        int32_t *r2, uint8_t *r2_valid, uint8_t *host_valid,
-                       uint8_t *schnorr, int nthreads) {
+                       uint8_t *schnorr, uint8_t *bip340, int nthreads) {
   // ---- serial: validity + Montgomery batch inversion of s (ECDSA rows) ----
   std::vector<Fe> sv(count), prefix(count), w(count);
   std::vector<uint8_t> ok(count), is_sch(count);
@@ -816,7 +873,7 @@ int secp_prepare_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
   for (int i = 0; i < count; ++i) {
     Fe si = fe_from_be(s + 32 * i);
     Fe ri = fe_from_be(r + 32 * i);
-    is_sch[i] = present[i] == 2;
+    is_sch[i] = present[i] >= 2;  // both Schnorr variants: u1=s, u2=n-e
     if (is_sch[i]) {
       // spec ranges: r < p, s < n; zero allowed for both
       ok[i] = !ge(si, FN.m) && !ge(ri, FP.m);
@@ -847,7 +904,7 @@ int secp_prepare_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
       Fe ri = fe_from_be(r + 32 * i);
       Fe u1, u2;
       if (is_sch[i]) {
-        schnorr[i] = 1;
+        (present[i] == 2 ? schnorr : bip340)[i] = 1;
         u1 = fe_from_be(s + 32 * i);  // u1 = s (< n, checked)
         u2 = Fe{{0, 0, 0, 0}};        // u2 = n - e (mod n)
         if (!is_zero(zi)) {

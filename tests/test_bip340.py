@@ -1,0 +1,162 @@
+"""BIP340 (taproot) Schnorr as a verify primitive, across every backend.
+
+Third algorithm over the same dual-scalar MSM: x-only pubkeys lifted to
+the even-y point, a tagged challenge, and acceptance x(R) = r AND y(R)
+EVEN (the device computes parity via a Fermat-inverse windowed pow).
+Items are 5-tuples tagged "bip340" / RawBatch.present == 3.  Extraction
+does NOT emit these: a taproot keypath spend carries no pubkey on the
+wire (it lives in the prevout scriptPubKey, behind the embedder's UTXO
+set) and the BIP341 sighash needs every input's amount and script — the
+primitive is what an embedder with a UTXO set plugs into the engine.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpunode.verify.ecdsa_cpu import (
+    CURVE_N,
+    CURVE_P,
+    GENERATOR,
+    bip340_challenge,
+    lift_x,
+    point_mul,
+    sign_bip340,
+    tagged_hash,
+    verify_batch_cpu,
+    verify_bip340,
+    verify_bip340_e,
+)
+
+rng = random.Random(0xB1340)
+
+
+def _item(corrupt: str = ""):
+    priv = rng.getrandbits(256) % CURVE_N or 1
+    px = point_mul(priv, GENERATOR).x
+    m = rng.getrandbits(256)
+    r, s = sign_bip340(priv, m, rng.getrandbits(256))
+    if corrupt == "m":
+        m ^= 1
+    elif corrupt == "s":
+        s = (s + 1) % CURVE_N
+    e = bip340_challenge(r, px, m)
+    return (lift_x(px), e, r, s, "bip340"), corrupt == ""
+
+
+def _batch(n):
+    items, expect = [], []
+    for i in range(n):
+        it, ok = _item("m" if i % 5 == 2 else "s" if i % 5 == 4 else "")
+        items.append(it)
+        expect.append(ok)
+    return items, expect
+
+
+def test_oracle_roundtrip_and_rules():
+    for _ in range(6):
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        px = point_mul(priv, GENERATOR).x
+        m = rng.getrandbits(256)
+        r, s = sign_bip340(priv, m, rng.getrandbits(256))
+        assert verify_bip340(px, m, r, s)
+        assert not verify_bip340(px, m ^ 1, r, s)
+        # the lifted pubkey always has even y; R' of a valid sig too
+        P = lift_x(px)
+        assert P.y % 2 == 0
+    (P, e, r, s, _), _ = _item()
+    assert not verify_bip340_e(P, e, CURVE_P, s)  # r out of Fp range
+    assert not verify_bip340_e(P, e, r, CURVE_N)  # s out of scalar range
+    assert not verify_bip340_e(None, e, r, s)
+    assert not verify_bip340(CURVE_P, 1, 1, 1)  # x not liftable
+
+
+def test_tagged_hash_structure():
+    # SHA256(SHA256(tag) || SHA256(tag) || data) — self-consistency probes
+    import hashlib
+
+    th = hashlib.sha256(b"BIP0340/challenge").digest()
+    assert tagged_hash(b"BIP0340/challenge", b"xyz") == hashlib.sha256(
+        th + th + b"xyz"
+    ).digest()
+
+
+def test_native_cpp_matches_oracle():
+    from tpunode.verify.cpu_native import load_native_verifier
+
+    nv = load_native_verifier()
+    if nv is None:
+        pytest.skip("native verifier unavailable")
+    items, expect = _batch(30)
+    assert nv.verify_batch(items) == expect
+    assert True in expect and False in expect
+
+
+def test_rawbatch_roundtrip():
+    from tpunode.verify.raw import pack_items
+
+    items, expect = _batch(10)
+    raw = pack_items(items)
+    assert (raw.present == 3).sum() == 10
+    assert verify_batch_cpu(raw.to_tuples()) == expect
+
+
+def test_xla_kernel_mixed_with_other_algos():
+    jax = pytest.importorskip("jax")
+    del jax
+    from tpunode.verify.ecdsa_cpu import (
+        schnorr_challenge,
+        sign,
+        sign_schnorr,
+    )
+    from tpunode.verify.kernel import verify_batch_tpu
+
+    items, expect = _batch(10)
+    for i in range(10):  # interleave the other algorithms
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        m = rng.getrandbits(256)
+        if i % 2 == 0:
+            r, s = sign(priv, m, rng.getrandbits(256) % CURVE_N or 1)
+            items.append((pub, m, r, s))
+        else:
+            r, s = sign_schnorr(priv, m, rng.getrandbits(256))
+            items.append((pub, schnorr_challenge(r, pub, m), r, s, "schnorr"))
+        expect.append(True)
+    got = verify_batch_tpu(items, pad_to=32)
+    assert got == expect
+
+
+def test_pallas_interpret():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from tpunode.verify.kernel import prepare_batch
+    from tpunode.verify.pallas_kernel import verify_blocked_impl
+
+    items, expect = _batch(8)
+    prep = prepare_batch(items, pad_to=8)
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    out = verify_blocked_impl(*args, interpret=True, block=8)
+    assert [bool(b) for b in out[:8]] == expect
+    del jax
+
+
+def test_native_prep_parity():
+    import numpy as np
+
+    from tpunode.verify.cpu_native import load_native_verifier
+    from tpunode.verify.kernel import _DEVICE_FIELDS, prepare_batch
+
+    if load_native_verifier() is None:
+        pytest.skip("native prep unavailable")
+    items, _ = _batch(12)
+    a = prepare_batch(items, pad_to=16, native=False)
+    b = prepare_batch(items, pad_to=16, native=True)
+    for name, _nd in _DEVICE_FIELDS:
+        assert np.array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name))
+        ), name
+    assert np.asarray(a.bip340).sum() == 12

@@ -670,3 +670,72 @@ async def test_tx_accumulator_isolates_malformed_tx():
         if ev.stats.unsupported == 0:
             assert ev.valid
     assert err.txid == b"" and "extract" in err.error
+
+
+@pytest.mark.asyncio
+async def test_node_reorgs_to_heavier_chain_from_second_peer():
+    """Full-stack reorg: the node syncs chain A from peer 1, then a second
+    peer appears carrying a heavier chain B (same genesis, more work) and
+    the chain actor switches best to B's tip (reference: connectBlocks'
+    chain-work compare + syncNewPeer on PeerConnected, Chain.hs:352-362)."""
+    from benchmarks.txgen import gen_chain
+    from tpunode import ChainBestBlock
+
+    chain_a = gen_chain(NET, 6, 1, seed=0xAAA, cache=None)
+    chain_b = gen_chain(NET, 9, 1, seed=0xBBB, cache=None)
+    assert chain_a[-1].header.hash != chain_b[-1].header.hash
+
+    a_synced = asyncio.Event()
+
+    def connect(sa):
+        import contextlib as _ctx
+
+        host = sa[0]
+
+        @_ctx.asynccontextmanager
+        async def factory():
+            if host == "192.0.2.2":
+                await a_synced.wait()  # peer 2 joins only after A is best
+                blocks = chain_b
+            else:
+                blocks = chain_a
+            async with dummy_peer_connect(NET, blocks)() as conn:
+                yield conn
+
+        return factory
+
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=MemoryKV(),
+        pub=pub,
+        max_peers=2,
+        peers=["192.0.2.1:8333", "192.0.2.2:8333"],
+        discover=False,
+        connect=connect,
+    )
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(30):
+                # phase 1: chain A becomes best
+                await events.receive_match(
+                    lambda ev: ev
+                    if isinstance(ev, ChainBestBlock) and ev.node.height == 6
+                    else None
+                )
+                assert node.chain.get_best().hash == chain_a[-1].header.hash
+                a_synced.set()
+                # phase 2: heavier chain B takes over
+                await events.receive_match(
+                    lambda ev: ev
+                    if isinstance(ev, ChainBestBlock) and ev.node.height == 9
+                    else None
+                )
+            best = node.chain.get_best()
+            assert best.hash == chain_b[-1].header.hash
+            assert node.chain.block_main(chain_b[-1].header.hash)
+            # A's tip is now a side-chain block
+            assert not node.chain.block_main(chain_a[-1].header.hash)
+            # split point of the two tips is genesis
+            a_node = node.chain.get_block(chain_a[-1].header.hash)
+            assert a_node is not None  # side chain retained in the store

@@ -76,6 +76,7 @@ class NativeVerifier:
             u8,  # r2_valid
             u8,  # host_valid
             u8,  # schnorr
+            u8,  # bip340
             ctypes.c_int,  # nthreads
         ]
 
@@ -110,12 +111,14 @@ class NativeVerifier:
             "r2_valid": np.zeros(size, np.uint8),
             "host_valid": np.zeros(size, np.uint8),
             "schnorr": np.zeros(size, np.uint8),
+            "bip340": np.zeros(size, np.uint8),
         }
         bad = self._lib.secp_prepare_batch(
             px, py, z, r, s, present, count, size,
             out["d1a"], out["d1b"], out["d2a"], out["d2b"], out["negs"],
             out["qx"], out["qy"], out["r1"], out["r2"],
-            out["r2_valid"], out["host_valid"], out["schnorr"], nthreads,
+            out["r2_valid"], out["host_valid"], out["schnorr"],
+            out["bip340"], nthreads,
         )
         if bad:
             raise ValueError(f"native prep: {bad} GLV half-scalars out of range")

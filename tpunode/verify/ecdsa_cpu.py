@@ -28,6 +28,12 @@ __all__ = [
     "sign_schnorr",
     "verify_schnorr",
     "verify_schnorr_e",
+    "tagged_hash",
+    "lift_x",
+    "bip340_challenge",
+    "sign_bip340",
+    "verify_bip340",
+    "verify_bip340_e",
 ]
 
 # Curve: y^2 = x^3 + 7 over F_p
@@ -282,16 +288,99 @@ def verify_schnorr(pubkey: Optional[Point], m: int, r: int, s: int) -> bool:
     return verify_schnorr_e(pubkey, schnorr_challenge(r, pubkey, m), r, s)
 
 
+# --- BIP340 Schnorr (taproot, BTC 2021) ------------------------------------
+#
+# Same R' = s·G − e·P shape again; differences from the BCH variant: x-only
+# public keys lifted to the EVEN-y point, a tagged challenge hash, and the
+# acceptance test requires y(R') even (not jacobi = 1).  Exposed as a
+# verify primitive (engine items tagged "bip340"); extraction does not
+# emit these because a taproot keypath spend carries no pubkey on the
+# wire — it lives in the prevout scriptPubKey, i.e. behind the embedder's
+# UTXO set, and the BIP341 sighash needs every input's amount and script.
+
+
+def tagged_hash(tag: bytes, data: bytes) -> bytes:
+    import hashlib
+
+    th = hashlib.sha256(tag).digest()
+    return hashlib.sha256(th + th + data).digest()
+
+
+def lift_x(x: int) -> Optional[Point]:
+    """The even-y point with x-coordinate ``x`` (BIP340 lift_x); None if
+    ``x`` is out of range or not on the curve."""
+    if not (0 <= x < CURVE_P):
+        return None
+    y2 = (x * x * x + CURVE_B) % CURVE_P
+    y = pow(y2, (CURVE_P + 1) // 4, CURVE_P)
+    if y * y % CURVE_P != y2:
+        return None
+    return Point(x, y if y % 2 == 0 else CURVE_P - y)
+
+
+def bip340_challenge(r: int, pubkey_x: int, m: int) -> int:
+    e = tagged_hash(
+        b"BIP0340/challenge",
+        r.to_bytes(32, "big") + pubkey_x.to_bytes(32, "big")
+        + m.to_bytes(32, "big"),
+    )
+    return int.from_bytes(e, "big") % CURVE_N
+
+
+def sign_bip340(priv: int, m: int, nonce: int) -> tuple[int, int]:
+    """Deterministic-nonce test signing helper (NOT for production use; the
+    BIP's aux-rand nonce derivation is skipped, signatures are still
+    spec-verifiable)."""
+    P = point_mul(priv, GENERATOR)
+    d = priv if P.y % 2 == 0 else CURVE_N - priv
+    k = nonce % CURVE_N or 1
+    R = point_mul(k, GENERATOR)
+    if R.y % 2 != 0:
+        k = CURVE_N - k
+        R = Point(R.x, CURVE_P - R.y)
+    r = R.x
+    e = bip340_challenge(r, P.x, m)
+    s = (k + e * d) % CURVE_N
+    return r, s
+
+
+def verify_bip340_e(
+    pubkey: Optional[Point], e: int, r: int, s: int
+) -> bool:
+    """BIP340 verification from a precomputed challenge.  ``pubkey`` must
+    be the lift_x'd (even-y) point."""
+    if not (0 <= r < CURVE_P and 0 <= s < CURVE_N):
+        return False
+    if pubkey is None or pubkey.infinity or not pubkey.on_curve():
+        return False
+    R = point_add(
+        point_mul(s, GENERATOR), point_mul(CURVE_N - e % CURVE_N, pubkey)
+    )
+    if R.infinity:
+        return False
+    return R.y % 2 == 0 and R.x == r
+
+
+def verify_bip340(pubkey_x: int, m: int, r: int, s: int) -> bool:
+    """Full BIP340 verification over an x-only public key."""
+    P = lift_x(pubkey_x)
+    if P is None:
+        return False
+    return verify_bip340_e(P, bip340_challenge(r, pubkey_x, m), r, s)
+
+
 def verify_batch_cpu(
     items: Sequence[tuple],
 ) -> list[bool]:
     """Sequential batch verify.  Items are ``(pubkey|None, z, r, s)`` for
-    ECDSA or ``(pubkey|None, e, r, s, "schnorr")`` for BCH Schnorr (``e``
-    the precomputed challenge)."""
+    ECDSA, or 5-tuples tagged ``"schnorr"`` (BCH) / ``"bip340"`` (taproot)
+    with the precomputed challenge in the z position."""
     out = []
     for item in items:
         if len(item) >= 5 and item[4] == "schnorr":
             out.append(verify_schnorr_e(item[0], item[1], item[2], item[3]))
+        elif len(item) >= 5 and item[4] == "bip340":
+            out.append(verify_bip340_e(item[0], item[1], item[2], item[3]))
         else:
             q, z, r, s = item[:4]
             out.append(verify(q, z, r, s))

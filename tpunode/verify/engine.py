@@ -85,9 +85,12 @@ def _device_warmup(batch_size: int, device_batch: int = 0) -> str:
     from .ecdsa_cpu import (
         CURVE_N,
         GENERATOR,
+        bip340_challenge,
+        lift_x,
         point_mul,
         schnorr_challenge,
         sign,
+        sign_bip340,
         sign_schnorr,
     )
     from .kernel import verify_batch_tpu
@@ -98,11 +101,21 @@ def _device_warmup(batch_size: int, device_batch: int = 0) -> str:
         priv = (0xA11CE + i) % CURVE_N
         pub = point_mul(priv, GENERATOR)
         z = (0xD00D << i) % CURVE_N
-        if i % 4 == 1:  # schnorr lanes compile+check in the same program
+        # every algorithm's lane compiles + cross-checks in the one program
+        if i % 4 == 1:
             r, s = sign_schnorr(priv, z, 0xC0FFEE + i)
             if i % 3 == 2:
                 z ^= 1
             items.append((pub, schnorr_challenge(r, pub, z), r, s, "schnorr"))
+            expect.append(i % 3 != 2)
+            continue
+        if i % 4 == 3:
+            r, s = sign_bip340(priv, z, 0xC0FFEE + i)
+            if i % 3 == 2:
+                z ^= 1
+            items.append(
+                (lift_x(pub.x), bip340_challenge(r, pub.x, z), r, s, "bip340")
+            )
             expect.append(i % 3 != 2)
             continue
         r, s = sign(priv, z, 0xC0FFEE + i)

@@ -143,6 +143,7 @@ _DEVICE_FIELDS = (
     ("r2_valid", 1),
     ("host_valid", 1),
     ("schnorr", 1),  # per-lane algorithm: BCH Schnorr instead of ECDSA
+    ("bip340", 1),  # per-lane algorithm: BIP340 (taproot) Schnorr
 )
 
 # For shard_map callers: which device_args are 2-D (batch trailing) vs 1-D.
@@ -227,9 +228,12 @@ def _ints_to_digits_np(vals: list[int]) -> np.ndarray:
     return out
 
 
-def _item_algo(item: tuple) -> bool:
-    """True if a VerifyItem tuple is tagged Schnorr (5th element)."""
-    return len(item) >= 5 and item[4] == "schnorr"
+def _item_algo(item: tuple) -> Optional[str]:
+    """The VerifyItem tuple's algorithm tag ("schnorr" / "bip340") or None
+    for plain ECDSA."""
+    if len(item) >= 5 and item[4] in ("schnorr", "bip340"):
+        return item[4]
+    return None
 
 
 def prepare_batch(
@@ -272,6 +276,7 @@ def prepare_batch(
     r2v = np.zeros((size,), dtype=bool)
     hv = np.zeros((size,), dtype=bool)
     sch = np.zeros((size,), dtype=bool)
+    b340 = np.zeros((size,), dtype=bool)
 
     s_vals = []
     s_idx = []
@@ -279,11 +284,12 @@ def prepare_batch(
         q, z, r, s = item[:4]
         if q is None or q.infinity:
             continue
-        if _item_algo(item):
+        tag = _item_algo(item)
+        if tag is not None:
             if not (0 <= r < CURVE_P and 0 <= s < CURVE_N):
                 continue
             hv[i] = True
-            sch[i] = True
+            (sch if tag == "schnorr" else b340)[i] = True
         else:
             if not (0 < r < CURVE_N and 0 < s < CURVE_N):
                 continue
@@ -309,7 +315,7 @@ def prepare_batch(
             continue
         q, z, r, s = item[:4]
         idxs.append(i)
-        if sch[i]:
+        if sch[i] or b340[i]:
             u1 = s % CURVE_N
             u2 = (CURVE_N - z % CURVE_N) % CURVE_N
         else:
@@ -328,7 +334,7 @@ def prepare_batch(
         gx.append(q.x)
         gy.append(q.y)
         gr1.append(r)
-        if not sch[i] and r + CURVE_N < CURVE_P:
+        if not (sch[i] or b340[i]) and r + CURVE_N < CURVE_P:
             r2_idx.append(i)
             gr2.append(r + CURVE_N)
     if idxs:
@@ -360,6 +366,7 @@ def prepare_batch(
         r2_valid=r2v,
         host_valid=hv,
         schnorr=sch,
+        bip340=b340,
         count=count,
     )
 
@@ -387,13 +394,13 @@ def _prepare_batch_native(
     px, py, zs, rs, ss, present = [], [], [], [], [], bytearray(count)
     for i, item in enumerate(items):
         q, z, r, s = item[:4]
-        schnorr = _item_algo(item)
+        tag = _item_algo(item)
         if q is not None and not q.infinity and (
             (0 <= r < CURVE_P and 0 <= s < CURVE_N)
-            if schnorr
+            if tag is not None
             else (0 < r < CURVE_N and 0 < s < CURVE_N)
         ):
-            present[i] = 2 if schnorr else 1
+            present[i] = 1 if tag is None else (2 if tag == "schnorr" else 3)
             px.append(q.x.to_bytes(32, "big"))
             py.append(q.y.to_bytes(32, "big"))
             zs.append((z % CURVE_N).to_bytes(32, "big"))
@@ -431,6 +438,7 @@ def _prepare_batch_native(
         r2_valid=out["r2_valid"].astype(bool),
         host_valid=out["host_valid"].astype(bool),
         schnorr=out["schnorr"].astype(bool),
+        bip340=out["bip340"].astype(bool),
         count=count,
     )
 
@@ -474,6 +482,7 @@ def prepare_batch_raw(raw, pad_to: Optional[int] = None) -> PreparedBatch:
         r2_valid=out["r2_valid"].astype(bool),
         host_valid=out["host_valid"].astype(bool),
         schnorr=out["schnorr"].astype(bool),
+        bip340=out["bip340"].astype(bool),
         count=count,
     )
 
@@ -513,21 +522,23 @@ def _signed(entry: jnp.ndarray, neg: jnp.ndarray) -> jnp.ndarray:
     return entry.at[1].set(jnp.where(neg, -entry[1], entry[1]))
 
 
-# Euler's criterion exponent (p-1)/2 as 64 MSB-first 4-bit digits — a
-# compile-time constant, so the windowed pow below needs no data-dependent
-# digit extraction.
+# Constant-exponent digit tables (64 MSB-first 4-bit digits each) for the
+# two fixed powers the acceptance tests need — compile-time constants, so
+# the windowed pow needs no data-dependent digit extraction.
 _EULER_DIGITS = np.array(
     [((CURVE_P - 1) // 2 >> (4 * (63 - i))) & 0xF for i in range(64)],
     dtype=np.int32,
-)
+)  # Euler's criterion: jacobi via t^((p-1)/2)
+_PM2_DIGITS = np.array(
+    [((CURVE_P - 2) >> (4 * (63 - i))) & 0xF for i in range(64)],
+    dtype=np.int32,
+)  # Fermat inverse: z^(p-2)
 
 
-def _euler_is_one(t: jnp.ndarray) -> jnp.ndarray:
-    """Legendre symbol check ``t^((p-1)/2) ≡ 1 (mod p)`` for a (L, B) limb
-    column — the jacobi(y) acceptance test of BCH Schnorr, computed as a
-    windowed 4-bit pow (15 table muls + 64×(4 sqr + 1 mul)): ~12% of the
-    MSM's cost, paid once per batch for every lane uniformly (branch-free
-    SPMD — ECDSA lanes simply ignore the bit)."""
+def _pow_const(t: jnp.ndarray, digits: np.ndarray) -> jnp.ndarray:
+    """Windowed 4-bit pow by a COMPILE-TIME exponent for a (L, B) limb
+    column: 15 table muls + 64×(4 sqr + 1 mul) ≈ 12% of the MSM's cost,
+    paid once per batch for every lane uniformly (branch-free SPMD)."""
     one = jnp.broadcast_to(F.ONE, t.shape)
 
     def tstep(acc, _):
@@ -544,8 +555,14 @@ def _euler_is_one(t: jnp.ndarray) -> jnp.ndarray:
         )
         return F.mul(acc, sel), None
 
-    acc, _ = lax.scan(step, one, jnp.asarray(_EULER_DIGITS))
-    return F.eq(acc, one)
+    acc, _ = lax.scan(step, one, jnp.asarray(digits))
+    return acc
+
+
+def _euler_is_one(t: jnp.ndarray) -> jnp.ndarray:
+    """Legendre symbol check ``t^((p-1)/2) ≡ 1 (mod p)`` — the jacobi(y)
+    acceptance test of BCH Schnorr."""
+    return F.eq(_pow_const(t, _EULER_DIGITS), jnp.broadcast_to(F.ONE, t.shape))
 
 
 def verify_core(
@@ -564,15 +581,17 @@ def verify_core(
     r2_valid: jnp.ndarray,  # (B,) bool
     host_valid: jnp.ndarray,  # (B,) bool
     schnorr: jnp.ndarray,  # (B,) bool: lane verifies BCH Schnorr
+    bip340: jnp.ndarray,  # (B,) bool: lane verifies BIP340 (taproot)
 ) -> jnp.ndarray:
     """The device program (un-jitted: reused by the shard_map multi-chip
     wrapper in multichip.py): returns a (B,) bool validity vector.
 
-    One program, two signature algorithms (same dual-scalar MSM): per-lane
-    ``schnorr`` selects the acceptance test — ECDSA checks
-    ``x(R) ∈ {r, r+n} (mod p)``; Schnorr checks ``x(R) = r`` AND
-    ``jacobi(y(R)) = 1`` (host prep already folded ``u1 = s``,
-    ``u2 = n - e`` into the digit arrays).
+    One program, three signature algorithms (same dual-scalar MSM):
+    per-lane flags select the acceptance test — ECDSA checks
+    ``x(R) ∈ {r, r+n} (mod p)``; BCH Schnorr checks ``x(R) = r`` AND
+    ``jacobi(y(R)) = 1``; BIP340 checks ``x(R) = r`` AND ``y(R)`` even
+    (host prep already folded ``u1 = s``, ``u2 = n - e`` into the digit
+    arrays for both Schnorr variants).
     """
     q_table = _build_q_table(qx, qy)  # (16, 3, L, B)
     lq_table = _lambda_table(q_table)
@@ -594,12 +613,18 @@ def verify_core(
     not_inf = ~F.is_zero(Z)
     m1 = F.eq(X, F.mul(r1, Z))
     m2 = F.eq(X, F.mul(r2, Z)) & r2_valid
-    # jacobi(y(R)) for the Schnorr lanes: y = Y/Z, and jacobi(Y/Z) =
+    # jacobi(y(R)) for the BCH Schnorr lanes: y = Y/Z, and jacobi(Y/Z) =
     # jacobi(Y·Z) since the symbol is multiplicative and squares vanish
     jac_ok = _euler_is_one(F.mul(Y, Z))
+    # y(R) parity for the BIP340 lanes: affine y via a Fermat inverse
+    # (z^(p-2)), then the canonical representative's low bit
+    y_aff = F.mul(Y, _pow_const(Z, _PM2_DIGITS))
+    even_ok = (F.canonical(y_aff)[0] & 1) == 0
     # pubkey must satisfy the curve equation: qy^2 = qx^3 + 7
     on_curve = F.eq(F.sqr(qy), F.mul(F.sqr(qx), qx) + _SEVEN)
-    algo_ok = jnp.where(schnorr, m1 & jac_ok, m1 | m2)
+    algo_ok = jnp.where(
+        bip340, m1 & even_ok, jnp.where(schnorr, m1 & jac_ok, m1 | m2)
+    )
     return host_valid & on_curve & not_inf & algo_ok
 
 

@@ -37,7 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from . import field as F
 from . import pallas_field as PF
 from .curve import pt_add, pt_double
-from .kernel import _EULER_DIGITS, BETA, G_TABLE, LG_TABLE, WINDOWS
+from .kernel import _EULER_DIGITS, _PM2_DIGITS, BETA, G_TABLE, LG_TABLE, WINDOWS
 
 __all__ = ["verify_blocked", "verify_blocked_impl", "BLOCK"]
 
@@ -92,8 +92,8 @@ def _kernel(
     qy_ref,
     r1_ref,
     r2_ref,
-    flags_ref,  # (3, B) int32: [r2_valid, host_valid, schnorr]
-    euler_ref,  # (1, 64) int32: Euler exponent 4-bit digits, MSB first
+    flags_ref,  # (4, B) int32: [r2_valid, host_valid, schnorr, bip340]
+    euler_ref,  # (2, 64) int32: (p-1)/2 and p-2 exponent digits, MSB first
     out_ref,  # (1, B) int32
     qtab_ref,  # scratch (16, 3, L, B)
     lqtab_ref,  # scratch (16, 3, L, B)
@@ -187,20 +187,40 @@ def _kernel(
 
     lax.fori_loop(2, 16, pow_build, 0)
 
-    def pow_window(w, pacc):
-        pacc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(pacc))))
-        d = euler_ref[0, w]
-        sel = None
-        for tv in range(16):
-            contrib = jnp.where(d == tv, powtab_ref[tv], 0)
-            sel = contrib if sel is None else sel + contrib
-        return PF.mul(pacc, sel)
+    def pow_window_for(row):
+        def pow_window(w, pacc):
+            pacc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(pacc))))
+            d = euler_ref[row, w]
+            sel = None
+            for tv in range(16):
+                contrib = jnp.where(d == tv, powtab_ref[tv], 0)
+                sel = contrib if sel is None else sel + contrib
+            return PF.mul(pacc, sel)
 
-    pacc = lax.fori_loop(0, 64, pow_window, one)
+        return pow_window
+
+    pacc = lax.fori_loop(0, 64, pow_window_for(0), one)
     jac_ok = PF.eq(pacc, one)
 
+    # BIP340 evenness: affine y = Y/Z via Fermat inverse Z^(p-2), then the
+    # canonical representative's low bit — reuse the power table with t=Z
+    powtab_ref[1] = Z
+    def pow_build_z(k, carry):
+        powtab_ref[pl.ds(k, 1)] = PF.mul(powtab_ref[pl.ds(k - 1, 1)][0], Z)[
+            None
+        ]
+        return carry
+
+    lax.fori_loop(2, 16, pow_build_z, 0)
+    zinv = lax.fori_loop(0, 64, pow_window_for(1), one)
+    y_aff = PF.mul(Y, zinv)
+    even_ok = (PF.canonical(y_aff)[0:1] & 1) == 0
+
     is_sch = flags_ref[2:3] != 0
-    algo_ok = jnp.where(is_sch, m1 & jac_ok, m1 | m2)
+    is_b340 = flags_ref[3:4] != 0
+    algo_ok = jnp.where(
+        is_b340, m1 & even_ok, jnp.where(is_sch, m1 & jac_ok, m1 | m2)
+    )
     valid = (flags_ref[1:2] != 0) & on_curve & not_inf & algo_ok
     out_ref[:] = valid.astype(jnp.int32)
 
@@ -221,6 +241,7 @@ def verify_blocked_impl(
     r2_valid,
     host_valid,
     schnorr,
+    bip340,
     *,
     interpret: bool = False,
     block: int = BLOCK,
@@ -241,6 +262,7 @@ def verify_blocked_impl(
             r2_valid.astype(jnp.int32),
             host_valid.astype(jnp.int32),
             schnorr.astype(jnp.int32),
+            bip340.astype(jnp.int32),
         ],
         axis=0,
     )
@@ -267,8 +289,8 @@ def verify_blocked_impl(
             col(F.NLIMBS),
             col(F.NLIMBS),
             col(F.NLIMBS),
-            col(3),
-            pl.BlockSpec((1, 64), lambda i: (0, 0)),
+            col(4),
+            pl.BlockSpec((2, 64), lambda i: (0, 0)),
         ],
         out_specs=col(1),
         scratch_shapes=[
@@ -290,7 +312,9 @@ def verify_blocked_impl(
         r1,
         r2,
         flags,
-        jnp.asarray(_EULER_DIGITS).reshape(1, 64),
+        jnp.stack(
+            [jnp.asarray(_EULER_DIGITS), jnp.asarray(_PM2_DIGITS)], axis=0
+        ),
     )
     return out[0].astype(jnp.bool_)
 

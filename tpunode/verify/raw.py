@@ -12,6 +12,9 @@ big-endian values plus a per-item ``present`` flag carrying the algorithm:
 * ``present == 2``: BCH Schnorr — ``z`` is the PRECOMPUTED challenge ``e``
   (extraction hashes it once; no backend re-hashes), ``r`` the Fp
   x-coordinate, ``s`` the scalar.
+* ``present == 3``: BIP340 (taproot) Schnorr — same row layout as BCH
+  Schnorr with the tagged challenge in ``z``; the pubkey columns hold the
+  lift_x'd even-y point.
 
 Tuple items (the engine's ``VerifyItem``) pack into it with the same
 degenerate-item rules the CPU backend always applied (None/infinity pubkey,
@@ -40,7 +43,7 @@ class RawBatch:
     z: np.ndarray
     r: np.ndarray
     s: np.ndarray
-    present: np.ndarray  # (N,) uint8; 0 = absent, 1 = ecdsa, 2 = schnorr
+    present: np.ndarray  # (N,) uint8; 0 absent, 1 ecdsa, 2 bch-schnorr, 3 bip340
 
     def __len__(self) -> int:
         return len(self.present)
@@ -74,7 +77,11 @@ class RawBatch:
                 int.from_bytes(self.r[i].tobytes(), "big"),
                 int.from_bytes(self.s[i].tobytes(), "big"),
             )
-            out.append(tup + ("schnorr",) if self.present[i] == 2 else tup)
+            if self.present[i] == 2:
+                tup = tup + ("schnorr",)
+            elif self.present[i] == 3:
+                tup = tup + ("bip340",)
+            out.append(tup)
         return out
 
 
@@ -91,14 +98,14 @@ def pack_items(items: Sequence[tuple]) -> RawBatch:
     present = np.zeros(n, np.uint8)
     for i, item in enumerate(items):
         q, zi, ri, si = item[:4]
-        schnorr = len(item) >= 5 and item[4] == "schnorr"
+        tag = item[4] if len(item) >= 5 else None
         if q is None or q.infinity:
             continue
-        if schnorr:
+        if tag in ("schnorr", "bip340"):
             # spec ranges: r an Fp element, s a scalar; zero allowed
             if not (0 <= ri < CURVE_P and 0 <= si < CURVE_N):
                 continue
-            present[i] = 2
+            present[i] = 2 if tag == "schnorr" else 3
         else:
             if not (0 < ri < CURVE_N and 0 < si < CURVE_N):
                 continue
