@@ -420,16 +420,18 @@ bool fe_euler_is_one(const Fe &a) {
   return fe_eq(acc, one);
 }
 
-// BCH Schnorr verification (2019-05 upgrade spec): with the PRECOMPUTED
-// challenge e (= SHA256(r || P_comp || m) mod n, hashed by the extractor),
-// compute R = s*G + (n - e)*P and accept iff R is finite, x(R) == r over
-// Fp, and jacobi(y(R)) == 1.  Same window MSM as verify_one.
-bool verify_one_schnorr(const uint8_t *px, const uint8_t *py,
-                        const uint8_t *e32, const uint8_t *r32,
-                        const uint8_t *s32) {
+// Shared core of both Schnorr-family verifiers (BCH 2019 and BIP340):
+// identical range rules (r < p, s < n, zero allowed), curve membership,
+// u1 = s / u2 = n - e, and the window MSM — only the final acceptance
+// test differs (jacobi(y) = 1 vs y even), exactly as the TPU kernel
+// splits it with per-lane flags.  Returns false on any pre-acceptance
+// failure; on success fills r_out and the Jacobian accumulator.
+bool schnorr_msm(const uint8_t *px, const uint8_t *py, const uint8_t *e32,
+                 const uint8_t *r32, const uint8_t *s32, Fe &r_out,
+                 Pt &acc_out) {
   Fe qx = fe_from_be(px), qy = fe_from_be(py);
-  Fe r = fe_from_be(r32);
-  if (ge(r, FP.m)) return false;  // r is an Fp x-coordinate
+  r_out = fe_from_be(r32);
+  if (ge(r_out, FP.m)) return false;  // r is an Fp x-coordinate
   Fe s = fe_from_be(s32);
   if (ge(s, FN.m)) return false;  // s a scalar (zero allowed by spec)
   if (ge(qx, FP.m) || ge(qy, FP.m)) return false;
@@ -469,64 +471,64 @@ bool verify_one_schnorr(const uint8_t *px, const uint8_t *py,
   if (pt_inf(acc)) return false;
   // x(R) == r over Fp (Jacobian: X == r * Z^2)
   Fe zz = FP.sqr(acc.z);
-  if (!fe_eq(FP.mul(r, zz), acc.x)) return false;
+  if (!fe_eq(FP.mul(r_out, zz), acc.x)) return false;
+  acc_out = acc;
+  return true;
+}
+
+// BCH Schnorr (2019-05 upgrade spec), challenge e precomputed by the
+// extractor: accept iff the common checks pass and jacobi(y(R)) == 1.
+bool verify_one_schnorr(const uint8_t *px, const uint8_t *py,
+                        const uint8_t *e32, const uint8_t *r32,
+                        const uint8_t *s32) {
+  Fe r;
+  Pt acc;
+  if (!schnorr_msm(px, py, e32, r32, s32, r, acc)) return false;
   // jacobi(y(R)) with y = Y/Z^3: jacobi(Y/Z^3) = jacobi(Y)*jacobi(Z) =
   // jacobi(Y*Z) (the symbol is multiplicative; squares vanish)
   return fe_euler_is_one(FP.mul(acc.y, acc.z));
 }
 
-// BIP340 (taproot) verification from a precomputed tagged challenge: the
-// same MSM, acceptance x(R) == r over Fp AND y(R) EVEN (not jacobi).
-// The pubkey columns carry the lift_x'd even-y point.
+// BIP340 (taproot): accept iff the common checks pass and y(R) is EVEN
+// (the pubkey columns carry the lift_x'd even-y point).
 bool verify_one_bip340(const uint8_t *px, const uint8_t *py,
                        const uint8_t *e32, const uint8_t *r32,
                        const uint8_t *s32) {
-  Fe qx = fe_from_be(px), qy = fe_from_be(py);
-  Fe r = fe_from_be(r32);
-  if (ge(r, FP.m)) return false;
-  Fe s = fe_from_be(s32);
-  if (ge(s, FN.m)) return false;
-  if (ge(qx, FP.m) || ge(qy, FP.m)) return false;
-  Fe lhs = FP.sqr(qy);
-  Fe rhs = FP.add(FP.mul(FP.sqr(qx), qx), Fe{{7, 0, 0, 0}});
-  if (!fe_eq(lhs, rhs)) return false;
-
-  Fe e = fe_from_be(e32);
-  while (ge(e, FN.m)) sub_mod_raw(e, FN.m);
-  Fe u2{{0, 0, 0, 0}};
-  if (!is_zero(e)) {
-    u2 = Fe{{FN.m[0], FN.m[1], FN.m[2], FN.m[3]}};
-    sub_mod_raw(u2, e.v);
-  }
-  const Fe &u1 = s;
-
-  Pt tq[16];
-  tq[0] = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
-  tq[1] = Pt{qx, qy, {{1, 0, 0, 0}}};
-  for (int i = 2; i < 16; ++i) tq[i] = pt_add(tq[i - 1], tq[1]);
-
-  Pt acc = Pt{{{0}}, {{1, 0, 0, 0}}, {{0}}};
-  for (int w4 = 63; w4 >= 0; --w4) {
-    if (!pt_inf(acc)) {
-      acc = pt_double(acc);
-      acc = pt_double(acc);
-      acc = pt_double(acc);
-      acc = pt_double(acc);
-    }
-    int limb = w4 / 16, shift = (w4 % 16) * 4;
-    int d1 = (int)((u1.v[limb] >> shift) & 0xF);
-    int d2 = (int)((u2.v[limb] >> shift) & 0xF);
-    if (d1) acc = pt_add(acc, TAB.g[d1]);
-    if (d2) acc = pt_add(acc, tq[d2]);
-  }
-  if (pt_inf(acc)) return false;
-  Fe zz = FP.sqr(acc.z);
-  if (!fe_eq(FP.mul(r, zz), acc.x)) return false;
+  Fe r;
+  Pt acc;
+  if (!schnorr_msm(px, py, e32, r32, s32, r, acc)) return false;
   // evenness needs the affine y = Y / Z^3
   Fe zi = FP.inv(acc.z);
   Fe zi2 = FP.sqr(zi);
   Fe y_aff = FP.mul(acc.y, FP.mul(zi2, zi));
   return (y_aff.v[0] & 1) == 0;
+}
+
+// Shared prologue of the batch verifiers: validity of each ECDSA row's s
+// (Schnorr-family rows never join the inversion) and the Montgomery batch
+// inversion producing w[i] = s_i^-1.  ONE definition so the serial and
+// threaded entries can never diverge on the s-validity rule.
+void batch_inversion_prologue(const uint8_t *s, const uint8_t *present,
+                              int count, bool *s_ok, Fe *w) {
+  Fe *sv = new Fe[count];
+  Fe *prefix = new Fe[count];
+  Fe run{{1, 0, 0, 0}};
+  for (int i = 0; i < count; ++i) {
+    bool schnorr = present != nullptr && present[i] >= 2;
+    Fe si = fe_from_be(s + 32 * i);
+    s_ok[i] = !schnorr && !(is_zero(si) || ge(si, FN.m));
+    sv[i] = s_ok[i] ? si : Fe{{1, 0, 0, 0}};
+    run = FN.mul(run, sv[i]);
+    prefix[i] = run;
+  }
+  Fe inv_all = FN.inv(run);
+  for (int i = count - 1; i >= 0; --i) {
+    Fe before = (i == 0) ? Fe{{1, 0, 0, 0}} : prefix[i - 1];
+    w[i] = FN.mul(inv_all, before);
+    inv_all = FN.mul(inv_all, sv[i]);
+  }
+  delete[] sv;
+  delete[] prefix;
 }
 
 // Verify rows [lo, hi) (shared by the serial entry and the threaded one);
@@ -614,31 +616,11 @@ void secp_dbg_mulg(const uint8_t *k32, uint8_t *x_out, uint8_t *y_out) {
 int secp_verify_batch(const uint8_t *px, const uint8_t *py, const uint8_t *z,
                       const uint8_t *r, const uint8_t *s,
                       const uint8_t *present, int count, uint8_t *out) {
-  // Montgomery batch inversion of the ECDSA rows' s scalars: one field
-  // inversion for the whole batch plus 3 multiplications per element.
-  Fe *sv = new Fe[count];
-  Fe *prefix = new Fe[count];
   bool *s_ok = new bool[count];
-  Fe run{{1, 0, 0, 0}};
-  for (int i = 0; i < count; ++i) {
-    bool schnorr = present != nullptr && present[i] >= 2;
-    Fe si = fe_from_be(s + 32 * i);
-    s_ok[i] = !schnorr && !(is_zero(si) || ge(si, FN.m));
-    sv[i] = s_ok[i] ? si : Fe{{1, 0, 0, 0}};
-    run = FN.mul(run, sv[i]);
-    prefix[i] = run;
-  }
-  Fe inv_all = FN.inv(run);
   Fe *w = new Fe[count];
-  for (int i = count - 1; i >= 0; --i) {
-    Fe before = (i == 0) ? Fe{{1, 0, 0, 0}} : prefix[i - 1];
-    w[i] = FN.mul(inv_all, before);
-    inv_all = FN.mul(inv_all, sv[i]);
-  }
+  batch_inversion_prologue(s, present, count, s_ok, w);
   int valid = secp_verify_rows(px, py, z, r, s, present, s_ok, w, 0, count,
                                out);
-  delete[] sv;
-  delete[] prefix;
   delete[] s_ok;
   delete[] w;
   return valid;
@@ -813,25 +795,9 @@ int secp_verify_batch_mt(const uint8_t *px, const uint8_t *py,
   if (T == 1 || count < 64)
     return secp_verify_batch(px, py, z, r, s, present, count, out);
 
-  std::vector<Fe> sv(count), prefix(count), w(count);
-  std::vector<char> s_okv(count);
-  Fe run{{1, 0, 0, 0}};
-  for (int i = 0; i < count; ++i) {
-    bool schnorr = present != nullptr && present[i] >= 2;
-    Fe si = fe_from_be(s + 32 * i);
-    s_okv[i] = !schnorr && !(is_zero(si) || ge(si, FN.m));
-    sv[i] = s_okv[i] ? si : Fe{{1, 0, 0, 0}};
-    run = FN.mul(run, sv[i]);
-    prefix[i] = run;
-  }
-  Fe inv_all = FN.inv(run);
-  for (int i = count - 1; i >= 0; --i) {
-    Fe before = (i == 0) ? Fe{{1, 0, 0, 0}} : prefix[i - 1];
-    w[i] = FN.mul(inv_all, before);
-    inv_all = FN.mul(inv_all, sv[i]);
-  }
+  std::vector<Fe> w(count);
   std::unique_ptr<bool[]> s_ok(new bool[count]);
-  for (int i = 0; i < count; ++i) s_ok[i] = s_okv[i] != 0;
+  batch_inversion_prologue(s, present, count, s_ok.get(), w.data());
 
   std::atomic<int> valid{0};
   std::vector<std::thread> ts;

@@ -30,9 +30,12 @@ def ensure_native_lib(lib_path: str, src_subdir: str) -> str:
 
     The mtime check protects against a stale .so with an old C ABI after a
     source change, without making every process invoke (or even require) a
-    build toolchain: a host with a prebuilt, up-to-date .so and no
-    make/g++ loads fine, and a failed rebuild falls back to an existing
-    .so only when it is NOT stale (a stale one would corrupt calls)."""
+    build toolchain.  If the rebuild FAILS but a prebuilt .so exists, load
+    it anyway with a warning: on a toolchain-less host a fresh checkout
+    makes every source look newer than a perfectly current prebuilt
+    library (git sets mtimes to checkout time), and crashing there would
+    regress a working deployment.  The warning gives the operator the
+    signal if the library genuinely is stale."""
     native_dir = os.path.join(_REPO_ROOT, "native")
     srcs = [os.path.join(native_dir, "Makefile")]
     src_dir = os.path.join(native_dir, src_subdir)
@@ -48,12 +51,23 @@ def ensure_native_lib(lib_path: str, src_subdir: str) -> str:
         if os.path.exists(s)
     )
     if stale:
-        subprocess.run(
-            ["make", "-C", native_dir,
-             os.path.join("build", os.path.basename(lib_path))],
-            check=True,
-            capture_output=True,
-        )
+        try:
+            subprocess.run(
+                ["make", "-C", native_dir,
+                 os.path.join("build", os.path.basename(lib_path))],
+                check=True,
+                capture_output=True,
+            )
+        except Exception:
+            if not os.path.exists(lib_path):
+                raise
+            import logging
+
+            logging.getLogger("tpunode.native").warning(
+                "rebuild of %s failed but a prebuilt library exists; "
+                "loading it (sources look newer — verify it is not stale)",
+                os.path.basename(lib_path),
+            )
     return lib_path
 
 _REC = struct.Struct("<BII")

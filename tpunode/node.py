@@ -320,7 +320,14 @@ class Node:
         elif self._shed_flush is None or self._shed_flush.done():
 
             async def flush_later():
-                await asyncio.sleep(0.5)
+                # sleep until the window actually reopens (a direct flush
+                # may move _shed_last_pub while we wait) so the ~2/sec cap
+                # holds even when direct and delayed flushes interleave
+                while True:
+                    remain = self._shed_last_pub + 0.5 - _time.monotonic()
+                    if remain <= 0:
+                        break
+                    await asyncio.sleep(remain)
                 if self._shed_count:
                     self._flush_shed(peer)
 

@@ -97,19 +97,28 @@ def point_double(p: Point) -> Point:
 # The oracle favors clarity, but G-multiplies dominate test signing and
 # benchmark workload generation (hours of wall over a round); the windowed
 # path is ~6x faster and bit-identical (cross-checked against the generic
-# ladder in tests and against OpenSSL).
+# ladder in tests and against OpenSSL).  Built under a lock and published
+# atomically: engine warmup (a daemon thread) and oracle batches (worker
+# threads) can race to first use.
 _G_TABLE: list[list[Point]] = []
+_G_TABLE_LOCK = __import__("threading").Lock()
 
 
 def _g_table() -> list[list[Point]]:
-    if not _G_TABLE:
+    if _G_TABLE:
+        return _G_TABLE
+    with _G_TABLE_LOCK:
+        if _G_TABLE:
+            return _G_TABLE
+        rows: list[list[Point]] = []
         base = GENERATOR
         for _ in range(64):
             row = [INFINITY]
             for _d in range(15):
                 row.append(point_add(row[-1], base))
-            _G_TABLE.append(row)
+            rows.append(row)
             base = point_double(point_double(point_double(point_double(base))))
+        _G_TABLE.extend(rows)  # publish fully built
     return _G_TABLE
 
 
