@@ -129,8 +129,8 @@ def _mutations(rng: random.Random, base: bytes):
         b[rng.randrange(n)] = rng.randrange(256)
         yield bytes(b)
     yield base[: rng.randrange(1, n)]  # truncation
-    cut = rng.randrange(1, n)
-    yield base[:cut] + base[cut + rng.randrange(1, min(8, n - cut)) :]  # splice
+    cut = rng.randrange(1, n - 1)  # splice: drop 1..7 bytes mid-buffer
+    yield base[:cut] + base[cut + rng.randrange(1, min(8, n - cut) + 1) :]
     b = bytearray(base)  # varint-area targeted flips (first bytes of the tx)
     b[rng.randrange(min(8, n))] = rng.choice([0x00, 0xFD, 0xFE, 0xFF])
     yield bytes(b)
