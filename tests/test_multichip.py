@@ -90,3 +90,45 @@ def test_pallas_kernel_inside_shard_map_interpret():
     ok3, total3 = fn(*args3)
     assert [bool(b) for b in np.asarray(ok3)[:3]] == expect3
     assert int(total3) == sum(expect3)  # padded lanes reject for free
+
+
+def test_sharded_mixed_algorithms():
+    """All three signature algorithms through shard_map on the CPU mesh:
+    the per-lane schnorr/bip340 flags must shard with the batch like every
+    other 1-D lane array (ARG_IS_2D derives them from _DEVICE_FIELDS)."""
+    from tpunode.verify.ecdsa_cpu import (
+        bip340_challenge,
+        lift_x,
+        schnorr_challenge,
+        sign_bip340,
+        sign_schnorr,
+        verify_batch_cpu,
+    )
+
+    items = []
+    for i in range(16):
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        m = rng.getrandbits(256)
+        if i % 3 == 0:
+            r, s = sign(priv, m, rng.getrandbits(256) % CURVE_N or 1)
+            if i % 6 == 3:
+                s = (s + 1) % CURVE_N or 1
+            items.append((pub, m, r, s))
+        elif i % 3 == 1:
+            r, s = sign_schnorr(priv, m, rng.getrandbits(256))
+            e = schnorr_challenge(r, pub, m)
+            if i % 6 == 4:
+                e = (e + 1) % CURVE_N
+            items.append((pub, e, r, s, "schnorr"))
+        else:
+            r, s = sign_bip340(priv, m, rng.getrandbits(256))
+            e = bip340_challenge(r, pub.x, m)
+            if i % 6 == 5:
+                e = (e + 1) % CURVE_N
+            items.append((lift_x(pub.x), e, r, s, "bip340"))
+    expect = verify_batch_cpu(items)
+    mesh = make_mesh(4)
+    got = verify_batch_sharded(items, mesh=mesh)
+    assert got == expect
+    assert True in expect and False in expect
