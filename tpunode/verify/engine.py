@@ -1,7 +1,8 @@
 """Async batch verification engine: the queue between ingest and the TPU.
 
 The north-star integration point (BASELINE.json): block/mempool ingest
-submits (pubkey, z, r, s) items; the engine accumulates them into
+submits VerifyItem tuples (ECDSA / BCH Schnorr / BIP340 — see
+tpunode/verify/raw.py); the engine accumulates them into
 fixed-shape batches (static shapes = no XLA recompilation), dispatches to
 the TPU kernel — or the C++ CPU engine for small batches / no device — and
 resolves per-item futures.  Double-buffered by construction: device dispatch
@@ -40,7 +41,9 @@ from .raw import as_raw_batch, concat_raw
 
 __all__ = ["VerifyConfig", "VerifyEngine", "VerifyItem", "enable_compile_cache"]
 
-VerifyItem = tuple[Optional[Point], int, int, int]  # (pubkey, z, r, s)
+# (pubkey, z, r, s) for ECDSA; 5-tuples append "schnorr" (BCH) or
+# "bip340" (taproot) with the precomputed challenge in the z position.
+VerifyItem = tuple  # see raw.pack_items for the per-algorithm rules
 
 log = logging.getLogger("tpunode.verify")
 
