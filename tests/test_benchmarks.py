@@ -80,3 +80,20 @@ def test_txgen_chain_is_consensus_valid():
             items.extend((i.pubkey, i.z, i.r, i.s) for i in its)
     assert len(items) == 5 * 2 * 2
     assert verify_batch_cpu(items) == [True] * len(items)
+
+
+def test_churn_soak_short():
+    """30s of the churn soak (benchmarks/soak.py): remote deaths every
+    ~10s, continuous verdict flow, flat task count / RSS at exit."""
+    env = dict(os.environ)
+    env.update(SOAK_SECONDS="30", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "benchmarks.soak"],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    assert "PASS" in out.stdout

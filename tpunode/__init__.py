@@ -35,7 +35,7 @@ from .headers import (
     next_work_required,
     split_point,
 )
-from .node import Node, NodeConfig, TxVerdict, tcp_connect
+from .node import Node, NodeConfig, TxVerdict, VerifyShed, tcp_connect
 from .params import (
     BCH,
     BCH_REGTEST,
@@ -68,11 +68,20 @@ from .peermgr import (
     to_sock_addr,
 )
 from .store import LogKV, MemoryKV, Namespaced, open_store
+from .txverify import (
+    ExtractStats,
+    SigItem,
+    combine_verdicts,
+    extract_sig_items,
+    msig_match,
+)
 from .wire import (
     Block,
     BlockHeader,
     InvType,
     InvVector,
+    LazyBlock,
+    LazyTx,
     NetworkAddress,
     Tx,
     build_merkle_root,
