@@ -304,7 +304,12 @@ def main() -> None:
 
     probe = _run_worker("--probe", T_PROBE)
     if probe.get("ok") and probe.get("platform") == "tpu":
-        ladder = LADDER
+        # Every worker re-pays backend init; a slow-but-alive tunnel must not
+        # eat the compile budget, so stretch each rung by the measured
+        # init time (capped — a 2-minute init still leaves the ladder
+        # inside the driver's overall tolerance).
+        extra = min(180.0, float(probe.get("init_s", 0.0)) * 1.5)
+        ladder = tuple((b, t + extra) for b, t in LADDER)
     else:
         # Dead/slow tunnel: one last-chance small-batch attempt (the probe
         # itself may have nudged the relay awake), then the cpu fallback.
