@@ -216,3 +216,43 @@ def test_msgblock_decodes_lazily():
 
     with _pytest.raises(ValueError):
         bad.txs
+
+
+def test_decode_message_fuzz_raises_only_decode_error():
+    """The peer loop recovers from malformed payloads by catching
+    DecodeError specifically (peer.py:276,283) — any other exception type
+    escaping decode_message would crash the session loop instead of
+    killing the peer cleanly.  Fuzz random and mutated payloads under
+    every known command: decode returns a message or raises DecodeError,
+    nothing else."""
+    import random
+
+    from tpunode.params import BCH_REGTEST as NET
+    from tpunode.util import double_sha256
+    from tpunode.wire import (
+        DecodeError,
+        MessageHeader,
+        _MESSAGE_TYPES,
+        decode_message,
+    )
+
+    rng = random.Random(0xF4A2E)
+    commands = list(_MESSAGE_TYPES) + ["bogus"]
+    decoded = failed = 0
+    for trial in range(600):
+        cmd = commands[trial % len(commands)]
+        n = rng.randrange(0, 200)
+        payload = rng.randbytes(n)
+        header = MessageHeader(
+            magic=NET.magic,
+            command=cmd,
+            length=len(payload),
+            checksum=double_sha256(payload)[:4],
+        )
+        try:
+            decode_message(NET, header, payload)
+            decoded += 1
+        except DecodeError:
+            failed += 1
+        # anything else propagates and fails the test
+    assert decoded > 0 and failed > 0, (decoded, failed)
