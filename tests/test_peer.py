@@ -121,3 +121,122 @@ async def test_busy_lock_cas():
     assert not peer.set_busy()  # second take fails
     peer.set_free()
     assert peer.set_busy()
+
+
+@pytest.mark.asyncio
+async def test_framing_reassembles_one_byte_chunks():
+    """The frame reader must reassemble messages from arbitrarily split
+    chunks (TCP gives no boundary guarantees): a full handshake delivered
+    one byte at a time still brings the peer online."""
+    import contextlib
+    import time as _time
+
+    from tests.fakenet import QueueConnection, mock_peer_react
+    from tests.fixtures import all_blocks
+    from tpunode import Node, NodeConfig, PeerConnected, Publisher
+    from tpunode.params import BCH_REGTEST as NET, NODE_NETWORK
+    from tpunode.store import MemoryKV
+    from tpunode.util import Reader
+    from tpunode.wire import (
+        HEADER_SIZE,
+        MsgVersion,
+        NetworkAddress,
+        decode_message,
+        decode_message_header,
+        encode_message,
+    )
+
+    async def remote(to_node, from_node):
+        ver = MsgVersion(
+            version=70012, services=NODE_NETWORK, timestamp=int(_time.time()),
+            addr_recv=NetworkAddress.from_host_port("::1", 0),
+            addr_from=NetworkAddress.from_host_port(
+                "::1", 0, services=NODE_NETWORK),
+            nonce=7, user_agent=b"/split/", start_height=0, relay=True,
+        )
+        for b in encode_message(NET, ver):  # ONE BYTE per chunk
+            to_node.put_nowait(bytes([b]))
+        buf = bytearray()
+        while True:
+            chunk = await from_node.get()
+            buf.extend(chunk)
+            while len(buf) >= HEADER_SIZE:
+                hdr = decode_message_header(NET, bytes(buf[:HEADER_SIZE]))
+                if len(buf) < HEADER_SIZE + hdr.length:
+                    break
+                payload = bytes(buf[HEADER_SIZE:HEADER_SIZE + hdr.length])
+                del buf[:HEADER_SIZE + hdr.length]
+                msg = decode_message(NET, hdr, payload)
+                for reply in mock_peer_react(NET, all_blocks(), msg):
+                    for b in encode_message(NET, reply):
+                        to_node.put_nowait(bytes([b]))
+
+    def connect(sa):
+        @contextlib.asynccontextmanager
+        async def factory():
+            to_node: asyncio.Queue = asyncio.Queue()
+            from_node: asyncio.Queue = asyncio.Queue()
+            task = asyncio.ensure_future(remote(to_node, from_node))
+            try:
+                yield QueueConnection(to_node, from_node)
+            finally:
+                task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await task
+
+        return factory
+
+    pub = Publisher(name="split")
+    cfg = NodeConfig(net=NET, store=MemoryKV(), pub=pub,
+                     peers=["[::1]:1"], connect=connect)
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(15):
+                p = await events.receive_match(
+                    lambda ev: ev.peer if isinstance(ev, PeerConnected) else None
+                )
+            assert node.peer_mgr.get_online_peer(p).online
+
+
+@pytest.mark.asyncio
+async def test_oversize_frame_kills_peer_cleanly():
+    """A frame claiming > MAX_PAYLOAD must kill the session before the
+    handshake completes (reference Peer.hs:266).  A never-online peer
+    publishes no PeerDisconnected (reference online-only rule,
+    PeerMgr.hs:447-487) — so the observable contract is: the peer never
+    comes online and the node stays healthy."""
+    import contextlib
+
+    from tests.fakenet import QueueConnection
+    from tpunode import Node, NodeConfig, PeerConnected, Publisher
+    from tpunode.params import BCH_REGTEST as NET
+    from tpunode.store import MemoryKV
+    from tpunode.util import double_sha256
+    from tpunode.wire import MAX_PAYLOAD, MessageHeader
+
+    def connect(sa):
+        @contextlib.asynccontextmanager
+        async def factory():
+            to_node: asyncio.Queue = asyncio.Queue()
+            from_node: asyncio.Queue = asyncio.Queue()
+            hdr = MessageHeader(
+                magic=NET.magic, command="tx",
+                length=MAX_PAYLOAD + 1, checksum=double_sha256(b"")[:4],
+            )
+            to_node.put_nowait(hdr.serialize())
+            yield QueueConnection(to_node, from_node)
+
+        return factory
+
+    pub = Publisher(name="oversize")
+    cfg = NodeConfig(net=NET, store=MemoryKV(), pub=pub,
+                     peers=["[::1]:1"], connect=connect)
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            with contextlib.suppress(TimeoutError):
+                async with asyncio.timeout(3):
+                    while True:
+                        ev = await events.receive()
+                        assert not isinstance(ev, PeerConnected), \
+                            "oversize-framing peer must never come online"
+            assert node.chain.get_best() is not None  # node healthy
