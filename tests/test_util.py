@@ -124,3 +124,29 @@ def test_tx_with_non_minimal_input_count_rejected_both_paths():
         assert extract_raw(raw, 1).n_txs == 1  # canonical form still parses
         with pytest.raises(ValueError):
             extract_raw(bad, 1)
+
+
+def test_ensure_native_lib_falls_back_to_prebuilt(monkeypatch, tmp_path):
+    """A failed rebuild must not crash a host that has a prebuilt .so
+    (fresh checkouts make sources look newer on toolchain-less machines;
+    review r4 finding 3) — and must still raise when no library exists."""
+    import subprocess
+
+    from tpunode.native import ensure_native_lib
+
+    lib = tmp_path / "libfake.so"
+    lib.write_bytes(b"\x7fELF fake")
+
+    def boom(*a, **k):
+        raise FileNotFoundError("make not found")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    # sources (the real tree) are newer than this brand-new-but-backdated lib
+    import os as _os
+
+    _os.utime(lib, (0, 0))
+    assert ensure_native_lib(str(lib), "kvstore") == str(lib)
+
+    missing = tmp_path / "libmissing.so"
+    with pytest.raises(FileNotFoundError):
+        ensure_native_lib(str(missing), "kvstore")
