@@ -412,8 +412,9 @@ def _is_block_type(t: int) -> bool:
 
 async def get_blocks(
     net: Network, seconds: float, p: Peer, block_hashes: list[bytes]
-) -> Optional[list[Block]]:
-    """Fetch full blocks by hash (reference Peer.hs:309-324)."""
+) -> Optional[list["Block | LazyBlock"]]:
+    """Fetch full blocks by hash (reference Peer.hs:309-324).  Wire-decoded
+    blocks arrive as wire.LazyBlock (tx region unparsed until .txs)."""
     t = InvType.WITNESS_BLOCK if net.segwit else InvType.BLOCK
     out = await get_data(seconds, p, [InvVector(t, h) for h in block_hashes])
     if out is None or not all(isinstance(x, (Block, LazyBlock)) for x in out):
@@ -423,8 +424,9 @@ async def get_blocks(
 
 async def get_txs(
     net: Network, seconds: float, p: Peer, tx_hashes: list[bytes]
-) -> Optional[list[Tx]]:
-    """Fetch transactions by txid (reference Peer.hs:329-344)."""
+) -> Optional[list["Tx | LazyTx"]]:
+    """Fetch transactions by txid (reference Peer.hs:329-344).  Wire-decoded
+    txs arrive as wire.LazyTx (the txid match already parsed them)."""
     t = InvType.WITNESS_TX if net.segwit else InvType.TX
     out = await get_data(seconds, p, [InvVector(t, h) for h in tx_hashes])
     if out is None or not all(isinstance(x, (Tx, LazyTx)) for x in out):
