@@ -256,3 +256,32 @@ def test_decode_message_fuzz_raises_only_decode_error():
             failed += 1
         # anything else propagates and fails the test
     assert decoded > 0 and failed > 0, (decoded, failed)
+
+
+def test_lazytx_delegation_and_serialize_forms():
+    """LazyTx: .raw round-trips bytes without parsing; attribute access
+    parses once; non-witness serialization (txid computation) delegates."""
+    from benchmarks.txgen import gen_mixed_txs
+    from tpunode.util import Reader
+    from tpunode.wire import LazyTx, MsgTx, Tx
+
+    tx = next(t for t in gen_mixed_txs(8, seed=0x17) if t.has_witness)
+    raw = tx.serialize()
+    msg = MsgTx.deserialize_payload(Reader(raw))
+    lazy = msg.tx
+    assert isinstance(lazy, LazyTx)
+    assert lazy._tx is None  # untouched
+    assert lazy.serialize() == raw  # witness form == raw, no parse
+    assert lazy._tx is None
+    assert lazy.txid == tx.txid  # delegation parses once
+    assert lazy._tx is not None
+    assert lazy.serialize(include_witness=False) == tx.serialize(
+        include_witness=False
+    )
+    assert lazy == tx and lazy == LazyTx(raw)
+    # malformed payload raises on first attribute access, not at decode
+    bad = LazyTx(raw[:-2])
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        bad.txid
