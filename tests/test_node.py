@@ -794,3 +794,31 @@ async def test_verify_shed_rate_limited_and_lossless_counts(monkeypatch):
     # aggregated: far fewer events than drops, bounded ~2/sec + 1 initial
     span = shed_events[-1][0] if shed_events else 0.0
     assert len(shed_events) <= 2 + span * 2.5, (len(shed_events), span)
+
+
+@pytest.mark.asyncio
+async def test_peer_sending_bad_headers_is_killed():
+    """Headers failing consensus (wrong difficulty bits) kill the sync
+    peer (reference Chain.hs:334-338 killPeer PeerSentBadHeaders) and the
+    chain stays at its prior best; the node remains healthy."""
+    import dataclasses
+
+    from tpunode import PeerDisconnected
+    from tpunode.wire import Block
+
+    good = all_blocks()
+    # corrupt block 1's difficulty bits: the retarget check must reject
+    bad_hdr = dataclasses.replace(good[0].header, bits=0x1D00FFFF)
+    bad_blocks = [Block(bad_hdr, good[0].txs)] + good[1:]
+
+    async with make_test_node(blocks=bad_blocks) as (node, events):
+        async with asyncio.timeout(15):
+            p = await wait_for_peer(events)
+            await events.receive_match(
+                lambda ev: ev
+                if isinstance(ev, PeerDisconnected) and ev.peer is p
+                else None
+            )
+        assert node.chain.get_best().height == 0  # nothing imported
+        # the connect loop will keep re-dialing; the node itself is healthy
+        assert node.chain.is_synced() is False
