@@ -24,8 +24,14 @@ each lost their headline number to a different flavor of that):
 * kernel choice comes from jax.devices()[0].platform (not
   jax.default_backend(), which this box's axon shim can leave at a
   stale value);
-* if no TPU attempt lands, a clearly-labeled cpu-jax fallback (small
-  batch, XLA) still produces a numeric value with the TPU error noted.
+* if no TPU attempt lands, the freshest in-round device measurement
+  persisted by the round-long watcher (benchmarks/watcher.py ->
+  benchmarks/device_runs.jsonl) is reported with explicit provenance
+  ("in-round-watcher" + timestamp) — one-shot sampling of a flaky
+  tunnel was the round-1..4 failure mode;
+* only if no in-round device sample exists either, a clearly-labeled
+  cpu-jax fallback (small batch, XLA) still produces a numeric value
+  with the TPU error noted.
 
 Whatever happens, the final line is valid single-line JSON with a
 numeric ``value``.  Worst-case wall clock ~12 min, within the driver
@@ -39,7 +45,6 @@ from __future__ import annotations
 import json
 import os
 import statistics
-import subprocess
 import sys
 import time
 
@@ -232,64 +237,69 @@ def _worker_bench() -> None:
 def _run_worker(
     mode: str, timeout: float, env_extra: dict | None = None
 ) -> dict:
-    """Run a worker subprocess; parse its last JSON line.
+    """Run a bench worker subprocess via the shared group-kill runner."""
+    from benchmarks.common import run_json_subprocess
 
-    The worker runs in its own process group and the whole group is killed
-    on timeout: the TPU shim may spawn helpers that inherit the stdout
-    pipe, and killing only the direct child would leave communicate()
-    blocked on them forever.
-    """
-    env = dict(os.environ)
-    env.update(env_extra or {})
-    proc = subprocess.Popen(
+    return run_json_subprocess(
         [sys.executable, os.path.abspath(__file__), mode],
+        timeout,
+        env_extra,
         cwd=os.path.dirname(os.path.abspath(__file__)),
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        text=True,
-        start_new_session=True,
     )
+
+
+DEVICE_RUNS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "benchmarks", "device_runs.jsonl"
+)
+# Only trust same-round watcher samples.  The watcher truncates the file
+# at startup (one rotation per round); the age cap is belt-and-braces for
+# a round whose watcher never launched over a committed previous-round
+# file (rounds are ~12 h apart, so a cross-round row is always older).
+DEVICE_RUN_MAX_AGE = 12 * 3600
+
+
+def _freshest_device_run(path: str = DEVICE_RUNS) -> dict | None:
+    """Freshest in-round TPU headline sample from the round-long watcher
+    (benchmarks/watcher.py, VERDICT r4 item 1).  The watcher appends one
+    JSON line per successful device measurement; this returns the newest
+    valid ``kind == "headline"`` row on a tpu device, or None.
+
+    A recorded ``kind == "fatal"`` row (device/oracle verdict mismatch)
+    poisons the whole file: correctness failures must never be masked by
+    an earlier-or-later passing sample, so the fallback is disabled for
+    the round.  Rows that are valid JSON but corrupt (partial writes,
+    missing/non-numeric fields) are skipped — main() must always emit its
+    one JSON line.
+    """
     try:
-        stdout, stderr = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        _kill_group(proc)
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    best: dict | None = None
+    now = time.time()
+    for line in lines:
         try:
-            _, stderr = proc.communicate(timeout=10)
-        except subprocess.TimeoutExpired:
-            stderr = ""
-        # the worker streams progress to stderr; surface its last line so a
-        # timeout says what the worker was doing when the axe fell
-        last = ""
-        for line in (stderr or "").splitlines():
-            if line.startswith("[bench-worker]"):
-                last = line
-        return {
-            "ok": False,
-            "error": f"timed out after {timeout:.0f}s"
-            + (f" (last: {last})" if last else ""),
-        }
-    for line in reversed(stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
-                continue
-    return {
-        "ok": False,
-        "error": f"worker rc={proc.returncode}, no JSON "
-        f"(stderr tail: {stderr[-300:]!r})",
-    }
-
-
-def _kill_group(proc: subprocess.Popen) -> None:
-    import signal
-
-    try:
-        os.killpg(proc.pid, signal.SIGKILL)
-    except (ProcessLookupError, PermissionError):
-        proc.kill()
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(row, dict):
+            continue
+        if row.get("kind") == "fatal":
+            return None
+        if row.get("kind") != "headline":
+            continue
+        if not str(row.get("device", "")).startswith("tpu"):
+            continue
+        if not isinstance(row.get("value"), (int, float)) or not isinstance(
+            row.get("unix"), (int, float)
+        ) or not isinstance(row.get("ts"), str):
+            continue
+        if now - row["unix"] > DEVICE_RUN_MAX_AGE:
+            continue
+        if best is None or row["unix"] > best["unix"]:
+            best = row
+    return best
 
 
 def main() -> None:
@@ -334,23 +344,48 @@ def main() -> None:
             break
 
     tpu_err = None
+    provenance = "live"
+    watcher_run = None
     if not res.get("ok") and not res.get("fatal"):
-        # Clearly-labeled cpu-jax fallback so the driver still records a
-        # numeric value; ``device`` says cpu:* and tpu_error says why.
         tpu_err = res.get("error", "?")
-        res = _run_worker(
-            "--worker",
-            T_FALLBACK,
-            {
-                "JAX_PLATFORMS": "cpu",
-                "TPUNODE_BENCH_FORCE_CPU": "1",
-                "TPUNODE_BENCH_BATCH": "2048",
-                "TPUNODE_BENCH_ITERS": "2",
-            },
-        )
-        attempts.append(
-            "cpu-fallback: " + ("ok" if res.get("ok") else res.get("error", "?"))
-        )
+        # Round-long watcher fallback (VERDICT r4 item 1): the bench only
+        # samples at round end, but benchmarks/watcher.py samples all
+        # round and persists every successful device measurement.  A
+        # down-tunnel-at-bench-time round still reports a dated, in-round
+        # TPU number with explicit provenance instead of a cpu rate.
+        watcher_run = _freshest_device_run()
+        if watcher_run is not None:
+            provenance = "in-round-watcher"
+            res = {
+                "ok": True,
+                "rate": watcher_run["value"],
+                "device": watcher_run["device"],
+                "kernel": watcher_run.get("kernel"),
+                "batch": watcher_run.get("batch"),
+                "step_ms": watcher_run.get("step_ms"),
+                "compile_s": watcher_run.get("compile_s"),
+                "init_s": watcher_run.get("init_s"),
+            }
+            attempts.append(f"watcher: ok @ {watcher_run['ts']}")
+        else:
+            # Clearly-labeled cpu-jax fallback so the driver still records
+            # a numeric value; ``device`` says cpu:* and tpu_error says why.
+            res = _run_worker(
+                "--worker",
+                T_FALLBACK,
+                {
+                    "JAX_PLATFORMS": "cpu",
+                    "TPUNODE_BENCH_FORCE_CPU": "1",
+                    "TPUNODE_BENCH_BATCH": "2048",
+                    "TPUNODE_BENCH_ITERS": "2",
+                },
+            )
+            attempts.append(
+                "cpu-fallback: "
+                + ("ok" if res.get("ok") else res.get("error", "?"))
+            )
+            # provenance only claims a source that produced the number
+            provenance = "cpu-fallback" if res.get("ok") else "none"
 
     out = {
         "metric": "sig_verify_throughput",
@@ -358,14 +393,18 @@ def main() -> None:
         "unit": "sigs/sec/chip",
         "vs_baseline": round(res.get("rate", 0.0) / cpu_rate, 2),
         "device": res.get("device", "unavailable"),
+        "provenance": provenance,
         "baseline_cpu_single_core": round(cpu_rate, 1),
         "baseline_engine": cpu_engine,
         "attempts": "; ".join(attempts),
     }
     if tpu_err is not None:
         out["tpu_error"] = tpu_err
+    if watcher_run is not None:
+        out["measured_at"] = watcher_run["ts"]
+        out["measured_age_s"] = int(time.time() - watcher_run["unix"])
     for k in ("kernel", "batch", "step_ms", "compile_s", "init_s", "error"):
-        if k in res:
+        if k in res and res[k] is not None:
             out[k] = res[k]
     if probe.get("init_s") is not None:
         out["probe_init_s"] = probe["init_s"]

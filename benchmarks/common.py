@@ -4,7 +4,11 @@ so the two can't drift apart."""
 
 from __future__ import annotations
 
+import json
+import os
 import random
+import signal
+import subprocess
 import time
 
 __all__ = [
@@ -13,7 +17,62 @@ __all__ = [
     "device_kind",
     "cpu_single_core_bench",
     "cpu_single_core_rate",
+    "run_json_subprocess",
 ]
+
+
+def run_json_subprocess(
+    argv: list, timeout: float, env_extra: dict | None = None,
+    cwd: str | None = None,
+) -> dict:
+    """Run a subprocess in its own process group; parse its last JSON line.
+
+    Shared by bench.py's watchdog ladder and benchmarks/watcher.py (the
+    round-long sampler) so the trickiest subprocess logic exists once:
+    the whole process GROUP is killed on timeout, because the TPU shim
+    spawns helpers that inherit the stdout pipe and killing only the
+    direct child leaves communicate() blocked on them forever.  On
+    timeout, the worker's last ``[bench-worker]`` stderr progress line is
+    surfaced so the error says what the worker was doing.
+    """
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        argv, cwd=cwd, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            _, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            stderr = ""
+        last = ""
+        for line in (stderr or "").splitlines():
+            if line.startswith("[bench-worker]"):
+                last = line
+        return {
+            "ok": False,
+            "error": f"timed out after {timeout:.0f}s"
+            + (f" (last: {last})" if last else ""),
+        }
+    for line in reversed((stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return {
+        "ok": False,
+        "error": f"worker rc={proc.returncode}, no JSON "
+        f"(stderr tail: {(stderr or '')[-300:]!r})",
+    }
 
 
 def make_triples(n: int, seed: int = 0xBE5C, invalid_every: int = 16):

@@ -1,0 +1,170 @@
+"""Round-long opportunistic TPU sampler (VERDICT r4 item 1).
+
+The axon TPU tunnel on this box is flaky: it can be down for hours and a
+round-end one-shot bench then records a CPU fallback (rounds 1-4 all lost
+their headline device number this way).  This watcher turns device
+sampling into a round-long process instead of a round-end event:
+
+* every PROBE_INTERVAL seconds, probe the backend in a bounded
+  subprocess (``bench.py --probe`` — the parent never imports jax);
+* the moment the probe reports a live TPU, run the headline pallas
+  ladder (32768 first) and then the BASELINE configs 2/5/3, each in its
+  own watchdog-bounded subprocess;
+* persist every successful device measurement as one JSON line in
+  ``benchmarks/device_runs.jsonl`` (timestamp, metric, value, device,
+  provenance) — ``bench.py`` reports the freshest entry when its own
+  live attempt can't reach the device;
+* after a full sweep, keep refreshing the cheap headline number each
+  uptime window so the freshest entry stays recent, and log every
+  probe so a tunnel that never comes up leaves evidence
+  (``benchmarks/watcher.log``).
+
+Single-core box discipline: when the tunnel is down the watcher is a
+sleeping process plus one network-blocked probe subprocess — no CPU
+burned while the builder's tests run in the foreground.
+
+Run detached from the repo root:
+
+    nohup python -m benchmarks.watcher >> benchmarks/watcher.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.common import run_json_subprocess  # noqa: E402
+
+RUNS_PATH = os.path.join(REPO, "benchmarks", "device_runs.jsonl")
+PREV_RUNS_PATH = RUNS_PATH + ".prev"
+
+PROBE_INTERVAL = float(os.environ.get("TPUNODE_WATCHER_PROBE_INTERVAL", 240))
+PROBE_TIMEOUT = float(os.environ.get("TPUNODE_WATCHER_PROBE_TIMEOUT", 150))
+# After a fully-successful sweep, re-probe less often and only refresh the
+# cheap headline (the compile caches are warm by then).
+REFRESH_INTERVAL = float(os.environ.get("TPUNODE_WATCHER_REFRESH_INTERVAL", 900))
+DEADLINE_S = float(os.environ.get("TPUNODE_WATCHER_DEADLINE_S", 11.0 * 3600))
+
+# Outside the driver's round-end window we can afford generous watchdogs:
+# a server-side compile that outlives one attempt is found warm by the next.
+LADDER = ((32768, 600.0), (8192, 300.0), (4096, 240.0))
+CONFIG_BUDGETS = {"config2": 600.0, "config5": 900.0, "config3": 900.0}
+
+
+def _log(msg: str) -> None:
+    print(f"[{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] {msg}",
+          flush=True)
+
+
+def _record(kind: str, payload: dict) -> None:
+    row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "unix": int(time.time()), "kind": kind}
+    row.update(payload)
+    with open(RUNS_PATH, "a", encoding="utf-8") as f:
+        f.write(json.dumps(row) + "\n")
+    _log(f"recorded {kind}: value={payload.get('value')} "
+         f"device={payload.get('device')}")
+
+
+def _run_json(argv: list[str], timeout: float,
+              env_extra: dict | None = None) -> dict:
+    return run_json_subprocess(argv, timeout, env_extra, cwd=REPO)
+
+
+def probe() -> dict:
+    return _run_json([sys.executable, "bench.py", "--probe"], PROBE_TIMEOUT)
+
+
+def run_headline() -> dict | None:
+    """Pallas ladder, 32768 first.  Returns the successful worker dict,
+    or raises FatalMismatch on a device/oracle verdict mismatch."""
+    for batch, budget in LADDER:
+        res = _run_json(
+            [sys.executable, "bench.py", "--worker"], budget,
+            {"TPUNODE_BENCH_BATCH": str(batch),
+             "TPUNODE_BENCH_REQUIRE_TPU": "1"},
+        )
+        if res.get("ok"):
+            _record("headline", {
+                "metric": "sig_verify_throughput",
+                "value": round(res["rate"], 1), "unit": "sigs/sec/chip",
+                "device": res.get("device"), "kernel": res.get("kernel"),
+                "batch": res.get("batch"), "step_ms": res.get("step_ms"),
+                "compile_s": res.get("compile_s"),
+                "init_s": res.get("init_s"),
+            })
+            return res
+        _log(f"headline tpu@{batch}: {res.get('error', '?')}")
+        if res.get("fatal"):
+            # Correctness failure, not an infra flake: record it (which
+            # poisons bench.py's watcher fallback for the round) and stop
+            # sampling — a later flaky pass must never mask a mismatch.
+            _record("fatal", {"error": res.get("error")})
+            raise FatalMismatch(res.get("error", "verdict mismatch"))
+    return None
+
+
+class FatalMismatch(RuntimeError):
+    """Device/oracle verdict mismatch observed by the watcher."""
+
+
+def run_config(name: str) -> dict | None:
+    res = _run_json([sys.executable, "-m", "benchmarks.run", name],
+                    CONFIG_BUDGETS[name])
+    if res.get("metric"):
+        _record(name, res)
+        return res
+    _log(f"{name}: {res.get('error', '?')}")
+    return None
+
+
+def _rotate_runs_file() -> None:
+    """One rotation per round: a previous round's committed samples must
+    never be reported as in-round (bench.py trusts this file)."""
+    if os.path.exists(RUNS_PATH):
+        os.replace(RUNS_PATH, PREV_RUNS_PATH)
+        _log(f"rotated stale {RUNS_PATH} -> {PREV_RUNS_PATH}")
+
+
+def main() -> None:
+    start = time.time()
+    deadline = start + DEADLINE_S
+    _rotate_runs_file()
+    swept: set[str] = set()   # configs captured on-device this round
+    _log(f"watcher up (pid {os.getpid()}), deadline in "
+         f"{DEADLINE_S/3600:.1f}h, probing every {PROBE_INTERVAL:.0f}s")
+    n_probe = 0
+    while time.time() < deadline:
+        n_probe += 1
+        p = probe()
+        if p.get("ok") and p.get("platform") == "tpu":
+            _log(f"probe #{n_probe}: TPU UP "
+                 f"({p.get('device_kind')}, init {p.get('init_s')}s)")
+            try:
+                head = run_headline()
+            except FatalMismatch as e:
+                _log(f"FATAL verdict mismatch — watcher stops sampling: {e}")
+                return
+            if head is not None:
+                # One at a time, cheapest first; config3 (full-node IBD on
+                # device) is the VERDICT item-2 money shot.
+                for name in ("config2", "config5", "config3"):
+                    if name not in swept and run_config(name) is not None:
+                        swept.add(name)
+            interval = REFRESH_INTERVAL if head is not None else PROBE_INTERVAL
+        else:
+            _log(f"probe #{n_probe}: down "
+                 f"({p.get('error') or 'platform=' + str(p.get('platform'))})")
+            interval = PROBE_INTERVAL
+        time.sleep(max(5.0, min(interval, deadline - time.time())))
+    _log(f"watcher deadline reached after {n_probe} probes; "
+         f"configs captured on-device: {sorted(swept) or 'none'}")
+
+
+if __name__ == "__main__":
+    main()
