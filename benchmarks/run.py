@@ -257,7 +257,7 @@ def config3() -> None:
         from tpunode import ChainSynced, PeerConnected
         from tpunode.store import MemoryKV
 
-        pub = Publisher(name="ibd-bench")
+        pub = Publisher(name="ibd-bench", maxsize=None)  # exact counts: bench bus must be lossless
         cfg = NodeConfig(
             net=net,
             store=MemoryKV(),
@@ -427,7 +427,7 @@ def config4() -> None:
 
             return factory
 
-        pub = Publisher(name="firehose")
+        pub = Publisher(name="firehose", maxsize=None)  # exact counts: bench bus must be lossless
         cfg = NodeConfig(
             net=net,
             store=MemoryKV(),

@@ -99,7 +99,7 @@ async def main():
     server = await asyncio.start_server(
         lambda r, w: remote(r, w, writers), "127.0.0.1", 0)
     port = server.sockets[0].getsockname()[1]
-    pub = Publisher(name="soak")
+    pub = Publisher(name="soak", maxsize=None)  # exact counts: bench bus must be lossless
     cfg = NodeConfig(
         net=NET, store=MemoryKV(), pub=pub,
         peers=[f"127.0.0.1:{port}"] * 1 + [f"127.0.0.1:{port}"],

@@ -285,3 +285,32 @@ def test_lazytx_delegation_and_serialize_forms():
 
     with _pytest.raises(ValueError):
         bad.txid
+
+
+def test_lazy_types_hash_like_their_eager_equivalents():
+    """Equal Tx/LazyTx (and Block/LazyBlock) must collapse in sets/dicts:
+    the lazy wire-decode surface (get_blocks/get_txs) replaced hashable
+    frozen dataclasses, so embedder set/dict use keeps working (ADVICE r4)."""
+    from benchmarks.txgen import gen_mixed_txs
+    from tests import fixtures
+    from tpunode.util import Reader
+    from tpunode.wire import Block, LazyBlock, LazyTx, MsgBlock, MsgTx, Tx
+
+    tx = gen_mixed_txs(2, seed=0x31)[0]
+    raw = tx.serialize()
+    lazy = MsgTx.deserialize_payload(Reader(raw)).tx
+    assert isinstance(lazy, LazyTx)
+    assert hash(lazy) == hash(tx)
+    assert len({tx, lazy, LazyTx(raw)}) == 1
+    assert {lazy: "a"}[tx] == "a"
+
+    block = fixtures.all_blocks()[1]
+    braw = block.serialize()
+    lazy_b = MsgBlock.deserialize_payload(Reader(braw)).block
+    assert isinstance(lazy_b, LazyBlock)
+    eager_b = Block.deserialize(Reader(braw))
+    assert lazy_b == eager_b
+    assert hash(lazy_b) == hash(eager_b)
+    assert len({eager_b, lazy_b}) == 1
+    # frozen message dataclasses containing lazy types are hashable again
+    assert len({MsgTx(tx), MsgTx(LazyTx(raw))}) == 1

@@ -100,26 +100,32 @@ def point_double(p: Point) -> Point:
 # ladder in tests and against OpenSSL).  Built under a lock and published
 # atomically: engine warmup (a daemon thread) and oracle batches (worker
 # threads) can race to first use.
-_G_TABLE: list[list[Point]] = []
+_G_TABLE: tuple[tuple[Point, ...], ...] | None = None
 _G_TABLE_LOCK = __import__("threading").Lock()
 
 
-def _g_table() -> list[list[Point]]:
-    if _G_TABLE:
-        return _G_TABLE
+def _g_table() -> tuple[tuple[Point, ...], ...]:
+    # Lock-free read relies only on a single reference assignment being
+    # atomic (true by the language model, not just the GIL — a partially
+    # visible list via extend() would not be, ADVICE r4).
+    global _G_TABLE
+    table = _G_TABLE
+    if table is not None:
+        return table
     with _G_TABLE_LOCK:
-        if _G_TABLE:
+        if _G_TABLE is not None:
             return _G_TABLE
-        rows: list[list[Point]] = []
+        rows: list[tuple[Point, ...]] = []
         base = GENERATOR
         for _ in range(64):
             row = [INFINITY]
             for _d in range(15):
                 row.append(point_add(row[-1], base))
-            rows.append(row)
+            rows.append(tuple(row))
             base = point_double(point_double(point_double(point_double(base))))
-        _G_TABLE.extend(rows)  # publish fully built
-    return _G_TABLE
+        table = tuple(rows)
+        _G_TABLE = table  # publish fully built, atomically
+    return table
 
 
 def point_mul(k: int, p: Point) -> Point:

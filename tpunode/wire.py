@@ -418,6 +418,13 @@ class LazyBlock:
             and self.txs == tuple(other.txs)
         )
 
+    def __hash__(self) -> int:
+        # Must match the eager Block's dataclass hash (tuple of its
+        # compare fields — raw_txs is compare=False) so mixed sets/dicts
+        # of Block and LazyBlock behave; hashing pays the one-time parse,
+        # like any other content access.
+        return hash((self.header, self.txs))
+
     def __repr__(self) -> str:
         return f"LazyBlock(header={self.header!r}, tx_count={self.tx_count})"
 
@@ -724,6 +731,12 @@ class LazyTx:
         if isinstance(other, Tx):
             return self._parsed() == other
         return NotImplemented
+
+    def __hash__(self) -> int:
+        # Must match the eager Tx's dataclass hash (raw is compare=False)
+        # so mixed sets/dicts of Tx and LazyTx behave; hashing pays the
+        # one-time parse, like any other content access.
+        return hash(self._parsed())
 
     def __repr__(self) -> str:
         return f"LazyTx({len(self.raw)} bytes)"
