@@ -51,19 +51,24 @@ def config1() -> None:
         extract_sig_items,
         wants_amount,
     )
-    from benchmarks.txgen import gen_mixed_txs, synth_amount
+    from benchmarks.txgen import gen_mixed_txs, synth_prevout
 
     n_txs = 64 if SMALL else 1536  # ~2.7 sigs/tx in the mix -> ~4k sigs
     txs = gen_mixed_txs(n_txs, seed=0x800000, invalid_every=0)
     items = []
     total_in = coinbase = extracted = sigs = 0
     for tx in txs:
-        amounts = {
-            idx: synth_amount(ti.prevout.txid, ti.prevout.index)
-            for idx, ti in enumerate(tx.inputs)
-            if wants_amount(tx, idx, False)
-        }
-        its, st = extract_sig_items(tx, prevout_amounts=amounts or None)
+        amounts: dict[int, int] = {}
+        scripts: dict[int, bytes] = {}
+        for idx, ti in enumerate(tx.inputs):
+            if not wants_amount(tx, idx, False):
+                continue
+            amt, script = synth_prevout(ti.prevout.txid, ti.prevout.index)
+            amounts[idx] = amt
+            scripts[idx] = script
+        its, st = extract_sig_items(
+            tx, prevout_amounts=amounts or None, prevout_scripts=scripts or None
+        )
         items.extend(its)
         total_in += st.total_inputs
         coinbase += st.coinbase
@@ -177,7 +182,7 @@ def config3() -> None:
         decode_message_header,
         encode_message,
     )
-    from benchmarks.txgen import gen_chain, synth_amount
+    from benchmarks.txgen import gen_chain, synth_prevout
     from tests.fakenet import QueueConnection, _QueueReader
 
     net = BCH_REGTEST
@@ -266,7 +271,7 @@ def config3() -> None:
             discover=False,
             connect=connect_factory,
             verify=VerifyConfig(max_wait=0.004),
-            prevout_lookup=synth_amount,
+            prevout_lookup=synth_prevout,
         )
         stats = {
             "verdicts": 0, "sigs": 0, "extracted": 0, "noncb_inputs": 0,
@@ -368,7 +373,7 @@ def config4() -> None:
     from tpunode.store import MemoryKV
     from tpunode.verify.engine import VerifyConfig
     from tpunode.wire import MsgTx, encode_message
-    from benchmarks.txgen import gen_mixed_txs, synth_amount
+    from benchmarks.txgen import gen_mixed_txs, synth_prevout
     from tests.fakenet import QueueConnection, _fake_remote
 
     import contextlib
@@ -437,7 +442,7 @@ def config4() -> None:
             max_peers=n_peers,
             connect=lambda sa: firehose_connect(),
             verify=VerifyConfig(batch_size=batch, max_wait=0.005),
-            prevout_lookup=synth_amount,
+            prevout_lookup=synth_prevout,
         )
         verdicts = 0
         sigs = 0

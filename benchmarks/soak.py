@@ -26,7 +26,7 @@ sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(_
 
 from tests.fakenet import mock_peer_react
 from tests.fixtures import all_blocks
-from benchmarks.txgen import gen_mixed_txs, synth_amount
+from benchmarks.txgen import gen_mixed_txs, synth_prevout
 from tpunode import Node, NodeConfig, Publisher, TxVerdict
 from tpunode.chain import ChainSynced
 from tpunode.params import BCH_REGTEST as NET, NODE_NETWORK
@@ -105,7 +105,7 @@ async def main():
         peers=[f"127.0.0.1:{port}"] * 1 + [f"127.0.0.1:{port}"],
         max_peers=3, discover=False,
         verify=VerifyConfig(backend="cpu", max_wait=0.01, warmup=False),
-        prevout_lookup=synth_amount,
+        prevout_lookup=synth_prevout,
     )
     stats = {"verdicts": 0, "sigs": 0, "connects": 0, "disconnects": 0,
              "kills": 0}
@@ -167,4 +167,5 @@ async def main():
     print("[soak] PASS")
 
 
-asyncio.run(main())
+if __name__ == "__main__":
+    asyncio.run(main())

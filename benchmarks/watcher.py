@@ -123,18 +123,54 @@ def run_config(name: str) -> dict | None:
     return None
 
 
-def _rotate_runs_file() -> None:
+FATAL_WINDOW_S = 12 * 3600  # matches bench.py's DEVICE_RUN_MAX_AGE
+
+
+def _rotate_runs_file() -> list[dict]:
     """One rotation per round: a previous round's committed samples must
-    never be reported as in-round (bench.py trusts this file)."""
-    if os.path.exists(RUNS_PATH):
-        os.replace(RUNS_PATH, PREV_RUNS_PATH)
-        _log(f"rotated stale {RUNS_PATH} -> {PREV_RUNS_PATH}")
+    never be reported as in-round (bench.py trusts this file).
+
+    Recent ``fatal`` rows (device/oracle verdict mismatches) are carried
+    FORWARD into the fresh file: a mid-round watcher relaunch must not
+    launder a correctness failure behind a later flaky pass (review r5).
+    Returns the carried rows so main() can refuse to sample."""
+    if not os.path.exists(RUNS_PATH):
+        return []
+    fatals: list[dict] = []
+    now = time.time()
+    try:
+        with open(RUNS_PATH, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (
+                    isinstance(row, dict)
+                    and row.get("kind") == "fatal"
+                    and now - float(row.get("unix", 0)) < FATAL_WINDOW_S
+                ):
+                    fatals.append(row)
+    except OSError:
+        pass
+    os.replace(RUNS_PATH, PREV_RUNS_PATH)
+    _log(f"rotated stale {RUNS_PATH} -> {PREV_RUNS_PATH}")
+    if fatals:
+        with open(RUNS_PATH, "w", encoding="utf-8") as f:
+            for row in fatals:
+                f.write(json.dumps(row) + "\n")
+        _log(f"carried {len(fatals)} recent fatal row(s) forward")
+    return fatals
 
 
 def main() -> None:
     start = time.time()
     deadline = start + DEADLINE_S
-    _rotate_runs_file()
+    if _rotate_runs_file():
+        _log("recent FATAL verdict mismatch on record — refusing to "
+             "sample until the kernel is fixed and the fatal rows are "
+             "cleared deliberately")
+        return
     swept: set[str] = set()   # configs captured on-device this round
     _log(f"watcher up (pid {os.getpid()}), deadline in "
          f"{DEADLINE_S/3600:.1f}h, probing every {PROBE_INTERVAL:.0f}s")

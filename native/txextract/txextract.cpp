@@ -20,6 +20,7 @@
 // Build: make -C native build/libtxextract.so
 // Python binding: tpunode/txextract.py (ctypes).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -440,6 +441,63 @@ bool decode_pubkey(const uint8_t *data, size_t len, uint8_t px[32],
     return true;
   }
   return false;
+}
+
+// BIP340 lift_x: the EVEN-y point with x-coordinate `x32` (big-endian).
+// Mirrors ecdsa_cpu.lift_x — taproot output keys are x-only; an off-curve
+// x makes the spend consensus-invalid.
+bool lift_x(const uint8_t x32[32], uint8_t px[32], uint8_t py[32]) {
+  static const F4 B7 = {{7, 0, 0, 0}};
+  F4 x;
+  f_from_be(x, x32);
+  if (f_ge_p(x)) return false;
+  F4 y2, x2;
+  f_sqr(x2, x);
+  f_mul(y2, x2, x);
+  f_add(y2, y2, B7);
+  F4 y;
+  f_sqrt_candidate(y, y2);
+  F4 check;
+  f_sqr(check, y);
+  if (!f_is_eq(check, y2)) return false;  // non-residue: not on curve
+  if (y.v[0] & 1) {
+    // y = p - y (pick the even root)
+    F4 neg = {{P_LIMBS[0], P_LIMBS[1], P_LIMBS[2], P_LIMBS[3]}};
+    u128 borrow = 0;
+    for (int i = 0; i < 4; ++i) {
+      u128 d = (u128)neg.v[i] - y.v[i] - borrow;
+      neg.v[i] = (uint64_t)d;
+      borrow = (d >> 64) & 1;
+    }
+    y = neg;
+  }
+  memcpy(px, x32, 32);
+  f_to_be(y, py);
+  return true;
+}
+
+// BIP340-style tagged hash: SHA256(SHA256(tag) || SHA256(tag) || data).
+// The two tag digests taproot needs are computed once per process.
+struct TagMidstate {
+  uint8_t th[32];
+  explicit TagMidstate(const char *tag) {
+    sha256(reinterpret_cast<const uint8_t *>(tag), strlen(tag), th);
+  }
+};
+
+void tagged_hash_init(Sha256 &h, const TagMidstate &tag) {
+  h.update(tag.th, 32);
+  h.update(tag.th, 32);
+}
+
+const TagMidstate &tap_sighash_tag() {
+  static const TagMidstate t("TapSighash");
+  return t;
+}
+
+const TagMidstate &bip340_challenge_tag() {
+  static const TagMidstate t("BIP0340/challenge");
+  return t;
 }
 
 // Curve order n, big-endian — sighash digests are reduced mod n before
@@ -962,6 +1020,157 @@ void bip143_sighash(TxSpan &tx, size_t index, const uint8_t *script_code,
   dsha256(buf.data(), buf.size(), out);
 }
 
+// ---------------------------------------------------------------------------
+// BIP341 (taproot) sighash — mirrors tpunode/sighash.py bip341_sighash.
+// All hashes are SINGLE SHA-256 (unlike legacy/BIP143's double).
+// ---------------------------------------------------------------------------
+
+bool valid_taproot_hashtype(int ht) {
+  return ht == 0x00 || ht == 0x01 || ht == 0x02 || ht == 0x03 ||
+         ht == 0x81 || ht == 0x82 || ht == 0x83;
+}
+
+// Resolved prevout (amount, scriptPubKey) rows for one tx's inputs —
+// BIP341 signs over the whole spent-output set.
+struct TapPrevouts {
+  std::vector<int64_t> amounts;
+  std::vector<const uint8_t *> scripts;
+  std::vector<uint32_t> script_lens;
+  std::vector<bool> have;  // per input: both amount and script resolved
+  bool built = false;
+};
+
+// Per-tx cache of the five whole-tx hashes (valid for one extract call:
+// amounts/scripts depend on the call's ext_* resolution).
+struct TapTxHashes {
+  uint8_t prevouts[32], amounts[32], scriptpubkeys[32], sequences[32],
+      outputs[32];
+  bool pv = false, am = false, sp = false, sq = false, out = false;
+};
+
+// Keypath (ext_flag = 0) signature message -> out[32].  `annex` is the
+// full witness element (0x50-prefixed) or nullptr.  Requires
+// tp.have[...] resolution per the hash_type (caller checks); returns
+// false when the spend is structurally INVALID under BIP341 (bad
+// hash_type, SIGHASH_SINGLE with no matching output) — the caller emits
+// an auto-invalid item, not unsupported.
+bool bip341_sighash(TxSpan &tx, size_t index, int hashtype,
+                    const uint8_t *annex, size_t annex_len,
+                    const TapPrevouts &tp, TapTxHashes &th,
+                    std::vector<uint8_t> &scratch, uint8_t out[32]) {
+  if (!valid_taproot_hashtype(hashtype)) return false;
+  int base = hashtype & 3;
+  bool acp = (hashtype & SIGHASH_ANYONECANPAY) != 0;
+  if (base == SIGHASH_SINGLE && index >= tx.outs.size()) return false;
+
+  scratch.clear();
+  std::vector<uint8_t> &buf = scratch;
+  buf.push_back(uint8_t(hashtype));
+  buf.insert(buf.end(), tx.version, tx.version + 4);
+  buf.insert(buf.end(), tx.locktime, tx.locktime + 4);
+  if (!acp) {
+    if (!th.pv) {
+      Sha256 h;
+      for (const InSpan &in : tx.ins) h.update(in.prevout, 36);
+      h.final(th.prevouts);
+      th.pv = true;
+    }
+    if (!th.am) {
+      Sha256 h;
+      for (size_t i = 0; i < tx.ins.size(); ++i) {
+        uint64_t a = uint64_t(tp.amounts[i]);
+        uint8_t le[8];
+        for (int k = 0; k < 8; ++k) le[k] = uint8_t(a >> (8 * k));
+        h.update(le, 8);
+      }
+      h.final(th.amounts);
+      th.am = true;
+    }
+    if (!th.sp) {
+      Sha256 h;
+      std::vector<uint8_t> vs;
+      for (size_t i = 0; i < tx.ins.size(); ++i) {
+        vs.clear();
+        put_varint(vs, tp.script_lens[i]);
+        h.update(vs.data(), vs.size());
+        h.update(tp.scripts[i], tp.script_lens[i]);
+      }
+      h.final(th.scriptpubkeys);
+      th.sp = true;
+    }
+    if (!th.sq) {
+      Sha256 h;
+      for (const InSpan &in : tx.ins) {
+        uint8_t seq[4] = {uint8_t(in.sequence), uint8_t(in.sequence >> 8),
+                          uint8_t(in.sequence >> 16),
+                          uint8_t(in.sequence >> 24)};
+        h.update(seq, 4);
+      }
+      h.final(th.sequences);
+      th.sq = true;
+    }
+    buf.insert(buf.end(), th.prevouts, th.prevouts + 32);
+    buf.insert(buf.end(), th.amounts, th.amounts + 32);
+    buf.insert(buf.end(), th.scriptpubkeys, th.scriptpubkeys + 32);
+    buf.insert(buf.end(), th.sequences, th.sequences + 32);
+  }
+  if (base != SIGHASH_NONE && base != SIGHASH_SINGLE) {
+    if (!th.out) {
+      sha256(tx.outputs_start, tx.outputs_len, th.outputs);
+      th.out = true;
+    }
+    buf.insert(buf.end(), th.outputs, th.outputs + 32);
+  }
+  buf.push_back(annex != nullptr ? 1 : 0);  // spend_type: ext_flag 0
+  const InSpan &in = tx.ins[index];
+  if (acp) {
+    buf.insert(buf.end(), in.prevout, in.prevout + 36);
+    uint64_t a = uint64_t(tp.amounts[index]);
+    for (int k = 0; k < 8; ++k) buf.push_back(uint8_t(a >> (8 * k)));
+    put_varint(buf, tp.script_lens[index]);
+    buf.insert(buf.end(), tp.scripts[index],
+               tp.scripts[index] + tp.script_lens[index]);
+    put_u32(buf, in.sequence);
+  } else {
+    put_u32(buf, uint32_t(index));
+  }
+  if (annex != nullptr) {
+    std::vector<uint8_t> va;
+    put_varint(va, annex_len);
+    va.insert(va.end(), annex, annex + annex_len);
+    uint8_t ah[32];
+    sha256(va.data(), va.size(), ah);
+    buf.insert(buf.end(), ah, ah + 32);
+  }
+  if (base == SIGHASH_SINGLE) {
+    uint8_t oh[32];
+    sha256(tx.outs[index].start, tx.outs[index].len, oh);
+    buf.insert(buf.end(), oh, oh + 32);
+  }
+  Sha256 h;
+  tagged_hash_init(h, tap_sighash_tag());
+  uint8_t epoch = 0x00;
+  h.update(&epoch, 1);
+  h.update(buf.data(), buf.size());
+  h.final(out);
+  return true;
+}
+
+// Locate an output's scriptPubKey inside its raw span (value(8) +
+// varstr(script)).
+bool out_script(const OutSpan &o, const uint8_t **script, uint32_t *len) {
+  Cursor c{o.start + 8, o.start + o.len};
+  uint64_t slen = c.varint();
+  if (!c.ok || slen > c.remaining()) return false;
+  *script = c.p;
+  *len = uint32_t(slen);
+  return true;
+}
+
+bool is_p2tr_script(const uint8_t *s, uint32_t len) {
+  return len == 34 && s[0] == 0x51 && s[1] == 0x20;
+}
+
 // Per-extract-call decoded-pubkey cache: decompression costs a field sqrt
 // (~a modexp), and real workloads reuse keys heavily (one wallet key funds
 // many inputs; multisig windows retry the same keys).  Bounded so a block
@@ -1068,6 +1277,11 @@ long txx_prevouts(const uint8_t *data, long len, long tx_count, int bch,
   while (c.ok && (tx_count < 0 ? c.remaining() > 0 : n < tx_count)) {
     TxSpan tx;
     if (!parse_tx(c, tx, /*compute_txid=*/false)) return -1;
+    // tx-LEVEL witness gate (mirror of txverify.wants_amount): a taproot
+    // keypath input digests EVERY input's amount+script, so any witness
+    // in the tx makes all of its inputs worth a lookup
+    bool tx_has_wit = false;
+    for (const InSpan &in : tx.ins) tx_has_wit |= in.wit_count >= 1;
     for (const InSpan &in : tx.ins) {
       if (flat >= capacity) return -2;
       memcpy(txids32 + flat * 32, in.prevout, 32);
@@ -1077,7 +1291,7 @@ long txx_prevouts(const uint8_t *data, long len, long tx_count, int bch,
       // prevout_lookup as the true unsigned value, not a negative int
       vouts[flat] = int64_t(vout);
       bool cb = memcmp(in.prevout, ZERO_TXID, 32) == 0;
-      wants[flat] = (!cb && (bch || in.wit_count >= 2)) ? 1 : 0;
+      wants[flat] = (!cb && (bch || tx_has_wit)) ? 1 : 0;
       ++flat;
     }
     ++n;
@@ -1181,6 +1395,8 @@ long txx_prevouts_h(void *hp, int bch, long capacity, uint8_t *txids32,
   long flat = 0;
   static const uint8_t ZERO_TXID[32] = {0};
   for (const TxSpan &tx : h->txs) {
+    bool tx_has_wit = false;  // tx-level gate, see txx_prevouts
+    for (const InSpan &in : tx.ins) tx_has_wit |= in.wit_count >= 1;
     for (const InSpan &in : tx.ins) {
       if (flat >= capacity) return -2;
       memcpy(txids32 + flat * 32, in.prevout, 32);
@@ -1188,7 +1404,7 @@ long txx_prevouts_h(void *hp, int bch, long capacity, uint8_t *txids32,
       memcpy(&vout, in.prevout + 32, 4);
       vouts[flat] = int64_t(vout);
       bool cb = memcmp(in.prevout, ZERO_TXID, 32) == 0;
-      wants[flat] = (!cb && (bch || in.wit_count >= 2)) ? 1 : 0;
+      wants[flat] = (!cb && (bch || tx_has_wit)) ? 1 : 0;
       ++flat;
     }
   }
@@ -1204,6 +1420,17 @@ long txx_extract_h(void *hp, int flags, const int64_t *ext_amounts,
                    int32_t *tx_n_inputs, int32_t *tx_extracted,
                    int32_t *tx_items, int32_t *tx_sigs, int32_t *tx_coinbase,
                    int32_t *tx_unsupported);
+
+long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
+                    long n_ext, const uint8_t *ext_scripts,
+                    const int64_t *ext_script_off, long capacity, uint8_t *z,
+                    uint8_t *px, uint8_t *py, uint8_t *r, uint8_t *s,
+                    uint8_t *present, int32_t *item_tx, int32_t *item_input,
+                    int32_t *item_sig, int32_t *item_key, int32_t *item_nsigs,
+                    int32_t *item_nkeys, uint8_t *txids,
+                    int32_t *tx_n_inputs, int32_t *tx_extracted,
+                    int32_t *tx_items, int32_t *tx_sigs, int32_t *tx_coinbase,
+                    int32_t *tx_unsupported);
 
 // Legacy one-shot entry: parse + extract in one call.
 long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
@@ -1226,7 +1453,7 @@ long txx_extract(const uint8_t *data, long len, long tx_count, int flags,
   return out;
 }
 
-// Extraction body over an already-parsed handle.
+// Back-compat shim: extraction without prevout scripts (no taproot).
 long txx_extract_h(void *hp, int flags, const int64_t *ext_amounts,
                    long n_ext, long capacity, uint8_t *z, uint8_t *px,
                    uint8_t *py, uint8_t *r, uint8_t *s, uint8_t *present,
@@ -1236,24 +1463,89 @@ long txx_extract_h(void *hp, int flags, const int64_t *ext_amounts,
                    int32_t *tx_n_inputs, int32_t *tx_extracted,
                    int32_t *tx_items, int32_t *tx_sigs, int32_t *tx_coinbase,
                    int32_t *tx_unsupported) {
+  return txx_extract_h2(hp, flags, ext_amounts, n_ext, nullptr, nullptr,
+                        capacity, z, px, py, r, s, present, item_tx,
+                        item_input, item_sig, item_key, item_nsigs,
+                        item_nkeys, txids, tx_n_inputs, tx_extracted,
+                        tx_items, tx_sigs, tx_coinbase, tx_unsupported);
+}
+
+// Extraction body over an already-parsed handle.
+//
+// ext_scripts/ext_script_off extend the external prevout oracle with
+// scriptPubKeys (VERDICT r4 item 3 — BIP341 digests sign over every
+// input's amount AND script): ext_script_off has n_ext+1 entries; row i's
+// script is ext_scripts[off[i]:off[i+1]], empty = unknown.  Rows align
+// with ext_amounts (flat input order).  NULL = no scripts (no taproot
+// extraction).
+long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
+                    long n_ext, const uint8_t *ext_scripts,
+                    const int64_t *ext_script_off, long capacity, uint8_t *z,
+                    uint8_t *px, uint8_t *py, uint8_t *r, uint8_t *s,
+                    uint8_t *present, int32_t *item_tx, int32_t *item_input,
+                    int32_t *item_sig, int32_t *item_key, int32_t *item_nsigs,
+                    int32_t *item_nkeys, uint8_t *txids,
+                    int32_t *tx_n_inputs, int32_t *tx_extracted,
+                    int32_t *tx_items, int32_t *tx_sigs, int32_t *tx_coinbase,
+                    int32_t *tx_unsupported) {
   std::vector<TxSpan> &txs = static_cast<TxxHandle *>(hp)->txs;
   bool bch = (flags & 1) != 0;
   bool intra = (flags & 2) != 0;
-  std::unordered_map<OutpointKey, int64_t, OutpointHash> amounts;
+  struct PrevoutInfo {
+    int64_t value;
+    const uint8_t *script;
+    uint32_t script_len;
+  };
+  std::unordered_map<OutpointKey, PrevoutInfo, OutpointHash> prevout_map;
   if (intra) {
     size_t total_outs = 0;
     for (const TxSpan &tx : txs) total_outs += tx.outs.size();
-    amounts.reserve(total_outs * 2);
+    prevout_map.reserve(total_outs * 2);
     for (const TxSpan &tx : txs) {
       for (size_t vout = 0; vout < tx.outs.size(); ++vout) {
         OutpointKey key;
         memcpy(key.b, tx.txid, 32);
         uint32_t v32 = uint32_t(vout);
         memcpy(key.b + 32, &v32, 4);
-        amounts[key] = tx.outs[vout].value;
+        PrevoutInfo info{tx.outs[vout].value, nullptr, 0};
+        out_script(tx.outs[vout], &info.script, &info.script_len);
+        prevout_map[key] = info;
       }
     }
   }
+
+  // Resolve one input's prevout (amount, script): intra-block map first,
+  // then the external oracle rows.  Returns a bitmask: 1 amount, 2 script.
+  auto resolve = [&](const InSpan &in, long flat, int64_t *amt,
+                     const uint8_t **scr, uint32_t *slen) -> int {
+    int got = 0;
+    if (intra) {
+      OutpointKey key;
+      memcpy(key.b, in.prevout, 36);
+      auto it = prevout_map.find(key);
+      if (it != prevout_map.end()) {
+        *amt = it->second.value;
+        got |= 1;
+        if (it->second.script != nullptr) {
+          *scr = it->second.script;
+          *slen = it->second.script_len;
+          got |= 2;
+        }
+      }
+    }
+    if (!(got & 1) && ext_amounts != nullptr && flat < n_ext &&
+        ext_amounts[flat] >= 0) {
+      *amt = ext_amounts[flat];
+      got |= 1;
+    }
+    if (!(got & 2) && ext_scripts != nullptr && ext_script_off != nullptr &&
+        flat < n_ext && ext_script_off[flat + 1] > ext_script_off[flat]) {
+      *scr = ext_scripts + ext_script_off[flat];
+      *slen = uint32_t(ext_script_off[flat + 1] - ext_script_off[flat]);
+      got |= 2;
+    }
+    return got;
+  };
 
   // pass 2: extract items
   static const uint8_t ZERO_TXID[32] = {0};
@@ -1261,13 +1553,16 @@ long txx_extract_h(void *hp, int flags, const int64_t *ext_amounts,
   scratch.reserve(4096);
   PubkeyCache pubcache;
   long item = 0;
-  long flat_input = 0;  // index into ext_amounts
+  long flat_input = 0;  // index into ext_amounts / ext_script_off
   for (size_t ti = 0; ti < txs.size(); ++ti) {
     TxSpan &tx = txs[ti];
     memcpy(txids + ti * 32, tx.txid, 32);
     int32_t n_inputs = 0, extracted = 0, coinbase = 0, unsupported = 0;
     int32_t sigs = 0;
     long tx_item_start = item;
+    long tx_flat_base = flat_input;
+    TapPrevouts tap;      // whole-tx prevout rows, built on first taproot use
+    TapTxHashes taphash;  // per-tx BIP341 hash cache
     for (size_t idx = 0; idx < tx.ins.size(); ++idx, ++flat_input) {
       const InSpan &in = tx.ins[idx];
       ++n_inputs;
@@ -1275,32 +1570,155 @@ long txx_extract_h(void *hp, int flags, const int64_t *ext_amounts,
         ++coinbase;
         continue;
       }
+
+      // prevout resolution (shared by every template; scripts matter only
+      // for taproot detection + BIP341)
+      int64_t amount = 0;
+      const uint8_t *pscript = nullptr;
+      uint32_t pscript_len = 0;
+      int got = resolve(in, flat_input, &amount, &pscript, &pscript_len);
+      bool have_amount = (got & 1) != 0;
+
+      if (!bch && (got & 2) && is_p2tr_script(pscript, pscript_len)) {
+        // Taproot KEYPATH spend (mirror of txverify._taproot_item):
+        // witness = [sig] or [sig, annex]; >=2 non-annex elements is the
+        // script path (unsupported — this is a signature pre-verifier,
+        // not a tapscript interpreter).
+        uint32_t wn = in.wit_count;
+        const uint8_t *annex = nullptr;
+        size_t annex_len = 0;
+        if (wn > MAX_WIT_SPANS) {
+          ++unsupported;  // can't even see the trailing spans: script path
+          continue;
+        }
+        if (wn >= 2 && in.wit_len[wn - 1] >= 1 &&
+            in.wit[wn - 1][0] == 0x50) {
+          annex = in.wit[wn - 1];
+          annex_len = in.wit_len[wn - 1];
+          --wn;
+        }
+        if (wn != 1) {
+          ++unsupported;
+          continue;
+        }
+        const uint8_t *sig = in.wit[0];
+        uint32_t sig_len = in.wit_len[0];
+        // Consensus-invalid shapes emit an AUTO-INVALID item (present=0):
+        // the spend is invalid, not unsupported.
+        auto emit_invalid = [&](const uint8_t *rb, const uint8_t *sb) -> bool {
+          if (item >= capacity) return false;
+          memset(z + item * 32, 0, 32);
+          memset(px + item * 32, 0, 32);
+          memset(py + item * 32, 0, 32);
+          if (rb != nullptr) memcpy(r + item * 32, rb, 32);
+          else memset(r + item * 32, 0, 32);
+          if (sb != nullptr) memcpy(s + item * 32, sb, 32);
+          else memset(s + item * 32, 0, 32);
+          present[item] = 0;
+          item_tx[item] = int32_t(ti);
+          item_input[item] = int32_t(idx);
+          item_sig[item] = 0;
+          item_key[item] = 0;
+          item_nsigs[item] = 1;
+          item_nkeys[item] = 1;
+          ++item;
+          ++extracted;
+          ++sigs;
+          return true;
+        };
+        int hashtype;
+        if (sig_len == 64) {
+          hashtype = 0x00;
+        } else if (sig_len == 65) {
+          hashtype = sig[64];
+          if (hashtype == 0x00) {
+            // 65-byte sig must carry an explicit type (zero r/s, mirror
+            // of txverify's bare invalid())
+            if (!emit_invalid(nullptr, nullptr)) return -2;
+            continue;
+          }
+        } else {
+          if (!emit_invalid(nullptr, nullptr)) return -2;
+          continue;
+        }
+        // ACP bit decides WHICH prevouts are required even when the
+        // hash_type is invalid (parity with txverify._taproot_item's
+        // `need` computation; the invalid type then fails in the digest)
+        bool acp = (hashtype & SIGHASH_ANYONECANPAY) != 0;
+        if (!tap.built) {
+          size_t n_in = tx.ins.size();
+          tap.amounts.assign(n_in, 0);
+          tap.scripts.assign(n_in, nullptr);
+          tap.script_lens.assign(n_in, 0);
+          tap.have.assign(n_in, false);
+          for (size_t i = 0; i < n_in; ++i) {
+            int64_t a = 0;
+            const uint8_t *sc = nullptr;
+            uint32_t sl = 0;
+            int g = resolve(tx.ins[i], tx_flat_base + long(i), &a, &sc, &sl);
+            if ((g & 3) == 3) {
+              tap.amounts[i] = a;
+              tap.scripts[i] = sc;
+              tap.script_lens[i] = sl;
+              tap.have[i] = true;
+            }
+          }
+          tap.built = true;
+        }
+        bool have_prevouts = acp ? bool(tap.have[idx])
+                                 : std::all_of(tap.have.begin(),
+                                               tap.have.end(),
+                                               [](bool b) { return b; });
+        if (!have_prevouts) {
+          ++unsupported;  // digest uncomputable: missing prevout info
+          continue;
+        }
+        uint8_t digest[32];
+        if (!bip341_sighash(tx, idx, hashtype, annex, annex_len, tap,
+                            taphash, scratch, digest)) {
+          if (!emit_invalid(sig, sig + 32)) return -2;
+          continue;
+        }
+        uint8_t pxb[32], pyb[32];
+        if (!lift_x(pscript + 2, pxb, pyb)) {
+          // off-curve output key: invalid spend
+          if (!emit_invalid(sig, sig + 32)) return -2;
+          continue;
+        }
+        if (item >= capacity) return -2;
+        // challenge e = tagged(BIP0340/challenge, r ∥ px ∥ m) mod n —
+        // extraction precomputes it, like the BCH Schnorr lane
+        uint8_t e32[32];
+        Sha256 h;
+        tagged_hash_init(h, bip340_challenge_tag());
+        h.update(sig, 32);       // r
+        h.update(pxb, 32);       // x-only pubkey
+        h.update(digest, 32);    // m
+        h.final(e32);
+        reduce_mod_n(e32);
+        memcpy(z + item * 32, e32, 32);
+        memcpy(px + item * 32, pxb, 32);
+        memcpy(py + item * 32, pyb, 32);
+        memcpy(r + item * 32, sig, 32);
+        memcpy(s + item * 32, sig + 32, 32);
+        present[item] = 3;
+        item_tx[item] = int32_t(ti);
+        item_input[item] = int32_t(idx);
+        item_sig[item] = 0;
+        item_key[item] = 0;
+        item_nsigs[item] = 1;
+        item_nkeys[item] = 1;
+        ++item;
+        ++extracted;
+        ++sigs;
+        continue;
+      }
+
       InTemplate t;
       classify_input(in, t);
       if (t.kind == InTemplate::UNSUPPORTED) {
         ++unsupported;
         continue;
-      }
-
-      // amount resolution shared by both kinds (prevout is per-input):
-      // intra-block map first, then ext_amounts.  The map keeps the raw
-      // 64-bit value (valid even above 2^63); only the ext sentinel uses
-      // sign (-1 = unknown).
-      int64_t amount = 0;
-      bool have_amount = false;
-      if (intra) {
-        OutpointKey key;
-        memcpy(key.b, in.prevout, 36);
-        auto it = amounts.find(key);
-        if (it != amounts.end()) {
-          amount = it->second;
-          have_amount = true;
-        }
-      }
-      if (!have_amount && ext_amounts != nullptr && flat_input < n_ext &&
-          ext_amounts[flat_input] >= 0) {
-        amount = ext_amounts[flat_input];
-        have_amount = true;
       }
 
       if (t.kind == InTemplate::SINGLE) {

@@ -14,7 +14,7 @@ import random
 
 import pytest
 
-from benchmarks.txgen import gen_mixed_txs, synth_amount
+from benchmarks.txgen import gen_mixed_txs, synth_prevout
 from tpunode.txverify import (
     combine_verdicts,
     extract_sig_items,
@@ -44,12 +44,16 @@ def _python_path(data: bytes, n_txs: int, bch: bool):
     sigs = []
     for tx in txs:
         amounts = {}
+        scripts = {}
         for idx, ti in enumerate(tx.inputs):
             if wants_amount(tx, idx, bch):
-                amounts[idx] = synth_amount(ti.prevout.txid, ti.prevout.index)
+                amounts[idx], scripts[idx] = synth_prevout(
+                    ti.prevout.txid, ti.prevout.index
+                )
         try:
             its, st = extract_sig_items(
-                tx, prevout_amounts=amounts or None, bch=bch
+                tx, prevout_amounts=amounts or None, bch=bch,
+                prevout_scripts=scripts or None,
             )
         except Exception:
             return None
@@ -66,10 +70,15 @@ def _native_path(data: bytes, n_txs: int, bch: bool):
     with region:
         pt, pv, pw = region.scan_prevouts(bch)
         ext = [-1] * len(pw)
+        ext_scripts: list = [None] * len(pw)
         for i in pw.nonzero()[0]:
-            ext[int(i)] = synth_amount(pt[i].tobytes(), int(pv[i]))
+            ext[int(i)], ext_scripts[int(i)] = synth_prevout(
+                pt[i].tobytes(), int(pv[i])
+            )
         try:
-            return region.extract(bch=bch, ext_amounts=ext)
+            return region.extract(
+                bch=bch, ext_amounts=ext, ext_scripts=ext_scripts
+            )
         except ValueError:
             return None
 

@@ -50,8 +50,18 @@ def _amounts_for(tx, bch=False):
 
 
 def _extract_and_verify(tx, bch=False):
+    from benchmarks.txgen import synth_prevout
+
+    amounts = {}
+    scripts = {}
+    for idx, ti in enumerate(tx.inputs):
+        if wants_amount(tx, idx, bch):
+            amounts[idx], scripts[idx] = synth_prevout(
+                ti.prevout.txid, ti.prevout.index
+            )
     items, stats = extract_sig_items(
-        tx, prevout_amounts=_amounts_for(tx, bch) or None, bch=bch
+        tx, prevout_amounts=amounts or None, bch=bch,
+        prevout_scripts=scripts or None,
     )
     verdicts = verify_batch_cpu([i.verify_item for i in items])
     return items, stats, combine_verdicts(items, verdicts)
@@ -243,17 +253,23 @@ def test_mixed_workload_native_parity():
     txextract = pytest.importorskip("tpunode.txextract")
     if not txextract.have_native_extract():  # pragma: no cover
         pytest.skip("native txextract unavailable")
+    from benchmarks.txgen import synth_prevout
+
     txs = gen_mixed_txs(100, seed=11, invalid_every=5)
     data = b"".join(t.serialize() for t in txs)
     ext = []
+    ext_scripts: list = []
     for tx in txs:
         for idx, ti in enumerate(tx.inputs):
-            ext.append(
-                synth_amount(ti.prevout.txid, ti.prevout.index)
-                if wants_amount(tx, idx, False)
-                else -1
-            )
-    raw = txextract.extract_raw(data, len(txs), ext_amounts=ext)
+            if wants_amount(tx, idx, False):
+                a, sc = synth_prevout(ti.prevout.txid, ti.prevout.index)
+            else:
+                a, sc = -1, None
+            ext.append(a)
+            ext_scripts.append(sc)
+    raw = txextract.extract_raw(
+        data, len(txs), ext_amounts=ext, ext_scripts=ext_scripts
+    )
     py_items = []
     py_sig_verdicts = []
     for tx in txs:

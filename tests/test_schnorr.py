@@ -32,6 +32,67 @@ from tpunode.verify.ecdsa_cpu import (
 rng = random.Random(0x5C40)
 
 
+def test_independent_spec_construction():
+    """Build BCH Schnorr signatures from scratch per the 2019 spec with an
+    INDEPENDENT hashlib challenge (no shared schnorr_challenge code), and
+    require the repo verifier to accept them — closing the
+    sign/verify-share-a-bug loophole (ADVICE r4).  Also pins the repo
+    challenge function byte-for-byte against the independent one."""
+    import hashlib
+
+    from tpunode.verify.ecdsa_cpu import CURVE_P as P_
+
+    local = random.Random(0xBC45)
+    for i in range(8):
+        d = local.getrandbits(256) % CURVE_N or 1
+        P = point_mul(d, GENERATOR)
+        m = local.getrandbits(256)
+        k = local.getrandbits(256) % CURVE_N or 1
+        R = point_mul(k, GENERATOR)
+        # spec: k is negated when jacobi(R.y) != 1, R.x is kept
+        if jacobi(R.y) != 1:
+            k = CURVE_N - k
+        r = R.x
+        compressed = bytes([2 + (P.y & 1)]) + P.x.to_bytes(32, "big")
+        e_ind = (
+            int.from_bytes(
+                hashlib.sha256(
+                    r.to_bytes(32, "big") + compressed + m.to_bytes(32, "big")
+                ).digest(),
+                "big",
+            )
+            % CURVE_N
+        )
+        assert e_ind == schnorr_challenge(r, P, m)  # challenge pinned
+        s = (k + e_ind * d) % CURVE_N
+        assert verify_schnorr(P, m, r, s), i
+        assert not verify_schnorr(P, m ^ 1, r, s)
+        assert not verify_schnorr(P, m, r, (s + 1) % CURVE_N)
+        # odd-y pubkeys exercise the compressed-prefix byte
+        if P.y & 1:
+            break
+    # jacobi rule: a signature built WITHOUT the k negation must fail
+    # whenever jacobi(R.y) != 1 (the acceptance test is jacobi, not parity)
+    d = 0xD1CE
+    P = point_mul(d, GENERATOR)
+    m = 0x1234
+    for k in range(2, 40):
+        R = point_mul(k, GENERATOR)
+        if jacobi(R.y) == 1:
+            continue
+        compressed = bytes([2 + (P.y & 1)]) + P.x.to_bytes(32, "big")
+        e = int.from_bytes(
+            hashlib.sha256(
+                R.x.to_bytes(32, "big") + compressed + m.to_bytes(32, "big")
+            ).digest(), "big") % CURVE_N
+        s_wrong = (k + e * d) % CURVE_N  # forgot the negation
+        assert not verify_schnorr(P, m, R.x, s_wrong)
+        s_right = ((CURVE_N - k) + e * d) % CURVE_N
+        assert verify_schnorr(P, m, R.x, s_right)
+        break
+    assert 0 <= P.x < P_
+
+
 def _schnorr_item(corrupt: str = ""):
     priv = rng.getrandbits(256) % CURVE_N or 1
     pub = point_mul(priv, GENERATOR)

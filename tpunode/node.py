@@ -27,7 +27,7 @@ from .txverify import (
     ExtractStats,
     combine_verdicts,
     extract_sig_items,
-    intra_block_amounts,
+    intra_block_prevouts,
     wants_amount,
 )
 from .verify.engine import VerifyConfig, VerifyEngine
@@ -81,6 +81,16 @@ def _native_extract_available() -> bool:
             log.info("[Node] native tx extractor unavailable; python path")
     return _native_extract_state
 
+def _prevout_info(res) -> "tuple[Optional[int], Optional[bytes]]":
+    """Normalize a ``prevout_lookup`` result: plain satoshi amount (the
+    pre-taproot form), an ``(amount, scriptPubKey)`` tuple, or None."""
+    if res is None:
+        return None, None
+    if isinstance(res, tuple):
+        return res[0], res[1]
+    return res, None
+
+
 @dataclass(frozen=True)
 class VerifyShed:
     """Published when verify-ingest backpressure drops a message's txs
@@ -132,12 +142,18 @@ class NodeConfig:
     # north-star hook: when set, inbound tx/block signatures stream through
     # the batch verify engine and TxVerdict events reach the user bus
     verify: Optional[VerifyConfig] = None
-    # prevout amount oracle for BIP143 (P2WPKH / BCH FORKID) sighashes:
-    # (prevout txid, vout) -> satoshi amount, or None if unknown.  Block
-    # ingest resolves intra-block spends automatically; this hook lets the
-    # embedder (which may hold a UTXO set) resolve the rest.  Capability
-    # boundary of SURVEY.md C9 / §2.2.
-    prevout_lookup: Optional[Callable[[bytes, int], Optional[int]]] = None
+    # prevout oracle for BIP143 (P2WPKH / BCH FORKID) and BIP341 (taproot)
+    # sighashes: (prevout txid, vout) -> satoshi amount, or
+    # (amount, scriptPubKey), or None if unknown.  The tuple form enables
+    # taproot keypath extraction: a P2TR spend is only detectable from the
+    # prevout script, and its BIP341 digest signs over every input's
+    # amount AND script (VERDICT r4 item 3).  Block ingest resolves
+    # intra-block spends automatically; this hook lets the embedder (which
+    # may hold a UTXO set) resolve the rest.  Capability boundary of
+    # SURVEY.md C9 / §2.2.
+    prevout_lookup: Optional[
+        Callable[[bytes, int], "Optional[int | tuple[int, bytes]]"]
+    ] = None
 
     def __post_init__(self):
         if self.connect is None:
@@ -408,21 +424,26 @@ class Node:
                 )
                 try:
                     ext: Optional[list[int]] = None
+                    ext_scripts: Optional[list[Optional[bytes]]] = None
                     if self.cfg.prevout_lookup is not None:
                         pv_txids, pv_vouts, pv_wants = region.scan_prevouts(bch)
                         lookup = self.cfg.prevout_lookup
                         ext = [-1] * len(pv_wants)
+                        ext_scripts = [None] * len(pv_wants)
                         for i in pv_wants.nonzero()[0]:
-                            amt = lookup(
-                                pv_txids[i].tobytes(), int(pv_vouts[i])
+                            amt, script = _prevout_info(
+                                lookup(pv_txids[i].tobytes(), int(pv_vouts[i]))
                             )
                             if amt is not None:
                                 ext[int(i)] = amt
+                            if script is not None:
+                                ext_scripts[int(i)] = script
                     items = await asyncio.to_thread(
                         region.extract,
                         bch=bch,
                         intra_amounts=False,
                         ext_amounts=ext,
+                        ext_scripts=ext_scripts,
                     )
                 finally:
                     region.close()
@@ -568,20 +589,27 @@ class Node:
             # prevout_lookup precedence (an in-block hit shadows whatever
             # the oracle would have said).
             ext: Optional[list[int]] = None
+            ext_scripts: Optional[list[Optional[bytes]]] = None
             if self.cfg.prevout_lookup is not None:
                 pv_txids, pv_vouts, pv_wants = region.scan_prevouts(bch)
                 lookup = self.cfg.prevout_lookup
                 ext = [-1] * len(pv_wants)
+                ext_scripts = [None] * len(pv_wants)
                 for i in pv_wants.nonzero()[0]:
-                    amt = lookup(pv_txids[i].tobytes(), int(pv_vouts[i]))
+                    amt, script = _prevout_info(
+                        lookup(pv_txids[i].tobytes(), int(pv_vouts[i]))
+                    )
                     if amt is not None:
                         ext[int(i)] = amt
+                    if script is not None:
+                        ext_scripts[int(i)] = script
             try:
                 items = await asyncio.to_thread(
                     region.extract,
                     bch=bch,
                     intra_amounts=n_txs > 1,
                     ext_amounts=ext,
+                    ext_scripts=ext_scripts,
                 )
             except asyncio.CancelledError:
                 raise
@@ -623,10 +651,11 @@ class Node:
         device batches (awaiting per tx would degrade a 150k-sig block into
         sequential tiny batches)."""
         assert self.verify_engine is not None
-        # Intra-block prevout amounts: a block message carries the funding tx
-        # for every in-block spend, which is exactly what BIP143 digests need
-        # (VERDICT r2 item 5).  Misses fall through to cfg.prevout_lookup.
-        block_outs = intra_block_amounts(txs) if len(txs) > 1 else {}
+        # Intra-block prevouts: a block message carries the funding tx for
+        # every in-block spend — exactly what BIP143 (amount) and BIP341
+        # (amount + script) digests need (VERDICT r2 item 5 / r4 item 3).
+        # Misses fall through to cfg.prevout_lookup.
+        block_outs = intra_block_prevouts(txs) if len(txs) > 1 else {}
         per_tx: list[tuple[Tx, ExtractStats, list, Optional[asyncio.Task]]] = []
         try:
             for tx in txs:
@@ -636,19 +665,36 @@ class Node:
                     # first attribute access, which must become an error
                     # verdict + peer kill, never a dead ingest task
                     amounts: dict[int, int] = {}
+                    scripts: dict[int, bytes] = {}
                     for idx, txin in enumerate(tx.inputs):
-                        if not wants_amount(tx, idx, self.cfg.net.bch):
-                            continue  # legacy non-FORKID input: amount unused
                         key = (txin.prevout.txid, txin.prevout.index)
-                        amt = block_outs.get(key)
-                        if amt is None and self.cfg.prevout_lookup is not None:
-                            amt = self.cfg.prevout_lookup(*key)
+                        # Precedence mirrors the native resolve(): the
+                        # intra-block map is consulted for EVERY input (a
+                        # dict hit is free, and classification must see
+                        # in-block P2TR scripts identically on both
+                        # paths); the external oracle only for inputs the
+                        # tx-level witness gate marks (review r5 parity
+                        # finding).
+                        hit = block_outs.get(key)
+                        if hit is not None:
+                            amt, script = hit
+                        elif self.cfg.prevout_lookup is not None and (
+                            wants_amount(tx, idx, self.cfg.net.bch)
+                        ):
+                            amt, script = _prevout_info(
+                                self.cfg.prevout_lookup(*key)
+                            )
+                        else:
+                            amt = script = None
                         if amt is not None:
                             amounts[idx] = amt
+                        if script is not None:
+                            scripts[idx] = script
                     items, stats = extract_sig_items(
                         tx,
                         prevout_amounts=amounts or None,
                         bch=self.cfg.net.bch,
+                        prevout_scripts=scripts or None,
                     )
                 except Exception as e:
                     metrics.inc("node.verify_errors")

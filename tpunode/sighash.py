@@ -7,13 +7,20 @@ wallet side); the verify engine needs them to turn raw transactions into
 * the legacy (pre-segwit) sighash algorithm, including the historical
   SIGHASH_SINGLE out-of-range "hash = 1" quirk,
 * BIP143 (segwit v0) digests, given the input amount,
-* the BCH variant (BIP143-style with FORKID, used by Bitcoin Cash).
+* the BCH variant (BIP143-style with FORKID, used by Bitcoin Cash),
+* BIP341 (taproot, segwit v1) digests, given EVERY input's prevout
+  amount and scriptPubKey (keypath spends sign over the whole prevout
+  set — the structural reason taproot extraction needs the extended
+  prevout oracle).
 
 Script handling is deliberately minimal: ``script_code`` is supplied by the
 caller (tpunode/txverify.py derives it for the standard templates).
 """
 
 from __future__ import annotations
+
+import hashlib
+from typing import Optional, Sequence
 
 from .util import double_sha256, write_varint, write_varstr
 from .wire import OutPoint, Tx, TxIn, TxOut
@@ -24,8 +31,11 @@ __all__ = [
     "SIGHASH_SINGLE",
     "SIGHASH_ANYONECANPAY",
     "SIGHASH_FORKID",
+    "SIGHASH_DEFAULT",
     "legacy_sighash",
     "bip143_sighash",
+    "bip341_sighash",
+    "valid_taproot_hashtype",
 ]
 
 SIGHASH_ALL = 0x01
@@ -33,6 +43,7 @@ SIGHASH_NONE = 0x02
 SIGHASH_SINGLE = 0x03
 SIGHASH_FORKID = 0x40  # BCH
 SIGHASH_ANYONECANPAY = 0x80
+SIGHASH_DEFAULT = 0x00  # BIP341: 64-byte signature, ALL semantics
 
 
 def legacy_sighash(tx: Tx, index: int, script_code: bytes, hashtype: int) -> int:
@@ -122,3 +133,84 @@ def bip143_sighash(
         + hashtype.to_bytes(4, "little")
     )
     return int.from_bytes(double_sha256(preimage), "big")
+
+
+def _tagged_hash(tag: bytes, data: bytes) -> bytes:
+    th = hashlib.sha256(tag).digest()
+    return hashlib.sha256(th + th + data).digest()
+
+
+def valid_taproot_hashtype(hashtype: int) -> bool:
+    """BIP341's valid hash_type set: 0x00 (default) or base 1..3, with or
+    without ANYONECANPAY.  Anything else makes the spend invalid."""
+    return hashtype in (0x00, 0x01, 0x02, 0x03, 0x81, 0x82, 0x83)
+
+
+def bip341_sighash(
+    tx: Tx,
+    index: int,
+    amounts: Sequence[int],
+    scripts: Sequence[bytes],
+    hashtype: int = SIGHASH_DEFAULT,
+    annex: Optional[bytes] = None,
+) -> Optional[int]:
+    """Taproot (segwit v1) signature message for a KEYPATH spend
+    (``ext_flag = 0``), per BIP341's SigMsg.
+
+    ``amounts``/``scripts`` are the spent outputs' values and
+    scriptPubKeys for ALL of ``tx``'s inputs, in input order (with
+    ANYONECANPAY only entry ``index`` is consulted).  ``annex`` is the
+    raw annex WITHOUT its 0x50 prefix stripped (i.e. the full witness
+    element), or None.  All hashes are single SHA-256 (unlike
+    legacy/BIP143's double).
+
+    Returns the digest as an int, or None when the spend is structurally
+    invalid under BIP341 (invalid hash_type, or SIGHASH_SINGLE with no
+    matching output) — the caller turns None into an auto-invalid item,
+    matching consensus "validation failure", not "unsupported".
+    """
+    if not valid_taproot_hashtype(hashtype):
+        return None
+    base = hashtype & 3
+    anyonecanpay = bool(hashtype & SIGHASH_ANYONECANPAY)
+    if base == SIGHASH_SINGLE and index >= len(tx.outputs):
+        return None  # BIP341: invalid (no legacy "hash = 1" quirk)
+
+    msg = bytearray()
+    msg.append(hashtype)
+    msg += tx.version.to_bytes(4, "little")
+    msg += tx.locktime.to_bytes(4, "little")
+    if not anyonecanpay:
+        msg += hashlib.sha256(
+            b"".join(i.prevout.serialize() for i in tx.inputs)
+        ).digest()
+        msg += hashlib.sha256(
+            b"".join(int(a).to_bytes(8, "little") for a in amounts)
+        ).digest()
+        msg += hashlib.sha256(
+            b"".join(write_varstr(s) for s in scripts)
+        ).digest()
+        msg += hashlib.sha256(
+            b"".join(i.sequence.to_bytes(4, "little") for i in tx.inputs)
+        ).digest()
+    if base not in (SIGHASH_NONE, SIGHASH_SINGLE):
+        msg += hashlib.sha256(
+            b"".join(o.serialize() for o in tx.outputs)
+        ).digest()
+    spend_type = 1 if annex is not None else 0  # ext_flag 0 (keypath)
+    msg.append(spend_type)
+    txin = tx.inputs[index]
+    if anyonecanpay:
+        msg += txin.prevout.serialize()
+        msg += int(amounts[index]).to_bytes(8, "little")
+        msg += write_varstr(scripts[index])
+        msg += txin.sequence.to_bytes(4, "little")
+    else:
+        msg += index.to_bytes(4, "little")
+    if annex is not None:
+        msg += hashlib.sha256(write_varstr(annex)).digest()
+    if base == SIGHASH_SINGLE:
+        msg += hashlib.sha256(tx.outputs[index].serialize()).digest()
+    return int.from_bytes(
+        _tagged_hash(b"TapSighash", b"\x00" + bytes(msg)), "big"
+    )

@@ -125,6 +125,18 @@ def load_txextract_lib() -> ctypes.CDLL:
             i32, i32, i32, i32, i32, i32,  # item_*
             u8, i32, i32, i32, i32, i32, i32,  # txids + tx_*
         ]
+        # h2: extended prevout oracle — per-input scriptPubKeys alongside
+        # amounts (BIP341/taproot needs both; VERDICT r4 item 3)
+        lib.txx_extract_h2.restype = ctypes.c_long
+        lib.txx_extract_h2.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_long,   # ext_amounts, n_ext
+            ctypes.c_void_p, ctypes.c_void_p,  # ext_scripts, ext_script_off
+            ctypes.c_long,
+            u8, u8, u8, u8, u8, u8,  # z px py r s present
+            i32, i32, i32, i32, i32, i32,  # item_*
+            u8, i32, i32, i32, i32, i32, i32,  # txids + tx_*
+        ]
         lib._ext_amounts_t = i64  # kept for callers building arrays
         _lib = lib
         return lib
@@ -243,10 +255,12 @@ class RawSigItems:
 
     def to_verify_items(self):
         """Convert to the engine's ``VerifyItem`` tuples (5-tuples tagged
-        "schnorr" for ``present == 2`` rows) — for the oracle backend and
-        cross-checks; the fast paths consume the arrays."""
+        "schnorr" for ``present == 2`` rows, "bip340" for ``== 3``) — for
+        the oracle backend and cross-checks; the fast paths consume the
+        arrays."""
         from .verify.ecdsa_cpu import Point
 
+        tags = {2: ("schnorr",), 3: ("bip340",)}
         items = []
         for i in range(self.count):
             if self.present[i]:
@@ -262,7 +276,7 @@ class RawSigItems:
                 int.from_bytes(self.r[i].tobytes(), "big"),
                 int.from_bytes(self.s[i].tobytes(), "big"),
             )
-            items.append(tup + ("schnorr",) if self.present[i] == 2 else tup)
+            items.append(tup + tags.get(int(self.present[i]), ()))
         return items
 
 
@@ -343,8 +357,15 @@ class ParsedTxRegion:
         bch: bool = False,
         intra_amounts: bool = True,
         ext_amounts: Optional[Sequence[int]] = None,
+        ext_scripts: Optional[Sequence[Optional[bytes]]] = None,
     ) -> RawSigItems:
-        """Same result as :func:`extract_raw`, zero re-parse."""
+        """Same result as :func:`extract_raw`, zero re-parse.
+
+        ``ext_scripts`` extends the external prevout oracle with
+        scriptPubKeys, aligned row-for-row with ``ext_amounts`` (flat
+        input order; None/empty = unknown).  Needed for taproot: a P2TR
+        keypath spend is detected from the prevout script and its BIP341
+        digest signs over every input's amount AND script."""
         assert self._h, "region closed"
         capacity = max(1, self.capacity)
         nt = max(1, self.n_txs)
@@ -371,6 +392,10 @@ class ParsedTxRegion:
             tx_unsupported=np.zeros(nt, np.int32),
         )
         flags = (1 if bch else 0) | (2 if intra_amounts else 0)
+        if ext_amounts is None and ext_scripts is not None:
+            # script rows align with amount rows; an all-unknown amounts
+            # array keeps the row indexing consistent
+            ext_amounts = [-1] * len(ext_scripts)
         if ext_amounts is not None:
             ext = np.asarray(
                 [(-1 if a is None else a) for a in ext_amounts], np.int64
@@ -381,8 +406,23 @@ class ParsedTxRegion:
             ext = None  # noqa: F841 — keep the array alive through the call
             ext_ptr = None
             n_ext = 0
-        count = self._lib.txx_extract_h(
-            self._h, flags, ext_ptr, n_ext, capacity,
+        if ext_scripts is not None:
+            if len(ext_scripts) != n_ext:
+                raise ValueError("ext_scripts/ext_amounts length mismatch")
+            blobs = [s or b"" for s in ext_scripts]
+            off = np.zeros(n_ext + 1, np.int64)
+            np.cumsum([len(b) for b in blobs], out=off[1:])
+            concat = np.frombuffer(
+                b"".join(blobs) or b"\x00", np.uint8
+            )  # keep non-empty for a valid pointer
+            scr_ptr = concat.ctypes.data_as(ctypes.c_void_p)
+            off_ptr = off.ctypes.data_as(ctypes.c_void_p)
+        else:
+            concat = off = None  # noqa: F841 — keep alive through the call
+            scr_ptr = None
+            off_ptr = None
+        count = self._lib.txx_extract_h2(
+            self._h, flags, ext_ptr, n_ext, scr_ptr, off_ptr, capacity,
             out.z, out.px, out.py, out.r, out.s, out.present,
             out.item_tx, out.item_input,
             out.item_sig, out.item_key, out.item_nsigs, out.item_nkeys,
@@ -391,7 +431,7 @@ class ParsedTxRegion:
             out.tx_coinbase, out.tx_unsupported,
         )
         if count < 0:
-            raise ValueError(f"txx_extract_h failed ({count})")
+            raise ValueError(f"txx_extract_h2 failed ({count})")
         # trim to the actual item count (views, no copies)
         out.count = int(count)
         for name in (
@@ -415,6 +455,7 @@ def extract_raw(
     bch: bool = False,
     intra_amounts: bool = True,
     ext_amounts: Optional[Sequence[int]] = None,
+    ext_scripts: Optional[Sequence[Optional[bytes]]] = None,
 ) -> RawSigItems:
     """Extract signature items from ``tx_count`` serialized transactions.
 
@@ -432,5 +473,6 @@ def extract_raw(
     """
     with ParsedTxRegion(data, tx_count) as region:
         return region.extract(
-            bch=bch, intra_amounts=intra_amounts, ext_amounts=ext_amounts
+            bch=bch, intra_amounts=intra_amounts, ext_amounts=ext_amounts,
+            ext_scripts=ext_scripts,
         )
