@@ -29,6 +29,7 @@ from tpunode.sighash import (
     bip143_sighash,
     bip341_sighash,
     legacy_sighash,
+    tapleaf_hash,
 )
 from tpunode.txverify import _hash160, _p2pkh_script_code
 from tpunode.util import Reader, double_sha256
@@ -217,7 +218,8 @@ _MIX = [
     (0.53, "p2sh-p2wpkh"),
     (0.65, "p2sh-msig"),
     (0.76, "p2wsh-msig"),
-    (0.96, "p2tr"),
+    (0.90, "p2tr"),
+    (0.96, "p2tr-script"),
     (1.01, "unsupported"),
 ]
 
@@ -273,14 +275,14 @@ def gen_mixed_txs(
     for t in range(count):
         roll = rng.random()
         kind = next(k for w, k in mix if roll < w)
-        if kind == "p2tr" and not taproot:
+        if kind in ("p2tr", "p2tr-script") and not taproot:
             kind = "p2wpkh"
         if schnorr_every and t % schnorr_every == schnorr_every - 1:
             kind = "p2pkh-schnorr"
         corrupt = invalid_every and t % invalid_every == invalid_every - 1
         # taproot kinds pin the synthetic prevout type; the rest avoid
         # P2TR-typed outpoints so the oracle's script can't reclassify them
-        want_tap = True if kind in ("p2tr", "unsupported") else False
+        want_tap = kind in ("p2tr", "p2tr-script", "unsupported")
         prevouts = tuple(outpoint(want_tap) for _ in range(inputs_per_tx))
         outputs = (TxOut(50_000 + t, out_script),)
         version = 2 if kind != "p2pkh" else 1
@@ -306,11 +308,31 @@ def gen_mixed_txs(
                    ))
             )
             continue
-        if kind == "p2tr":
+        if kind in ("p2tr", "p2tr-script"):
             amounts = [synth_amount(po.txid, po.index) for po in prevouts]
             scripts = [synth_prevout(po.txid, po.index)[1] for po in prevouts]
             wits = []
             for i, po in enumerate(prevouts):
+                if kind == "p2tr-script":
+                    # script path: the canonical single-key tapscript,
+                    # leaf key derived from the outpoint (distinct from
+                    # the output key), minimal control block
+                    leaf_priv = _synth_tap_priv(po.txid, po.index + 1000)
+                    LP = point_mul(leaf_priv, GENERATOR)
+                    leaf_script = b"\x20" + LP.x.to_bytes(32, "big") + b"\xac"
+                    control = b"\xc0" + scripts[i][2:34]
+                    digest = bip341_sighash(
+                        unsigned, i, amounts, scripts, 0x00,
+                        leaf_hash=tapleaf_hash(leaf_script),
+                    )
+                    r, s = sign_bip340(
+                        leaf_priv, digest, rng.getrandbits(256) % CURVE_N or 1
+                    )
+                    if corrupt and i == 0:
+                        s = (s + 1) % CURVE_N or 1
+                    sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+                    wits.append((sig, leaf_script, control))
+                    continue
                 digest = bip341_sighash(unsigned, i, amounts, scripts, 0x00)
                 r, s = sign_bip340(
                     _synth_tap_priv(po.txid, po.index),
@@ -450,7 +472,7 @@ def gen_chain(
             + (f"-w{segwit_every}" if segwit_every else "")
             # v2: taproot in the mix (r5) — the key must change with the
             # workload content or a stale cache silently survives
-            + (("-mixs2" if net.bch else "-mix2") if mix else "")
+            + (("-mixs3" if net.bch else "-mix3") if mix else "")
         )
         cache = f"{os.path.splitext(cache)[0]}-{key}.bin"
         path = cache_path(cache)

@@ -500,6 +500,11 @@ const TagMidstate &bip340_challenge_tag() {
   return t;
 }
 
+const TagMidstate &tap_leaf_tag() {
+  static const TagMidstate t("TapLeaf");
+  return t;
+}
+
 // Curve order n, big-endian — sighash digests are reduced mod n before
 // packing (parity with NativeVerifier.verify_batch's `z % CURVE_N`).
 const uint8_t N_BE[32] = {0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
@@ -1048,16 +1053,19 @@ struct TapTxHashes {
   bool pv = false, am = false, sp = false, sq = false, out = false;
 };
 
-// Keypath (ext_flag = 0) signature message -> out[32].  `annex` is the
-// full witness element (0x50-prefixed) or nullptr.  Requires
-// tp.have[...] resolution per the hash_type (caller checks); returns
-// false when the spend is structurally INVALID under BIP341 (bad
-// hash_type, SIGHASH_SINGLE with no matching output) — the caller emits
-// an auto-invalid item, not unsupported.
+// Signature message -> out[32]: keypath (ext_flag = 0) when `leaf_hash`
+// is nullptr; script path (ext_flag = 1, BIP342 extension: tapleaf hash
+// ∥ key_version 0 ∥ codesep 0xFFFFFFFF) otherwise.  `annex` is the full
+// witness element (0x50-prefixed) or nullptr.  Requires tp.have[...]
+// resolution per the hash_type (caller checks); returns false when the
+// spend is structurally INVALID under BIP341 (bad hash_type,
+// SIGHASH_SINGLE with no matching output) — the caller emits an
+// auto-invalid item, not unsupported.
 bool bip341_sighash(TxSpan &tx, size_t index, int hashtype,
                     const uint8_t *annex, size_t annex_len,
                     const TapPrevouts &tp, TapTxHashes &th,
-                    std::vector<uint8_t> &scratch, uint8_t out[32]) {
+                    std::vector<uint8_t> &scratch, uint8_t out[32],
+                    const uint8_t *leaf_hash = nullptr) {
   if (!valid_taproot_hashtype(hashtype)) return false;
   int base = hashtype & 3;
   bool acp = (hashtype & SIGHASH_ANYONECANPAY) != 0;
@@ -1121,7 +1129,8 @@ bool bip341_sighash(TxSpan &tx, size_t index, int hashtype,
     }
     buf.insert(buf.end(), th.outputs, th.outputs + 32);
   }
-  buf.push_back(annex != nullptr ? 1 : 0);  // spend_type: ext_flag 0
+  int ext_flag = leaf_hash != nullptr ? 1 : 0;
+  buf.push_back(uint8_t(ext_flag * 2 + (annex != nullptr ? 1 : 0)));
   const InSpan &in = tx.ins[index];
   if (acp) {
     buf.insert(buf.end(), in.prevout, in.prevout + 36);
@@ -1147,6 +1156,12 @@ bool bip341_sighash(TxSpan &tx, size_t index, int hashtype,
     sha256(tx.outs[index].start, tx.outs[index].len, oh);
     buf.insert(buf.end(), oh, oh + 32);
   }
+  if (leaf_hash != nullptr) {
+    // BIP342 extension: tapleaf ∥ key_version 0 ∥ codesep "none" sentinel
+    buf.insert(buf.end(), leaf_hash, leaf_hash + 32);
+    buf.push_back(0x00);
+    for (int k = 0; k < 4; ++k) buf.push_back(0xFF);
+  }
   Sha256 h;
   tagged_hash_init(h, tap_sighash_tag());
   uint8_t epoch = 0x00;
@@ -1154,6 +1169,17 @@ bool bip341_sighash(TxSpan &tx, size_t index, int hashtype,
   h.update(buf.data(), buf.size());
   h.final(out);
   return true;
+}
+
+// The canonical single-key tapscript: <32-byte x-only key> OP_CHECKSIG.
+bool is_single_key_tapscript(const uint8_t *s, uint32_t len) {
+  return len == 34 && s[0] == 0x20 && s[33] == 0xAC;
+}
+
+// BIP341 control block: leaf version 0xC0, internal key, 0-128 path nodes.
+bool valid_control_block(const uint8_t *cb, uint32_t len) {
+  return len >= 33 && len <= 33 + 128 * 32 && (len - 33) % 32 == 0 &&
+         (cb[0] & 0xFE) == 0xC0;
 }
 
 // Locate an output's scriptPubKey inside its raw span (value(8) +
@@ -1580,10 +1606,11 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
       bool have_amount = (got & 1) != 0;
 
       if (!bch && (got & 2) && is_p2tr_script(pscript, pscript_len)) {
-        // Taproot KEYPATH spend (mirror of txverify._taproot_item):
-        // witness = [sig] or [sig, annex]; >=2 non-annex elements is the
-        // script path (unsupported — this is a signature pre-verifier,
-        // not a tapscript interpreter).
+        // Taproot spend (mirror of txverify._taproot_item): KEYPATH
+        // witness = [sig] (+annex); SCRIPT path with the canonical
+        // single-key tapscript = [sig, <32B key> OP_CHECKSIG, control]
+        // (+annex).  Other tapscripts are unsupported — this is a
+        // signature pre-verifier, not a tapscript interpreter.
         uint32_t wn = in.wit_count;
         const uint8_t *annex = nullptr;
         size_t annex_len = 0;
@@ -1597,7 +1624,24 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
           annex_len = in.wit_len[wn - 1];
           --wn;
         }
-        if (wn != 1) {
+        uint8_t leaf_buf[32];
+        const uint8_t *leaf_hash = nullptr;
+        const uint8_t *key_ptr;  // 32-byte x-only key for this spend
+        if (wn == 1) {
+          key_ptr = pscript + 2;  // keypath: the output key
+        } else if (wn == 3 &&
+                   is_single_key_tapscript(in.wit[1], in.wit_len[1]) &&
+                   valid_control_block(in.wit[2], in.wit_len[2])) {
+          key_ptr = in.wit[1] + 1;  // the leaf's key
+          Sha256 lh;
+          tagged_hash_init(lh, tap_leaf_tag());
+          uint8_t hdr[2] = {uint8_t(in.wit[2][0] & 0xFE),
+                            uint8_t(in.wit_len[1])};
+          lh.update(hdr, 2);  // leaf version ∥ varstr length (34 < 0xFD)
+          lh.update(in.wit[1], in.wit_len[1]);
+          lh.final(leaf_buf);
+          leaf_hash = leaf_buf;
+        } else {
           ++unsupported;
           continue;
         }
@@ -1675,13 +1719,13 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
         }
         uint8_t digest[32];
         if (!bip341_sighash(tx, idx, hashtype, annex, annex_len, tap,
-                            taphash, scratch, digest)) {
+                            taphash, scratch, digest, leaf_hash)) {
           if (!emit_invalid(sig, sig + 32)) return -2;
           continue;
         }
         uint8_t pxb[32], pyb[32];
-        if (!lift_x(pscript + 2, pxb, pyb)) {
-          // off-curve output key: invalid spend
+        if (!lift_x(key_ptr, pxb, pyb)) {
+          // off-curve key: invalid spend
           if (!emit_invalid(sig, sig + 32)) return -2;
           continue;
         }

@@ -104,6 +104,7 @@ def test_rawbatch_roundtrip():
     assert verify_batch_cpu(raw.to_tuples()) == expect
 
 
+@pytest.mark.heavy  # device-kernel compile (pytest.ini tiers)
 def test_xla_kernel_mixed_with_other_algos():
     jax = pytest.importorskip("jax")
     del jax
@@ -130,6 +131,7 @@ def test_xla_kernel_mixed_with_other_algos():
     assert got == expect
 
 
+@pytest.mark.heavy  # device-kernel compile (pytest.ini tiers)
 def test_pallas_interpret():
     jax = pytest.importorskip("jax")
     import jax.numpy as jnp
@@ -281,6 +283,7 @@ def test_vectors_native_cpp():
     assert nv.verify_batch(items) == expect
 
 
+@pytest.mark.heavy  # device-kernel compile (pytest.ini tiers)
 def test_vectors_xla_kernel():
     jax = pytest.importorskip("jax")
     del jax
@@ -290,6 +293,7 @@ def test_vectors_xla_kernel():
     assert verify_batch_tpu(items, pad_to=32) == expect
 
 
+@pytest.mark.heavy  # device-kernel compile (pytest.ini tiers)
 def test_vectors_pallas_interpret():
     jax = pytest.importorskip("jax")
     import jax.numpy as jnp

@@ -3,6 +3,7 @@ import random
 
 import pytest
 
+
 from tpunode.metrics import metrics
 from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign
 from tpunode.verify.engine import VerifyConfig, VerifyEngine

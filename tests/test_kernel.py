@@ -5,6 +5,8 @@ import random
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy tier (pytest.ini)
+
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp
 

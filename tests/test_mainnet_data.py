@@ -1,0 +1,87 @@
+"""Real mainnet bytes through the full parse/hash/extract stack.
+
+Zero-egress constraint (BASELINE.md): the only real-chain bytes available
+on this box are the famous public constants.  The Bitcoin mainnet genesis
+block (285 raw bytes, committed at tests/data/mainnet_genesis_block.hex)
+is real network data whose header hash, merkle root, and coinbase txid
+are pinned by the chain itself — a fabrication or a codec bug cannot
+reproduce 0x000000000019d668... by accident.  This validates wire
+serialization, txid/merkle computation, header consensus constants
+(params), and extraction stats against REAL bytes rather than
+self-generated ones (VERDICT r4 item 9's intent; signature-bearing real
+txs would need network access, so the Schnorr/ECDSA ground truth comes
+from the official BIP340 vectors in tests/test_bip340.py instead).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tpunode.headers import genesis_node
+from tpunode.params import BTC
+from tpunode.txverify import extract_sig_items
+from tpunode.util import Reader
+from tpunode.wire import Block, build_merkle_root
+
+GENESIS_HASH = bytes.fromhex(
+    "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+)[::-1]
+GENESIS_COINBASE_TXID = bytes.fromhex(
+    "4a5e1e4baab89f3a32518a88c31bc87f618f76673e2cc77ab2127b7afdeda33b"
+)[::-1]
+
+
+def _raw() -> bytes:
+    path = os.path.join(
+        os.path.dirname(__file__), "data", "mainnet_genesis_block.hex"
+    )
+    return bytes.fromhex(open(path).read().strip())
+
+
+def test_genesis_block_parses_and_hashes():
+    raw = _raw()
+    blk = Block.deserialize(Reader(raw))
+    assert blk.header.hash == GENESIS_HASH
+    assert len(blk.txs) == 1
+    assert blk.txs[0].txid == GENESIS_COINBASE_TXID
+    assert blk.header.merkle == GENESIS_COINBASE_TXID
+    assert build_merkle_root([t.txid for t in blk.txs]) == blk.header.merkle
+    # byte-exact round trip through our serializer
+    assert blk.serialize() == raw
+    # the embedded Times headline is in the coinbase scriptSig
+    assert b"Chancellor on brink of second bailout" in blk.txs[0].inputs[0].script
+
+
+def test_genesis_matches_params_and_headers():
+    blk = Block.deserialize(Reader(_raw()))
+    g = BTC.genesis
+    hdr = blk.header
+    assert (hdr.version, hdr.merkle, hdr.timestamp, hdr.bits, hdr.nonce) == (
+        g.version, g.merkle, g.timestamp, g.bits, g.nonce
+    )
+    node = genesis_node(BTC)
+    assert node.header.hash == GENESIS_HASH
+    assert node.height == 0
+
+
+def test_genesis_coinbase_extraction_stats():
+    blk = Block.deserialize(Reader(_raw()))
+    items, stats = extract_sig_items(blk.txs[0])
+    assert items == []
+    assert stats.coinbase == 1 and stats.total_inputs == 1
+    assert stats.extracted == 0 and stats.unsupported == 0
+    assert stats.coverage == 1.0  # coinbase-only tx: nothing to cover
+
+
+def test_genesis_native_parity():
+    import pytest
+
+    txextract = pytest.importorskip("tpunode.txextract")
+    if not txextract.have_native_extract():  # pragma: no cover
+        pytest.skip("native txextract unavailable")
+    blk = Block.deserialize(Reader(_raw()))
+    out = txextract.extract_raw(blk.raw_txs, 1)
+    assert out.count == 0 and out.n_txs == 1
+    assert out.txid(0) == GENESIS_COINBASE_TXID
+    st = out.stats(0)
+    assert st.coinbase == 1 and st.total_inputs == 1

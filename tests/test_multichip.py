@@ -5,6 +5,8 @@ import random
 
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy tier (pytest.ini)
+
 jax = pytest.importorskip("jax")
 
 from tpunode.verify.ecdsa_cpu import CURVE_N, GENERATOR, point_mul, sign, verify

@@ -12,6 +12,8 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.heavy  # compile-heavy tier (pytest.ini)
+
 from tpunode.verify import field as F
 from tpunode.verify import pallas_field as PF
 from tpunode.verify.ecdsa_cpu import (

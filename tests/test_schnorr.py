@@ -192,6 +192,7 @@ def test_rawbatch_roundtrip_preserves_algo():
 # --- device kernels (cpu-jax XLA; pallas interpret) ------------------------
 
 
+@pytest.mark.heavy  # device-kernel compile (pytest.ini tiers)
 def test_xla_kernel_mixed_batch():
     jax = pytest.importorskip("jax")
     del jax
@@ -219,6 +220,7 @@ def test_native_prep_parity_with_python_prep():
     assert np.asarray(a.schnorr).sum() > 0
 
 
+@pytest.mark.heavy  # device-kernel compile (pytest.ini tiers)
 def test_pallas_interpret_mixed_batch():
     jax = pytest.importorskip("jax")
     import jax.numpy as jnp

@@ -344,6 +344,115 @@ def test_native_parity_on_invalid_and_annex_shapes():
         )
 
 
+def make_scriptpath_spend(leaf_privs, annexes=None, out_priv: int = 999):
+    """A tx spending P2TR prevouts via the canonical single-key tapscript
+    (script path, BIP342); returns (tx, amounts, scripts, leaf_scripts)."""
+    import dataclasses as _dc
+
+    from tpunode.sighash import tapleaf_hash
+
+    n = len(leaf_privs)
+    annexes = annexes or [None] * n
+    inputs = tuple(
+        TxIn(OutPoint(bytes([0x30 + i]) * 32, i), b"", 0xFFFFFFFE)
+        for i in range(n)
+    )
+    outputs = (TxOut(70_000, b"\x00\x14" + b"\x09" * 20),)
+    tx = Tx(2, inputs, outputs, 0, witnesses=tuple(() for _ in range(n)))
+    amounts = {i: 200_000 + i for i in range(n)}
+    scripts = {i: p2tr_script(out_priv) for i in range(n)}
+    leaf_scripts = []
+    wits = []
+    for i, lp in enumerate(leaf_privs):
+        LP = point_mul(lp, GENERATOR)
+        leaf = b"\x20" + LP.x.to_bytes(32, "big") + b"\xac"
+        leaf_scripts.append(leaf)
+        control = b"\xc0" + scripts[i][2:34] + b"\x11" * 32  # one path node
+        digest = bip341_sighash(
+            tx, i,
+            [amounts[j] for j in range(n)],
+            [scripts[j] for j in range(n)],
+            0x00, annexes[i], tapleaf_hash(leaf),
+        )
+        from tpunode.verify.ecdsa_cpu import sign_bip340 as _sign
+
+        r, s = _sign(lp, digest, nonce=0x5C0 + i)
+        stack = [r.to_bytes(32, "big") + s.to_bytes(32, "big"), leaf, control]
+        if annexes[i] is not None:
+            stack.append(annexes[i])
+        wits.append(tuple(stack))
+    return _dc.replace(tx, witnesses=tuple(wits)), amounts, scripts, leaf_scripts
+
+
+def test_scriptpath_single_key_tapscript_extracts_and_verifies():
+    tx, amounts, scripts, leaves = make_scriptpath_spend([401, 402])
+    items, stats, per_sig = run_extract(tx, amounts, scripts)
+    assert stats.extracted == 2 and stats.unsupported == 0
+    assert [i.algo for i in items] == ["bip340", "bip340"]
+    # items verify against the LEAF keys, not the output key
+    for it, leaf in zip(items, leaves):
+        assert it.pubkey.x == int.from_bytes(leaf[1:33], "big")
+    assert per_sig == [True, True]
+
+
+def test_scriptpath_commits_to_the_leaf():
+    """A signature over the KEYPATH digest presented via the script path
+    must fail: the BIP342 extension (tapleaf hash) changes the digest."""
+    tx, amounts, scripts, leaves = make_scriptpath_spend([411])
+    keypath_digest = bip341_sighash(
+        tx, 0, [amounts[0]], [scripts[0]], 0x00
+    )
+    r, s = sign_bip340(411, keypath_digest, nonce=0x123)
+    wit = (r.to_bytes(32, "big") + s.to_bytes(32, "big"),
+           tx.witnesses[0][1], tx.witnesses[0][2])
+    tx2 = dataclasses.replace(tx, witnesses=(wit,))
+    _, stats, per_sig = run_extract(tx2, amounts, scripts)
+    assert stats.extracted == 1 and per_sig == [False]
+
+
+def test_scriptpath_with_annex_and_native_parity():
+    import pytest as _pytest
+
+    annex = b"\x50\xaa\xbb"
+    tx, amounts, scripts, _ = make_scriptpath_spend(
+        [421, 422], annexes=[annex, None]
+    )
+    items, stats, per_sig = run_extract(tx, amounts, scripts)
+    assert stats.extracted == 2 and per_sig == [True, True]
+    txextract = _pytest.importorskip("tpunode.txextract")
+    if txextract.have_native_extract():
+        out = txextract.extract_raw(
+            tx.serialize(), 1,
+            ext_amounts=[amounts[0], amounts[1]],
+            ext_scripts=[scripts[0], scripts[1]],
+        )
+        assert out.present.tolist() == [3, 3]
+        for ni, pi in zip(out.to_verify_items(), items):
+            assert ni == pi.verify_item
+        assert verify_batch_cpu(out.to_verify_items()) == [True, True]
+
+
+def test_scriptpath_rejects_noncanonical_shapes():
+    """Non-single-key tapscripts and malformed control blocks are
+    unsupported (not invalid): the engine doesn't run tapscript."""
+    tx, amounts, scripts, _ = make_scriptpath_spend([431])
+    sig, leaf, control = tx.witnesses[0]
+    bad_shapes = [
+        (sig, b"\x51", control),                      # script: OP_1
+        (sig, leaf + b"\x00", control),               # 35-byte script
+        (sig, leaf, control[:32]),                    # control too short
+        (sig, leaf, control + b"\x00"),               # not 33+32k
+        (sig, leaf, b"\xa0" + control[1:]),           # wrong leaf version
+        (sig, b"x", leaf, control),                   # 4 elements
+    ]
+    for wit in bad_shapes:
+        t2 = dataclasses.replace(tx, witnesses=(tuple(wit),))
+        items, stats = extract_sig_items(
+            t2, prevout_amounts=amounts, prevout_scripts=scripts
+        )
+        assert stats.unsupported == 1 and not items, wit[1][:8]
+
+
 def test_mixed_legacy_plus_taproot_inputs_extract():
     """A tx with BOTH a taproot keypath input and a legacy no-witness
     P2PKH input: the BIP341 digest needs the LEGACY sibling's prevout

@@ -68,12 +68,16 @@ from .peermgr import (
     to_sock_addr,
 )
 from .store import LogKV, MemoryKV, Namespaced, open_store
+from .sighash import bip143_sighash, bip341_sighash, legacy_sighash
 from .txverify import (
     ExtractStats,
     SigItem,
     combine_verdicts,
     extract_sig_items,
+    intra_block_prevouts,
+    is_p2tr,
     msig_match,
+    wants_amount,
 )
 from .wire import (
     Block,

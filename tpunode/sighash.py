@@ -35,6 +35,7 @@ __all__ = [
     "legacy_sighash",
     "bip143_sighash",
     "bip341_sighash",
+    "tapleaf_hash",
     "valid_taproot_hashtype",
 ]
 
@@ -146,6 +147,14 @@ def valid_taproot_hashtype(hashtype: int) -> bool:
     return hashtype in (0x00, 0x01, 0x02, 0x03, 0x81, 0x82, 0x83)
 
 
+def tapleaf_hash(script: bytes, leaf_version: int = 0xC0) -> bytes:
+    """BIP341 TapLeaf hash: tagged_hash("TapLeaf", version ∥ varstr(script))
+    — the script-path sighash (BIP342) commits to the executed leaf."""
+    return _tagged_hash(
+        b"TapLeaf", bytes([leaf_version]) + write_varstr(script)
+    )
+
+
 def bip341_sighash(
     tx: Tx,
     index: int,
@@ -153,9 +162,13 @@ def bip341_sighash(
     scripts: Sequence[bytes],
     hashtype: int = SIGHASH_DEFAULT,
     annex: Optional[bytes] = None,
+    leaf_hash: Optional[bytes] = None,
 ) -> Optional[int]:
-    """Taproot (segwit v1) signature message for a KEYPATH spend
-    (``ext_flag = 0``), per BIP341's SigMsg.
+    """Taproot (segwit v1) signature message, per BIP341's SigMsg:
+    KEYPATH (``ext_flag = 0``) when ``leaf_hash`` is None, SCRIPT-path
+    (``ext_flag = 1``, BIP342 extension: tapleaf hash ∥ key_version 0 ∥
+    codesep position 0xFFFFFFFF) when the executed leaf's
+    :func:`tapleaf_hash` is supplied.
 
     ``amounts``/``scripts`` are the spent outputs' values and
     scriptPubKeys for ALL of ``tx``'s inputs, in input order (with
@@ -197,8 +210,8 @@ def bip341_sighash(
         msg += hashlib.sha256(
             b"".join(o.serialize() for o in tx.outputs)
         ).digest()
-    spend_type = 1 if annex is not None else 0  # ext_flag 0 (keypath)
-    msg.append(spend_type)
+    ext_flag = 0 if leaf_hash is None else 1
+    msg.append(ext_flag * 2 + (1 if annex is not None else 0))  # spend_type
     txin = tx.inputs[index]
     if anyonecanpay:
         msg += txin.prevout.serialize()
@@ -211,6 +224,13 @@ def bip341_sighash(
         msg += hashlib.sha256(write_varstr(annex)).digest()
     if base == SIGHASH_SINGLE:
         msg += hashlib.sha256(tx.outputs[index].serialize()).digest()
+    if leaf_hash is not None:
+        # BIP342 sighash extension (key_version 0; no OP_CODESEPARATOR in
+        # the templates this engine extracts, so the position is the
+        # "none executed" sentinel)
+        msg += leaf_hash
+        msg.append(0x00)
+        msg += (0xFFFFFFFF).to_bytes(4, "little")
     return int.from_bytes(
         _tagged_hash(b"TapSighash", b"\x00" + bytes(msg)), "big"
     )

@@ -41,6 +41,7 @@ from .sighash import (
     bip143_sighash,
     bip341_sighash,
     legacy_sighash,
+    tapleaf_hash,
 )
 from .verify.ecdsa_cpu import (
     Point,
@@ -60,6 +61,7 @@ __all__ = [
     "intra_block_prevouts",
     "wants_amount",
     "is_p2tr",
+    "is_single_key_tapscript",
     "combine_verdicts",
     "msig_match",
 ]
@@ -323,6 +325,22 @@ def extract_sig_items(
     return items, stats
 
 
+def is_single_key_tapscript(script: bytes) -> bool:
+    """The canonical single-key tapscript: ``<32-byte x-only key>
+    OP_CHECKSIG`` (the standard script-path leaf shape)."""
+    return len(script) == 34 and script[0] == 0x20 and script[33] == 0xAC
+
+
+def _valid_control_block(cb: bytes) -> bool:
+    """BIP341 control block: leaf version 0xC0 (the only defined tapscript
+    version), internal key, 0-128 merkle path nodes."""
+    return (
+        33 <= len(cb) <= 33 + 128 * 32
+        and (len(cb) - 33) % 32 == 0
+        and (cb[0] & 0xFE) == 0xC0
+    )
+
+
 def _taproot_item(
     tx: Tx,
     idx: int,
@@ -331,24 +349,41 @@ def _taproot_item(
     prevout_amounts: Optional[dict[int, int]],
     prevout_scripts: Optional[dict[int, bytes]],
 ) -> Optional[list[SigItem]]:
-    """One "bip340" item for a taproot KEYPATH spend, or None when the
-    input can't be handled (script path, or missing prevout info).
+    """One "bip340" item for a taproot spend, or None when the input
+    can't be handled (unsupported tapscript, or missing prevout info).
 
-    Keypath witness shape (after peeling the optional annex): exactly one
-    element, a 64-byte (SIGHASH_DEFAULT) or 65-byte (explicit hash_type)
-    BIP340 signature.  Consensus-invalid shapes (bad sig length, invalid
-    hash_type, SIGHASH_SINGLE with no matching output, off-curve output
-    key) yield an AUTO-INVALID item — the spend is invalid, not
-    unsupported.  A >=2-element witness is the script path: unsupported
-    (this engine is a signature pre-verifier, not a tapscript
-    interpreter)."""
+    KEYPATH (after peeling the optional annex, exactly one witness
+    element): a 64-byte (SIGHASH_DEFAULT) or 65-byte (explicit hash_type)
+    BIP340 signature over the BIP341 digest, key = the output key from
+    the prevout script.  SCRIPT path with the canonical single-key
+    tapscript (witness ``[sig, <32B-key> OP_CHECKSIG, control]``): the
+    BIP342 digest (ext_flag 1, tapleaf hash), key = the leaf's x-only
+    key.  Like every template here, signatures are verified — script
+    EXECUTION and the merkle commitment of the leaf to the output key
+    are not (same scope as P2SH, where the redeem-script hash is not
+    checked; this is a signature pre-verifier).  Other tapscripts are
+    unsupported.
+
+    Consensus-invalid shapes (bad sig length, invalid hash_type,
+    SIGHASH_SINGLE with no matching output, off-curve key) yield an
+    AUTO-INVALID item — the spend is invalid, not unsupported."""
     annex: Optional[bytes] = None
     if len(wit) >= 2 and len(wit[-1]) >= 1 and wit[-1][0] == 0x50:
         annex = wit[-1]
         wit = wit[:-1]
-    if len(wit) != 1:
-        return None  # script path (or empty witness): unsupported
     txid = tx.txid
+    leaf_hash: Optional[bytes] = None
+    if len(wit) == 1:
+        key_x = int.from_bytes(pscript[2:34], "big")  # keypath: output key
+    elif (
+        len(wit) == 3
+        and is_single_key_tapscript(wit[1])
+        and _valid_control_block(wit[2])
+    ):
+        key_x = int.from_bytes(wit[1][1:33], "big")  # leaf key
+        leaf_hash = tapleaf_hash(wit[1], wit[2][0] & 0xFE)
+    else:
+        return None  # other tapscript shapes: unsupported
     sig_blob = wit[0]
 
     def invalid(r: int = 0, s: int = 0) -> list[SigItem]:
@@ -374,12 +409,14 @@ def _taproot_item(
     n_in = len(tx.inputs)
     amounts = [prevout_amounts.get(i, 0) for i in range(n_in)]
     scripts = [prevout_scripts.get(i, b"") for i in range(n_in)]
-    digest = bip341_sighash(tx, idx, amounts, scripts, hashtype, annex)
+    digest = bip341_sighash(
+        tx, idx, amounts, scripts, hashtype, annex, leaf_hash
+    )
     if digest is None:
         return invalid(r, s)
-    pub = lift_x(int.from_bytes(pscript[2:34], "big"))
+    pub = lift_x(key_x)
     if pub is None:
-        return invalid(r, s)  # off-curve output key: invalid spend
+        return invalid(r, s)  # off-curve key: invalid spend
     e = bip340_challenge(r, pub.x, digest)
     return [SigItem(pub, e, r, s, txid, idx, algo="bip340")]
 

@@ -306,12 +306,19 @@ class VerifyEngine:
                 if self._device_state == "ready"
                 else self.cfg.batch_size
             )
+            # Event-driven fill (VERDICT r4 weak #6 — the former 2 ms poll
+            # burned ≤500 wakes/s per linger window): sleep until either a
+            # new enqueue kicks, or the linger deadline passes.
             deadline = time.monotonic() + self.cfg.max_wait
-            while (
-                sum(len(i) for i, _ in self._queue) < target
-                and time.monotonic() < deadline
-            ):
-                await asyncio.sleep(0.002)
+            while sum(len(i) for i, _ in self._queue) < target:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                try:
+                    await asyncio.wait_for(self._kick.wait(), timeout=remain)
+                except asyncio.TimeoutError:
+                    break
+                self._kick.clear()
             while self._queue:
                 batch: list[tuple[object, asyncio.Future]] = []
                 total = 0
