@@ -1229,6 +1229,32 @@ bool decode_pubkey_cached(PubkeyCache &cache, const uint8_t *data, size_t len,
   return true;
 }
 
+// lift_x through a bounded cache of ITS OWN (a field sqrt per call; real
+// taproot workloads reuse output/leaf keys through address reuse).  The
+// cache object must be separate from the SEC1 decode cache: any in-band
+// namespace tag can be forged by an attacker-controlled scriptSig pubkey
+// blob of the right shape, poisoning one lane's entries with the other's
+// verdicts (review r5 finding, confirmed by repro).
+bool lift_x_cached(PubkeyCache &cache, const uint8_t x32[32], uint8_t px[32],
+                   uint8_t py[32]) {
+  if (cache.size() >= PUBKEY_CACHE_MAX) return lift_x(x32, px, py);
+  std::string key(reinterpret_cast<const char *>(x32), 32);
+  auto it = cache.find(key);
+  if (it == cache.end()) {
+    PubkeyEntry e;
+    e.ok = lift_x(x32, e.px, e.py);
+    if (!e.ok) {
+      memset(e.px, 0, 32);
+      memset(e.py, 0, 32);
+    }
+    it = cache.emplace(std::move(key), e).first;
+  }
+  if (!it->second.ok) return false;
+  memcpy(px, it->second.px, 32);
+  memcpy(py, it->second.py, 32);
+  return true;
+}
+
 // ---------------------------------------------------------------------------
 // Intra-block prevout amount map: (txid, vout) -> satoshis.
 // ---------------------------------------------------------------------------
@@ -1577,7 +1603,9 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
   static const uint8_t ZERO_TXID[32] = {0};
   std::vector<uint8_t> scratch;
   scratch.reserve(4096);
-  PubkeyCache pubcache;
+  PubkeyCache pubcache;   // SEC1 decode results, keyed by raw blob
+  PubkeyCache liftcache;  // x-only lift results, keyed by x32 — separate
+                          // object, so no cross-lane key collisions exist
   long item = 0;
   long flat_input = 0;  // index into ext_amounts / ext_script_off
   for (size_t ti = 0; ti < txs.size(); ++ti) {
@@ -1724,7 +1752,7 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
           continue;
         }
         uint8_t pxb[32], pyb[32];
-        if (!lift_x(key_ptr, pxb, pyb)) {
+        if (!lift_x_cached(liftcache, key_ptr, pxb, pyb)) {
           // off-curve key: invalid spend
           if (!emit_invalid(sig, sig + 32)) return -2;
           continue;

@@ -145,6 +145,36 @@ def _mutations(rng: random.Random, base: bytes):
     yield bytes(b)
 
 
+def test_differential_fuzz_taproot_witness_targeted():
+    """Taproot-focused mutations: flip bytes specifically inside the
+    WITNESS region (sig lengths, annex prefix, control-block bytes,
+    tapscript opcodes) of keypath and script-path spends — the area where
+    the two extractors' newest branch logic lives."""
+    rng = random.Random(0x7A9F)
+    txs = gen_mixed_txs(
+        16, seed=0x7A90,
+        mix=[(0.4, "p2tr"), (0.8, "p2tr-script"), (1.01, "unsupported")],
+    )
+    outcomes = {"both-accept": 0, "both-reject": 0}
+    for tx in txs:
+        base = tx.serialize()
+        # witness region sits between the outputs and the 4-byte locktime;
+        # its size = full - nonwitness - marker/flag(2)
+        wit_len = len(base) - len(tx.serialize(include_witness=False)) - 2
+        assert wit_len > 0  # every tx in this mix carries a witness
+        lo, hi = len(base) - 4 - wit_len, len(base) - 4
+        outcomes[_compare(base, 1, False)] += 1
+        for _ in range(10):
+            b = bytearray(base)
+            b[rng.randrange(lo, hi)] ^= 1 << rng.randrange(8)
+            outcomes[_compare(bytes(b), 1, False)] += 1
+        for v in (0x50, 0xC0, 0xC1, 0x20, 0xAC, 0x00, 0x40, 0x41):
+            b = bytearray(base)
+            b[rng.randrange(lo, hi)] = v
+            outcomes[_compare(bytes(b), 1, False)] += 1
+    assert outcomes["both-accept"] > 20, outcomes
+
+
 @pytest.mark.parametrize("bch", [False, True])
 def test_differential_fuzz_single_tx(bch):
     rng = random.Random(0xF522 + bch)

@@ -453,6 +453,54 @@ def test_scriptpath_rejects_noncanonical_shapes():
         assert stats.unsupported == 1 and not items, wit[1][:8]
 
 
+def test_native_cache_lanes_cannot_cross_poison():
+    """A scriptSig "pubkey" blob of 0x01||X (attacker-controlled, fails
+    SEC1 decode) must not poison the taproot lift of the on-curve x-only
+    key X — and vice versa.  Review r5 finding: an in-band namespace tag
+    in a shared cache was forgeable; the caches are now separate objects."""
+    import pytest as _pytest
+
+    from benchmarks.txgen import _der
+    from tpunode.verify.ecdsa_cpu import sign as ecdsa_sign
+
+    txextract = _pytest.importorskip("tpunode.txextract")
+    if not txextract.have_native_extract():  # pragma: no cover
+        _pytest.skip("native txextract unavailable")
+    priv = 505
+    X = point_mul(priv, GENERATOR).x
+    fake_pub = b"\x01" + X.to_bytes(32, "big")  # P2PKH-shaped, undecodable
+    r0, s0 = ecdsa_sign(7, 0x1234, 0x777)
+    sig0 = _der(r0, s0) + b"\x01"
+    script_sig = bytes([len(sig0)]) + sig0 + bytes([len(fake_pub)]) + fake_pub
+    inputs = (
+        TxIn(OutPoint(b"\x41" * 32, 0), script_sig, 0xFFFFFFFF),
+        TxIn(OutPoint(b"\x42" * 32, 1), b"", 0xFFFFFFFF),
+    )
+    outputs = (TxOut(10, b"\x51"),)
+    tx = Tx(2, inputs, outputs, 0, witnesses=((), ()))
+    amounts = {0: 1000, 1: 2000}
+    scripts = {0: b"\x51", 1: b"\x51\x20" + X.to_bytes(32, "big")}
+    digest = bip341_sighash(
+        tx, 1, [amounts[0], amounts[1]], [scripts[0], scripts[1]], 0x00
+    )
+    r, s = sign_bip340(priv, digest, nonce=0x505)
+    tx = dataclasses.replace(
+        tx, witnesses=((), (r.to_bytes(32, "big") + s.to_bytes(32, "big"),))
+    )
+    py_items, _ = extract_sig_items(
+        tx, prevout_amounts=amounts, prevout_scripts=scripts
+    )
+    py_verdicts = verify_batch_cpu([i.verify_item for i in py_items])
+    assert py_verdicts == [False, True]  # fake pub auto-invalid; taproot OK
+    out = txextract.extract_raw(
+        tx.serialize(), 1,
+        ext_amounts=[amounts[0], amounts[1]],
+        ext_scripts=[scripts[0], scripts[1]],
+    )
+    assert out.present.tolist() == [0, 3]
+    assert verify_batch_cpu(out.to_verify_items()) == [False, True]
+
+
 def test_mixed_legacy_plus_taproot_inputs_extract():
     """A tx with BOTH a taproot keypath input and a legacy no-witness
     P2PKH input: the BIP341 digest needs the LEGACY sibling's prevout
