@@ -614,6 +614,85 @@ async def test_node_end_to_end_taproot_mempool():
         assert ev.stats.extracted == len(tx.inputs)
 
 
+@pytest.mark.asyncio
+async def test_node_block_ingest_intra_block_taproot_spend():
+    """A block where tx A creates a P2TR output and tx B key-spends it:
+    the spend's (amount, script) resolve from the INTRA-BLOCK map (the
+    C++ out_script lane — no oracle involved), through the full node's
+    native lazy-block ingest on BTC regtest."""
+    import asyncio
+
+    import tpunode.node as node_mod
+    from tests.fakenet import dummy_peer_connect
+    from tests.fixtures import all_blocks
+    from tpunode import PeerConnected
+    from tpunode.actors import Publisher
+    from tpunode.node import Node, NodeConfig, TxVerdict
+    from tpunode.params import BTC_REGTEST
+    from tpunode.peer import PeerMessage
+    from tpunode.store import MemoryKV
+    from tpunode.util import Reader
+    from tpunode.verify.engine import VerifyConfig
+    from tpunode.wire import Block, BlockHeader, MsgBlock
+
+    if not node_mod._native_extract_available():
+        pytest.skip("native extractor unavailable")
+    priv_a, priv_t = 601, 602
+    # tx A: funds a P2TR output for priv_t (inputs are unsupported shapes
+    # — only its OUTPUT matters here)
+    tx_a = Tx(
+        2,
+        (TxIn(OutPoint(b"\x61" * 32, 0), b"\x51", 0xFFFFFFFF),),
+        (TxOut(123_456, p2tr_script(priv_t)),
+         TxOut(5_000, b"\x00\x14" + b"\x01" * 20)),
+        0,
+    )
+    del priv_a
+    # tx B: key-spends tx A's output 0 (same block)
+    inputs = (TxIn(OutPoint(tx_a.txid, 0), b"", 0xFFFFFFFF),)
+    outputs = (TxOut(100_000, b"\x00\x14" + b"\x02" * 20),)
+    tx_b = Tx(2, inputs, outputs, 0, witnesses=((),))
+    digest = bip341_sighash(
+        tx_b, 0, [123_456], [p2tr_script(priv_t)], 0x00
+    )
+    r, s = sign_bip340(priv_t, digest, nonce=0x601)
+    tx_b = dataclasses.replace(
+        tx_b, witnesses=((r.to_bytes(32, "big") + s.to_bytes(32, "big"),),)
+    )
+    hdr = BlockHeader(1, b"\x00" * 32, b"\x00" * 32, 0, 0x207FFFFF, 0)
+    raw_block = Block(hdr, (tx_a, tx_b)).serialize()
+    msg = MsgBlock.deserialize_payload(Reader(raw_block))
+
+    pub = Publisher(name="tap-block")
+    cfg = NodeConfig(
+        net=BTC_REGTEST,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:18444"],
+        connect=lambda sa: dummy_peer_connect(BTC_REGTEST, all_blocks()),
+        verify=VerifyConfig(backend="cpu", max_wait=0.0),
+        # NO oracle: everything must come from the intra-block map
+        prevout_lookup=None,
+    )
+    got = {}
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(20):
+                peer = await events.receive_match(
+                    lambda ev: ev.peer if isinstance(ev, PeerConnected) else None
+                )
+                node._peer_pub.publish(PeerMessage(peer, msg))
+                while len(got) < 2:
+                    ev = await events.receive()
+                    if isinstance(ev, TxVerdict):
+                        got[ev.txid] = ev
+    ev_b = got[tx_b.txid]
+    assert ev_b.error is None and ev_b.valid
+    assert len(ev_b.verdicts) == 1 and ev_b.stats.extracted == 1
+    # tx A's garbage input is unsupported, not a failure
+    assert got[tx_a.txid].stats.unsupported == 1
+
+
 def test_taproot_heavy_mix_coverage():
     """Coverage >= 0.95 on a taproot-dominated mix with the extended
     oracle (VERDICT r4 item 3 acceptance), through the NATIVE path with
