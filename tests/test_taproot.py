@@ -615,14 +615,32 @@ async def test_node_end_to_end_taproot_mempool():
 
 
 @pytest.mark.asyncio
-async def test_node_block_ingest_intra_block_taproot_spend():
+@pytest.mark.parametrize("use_native", [True, False])
+async def test_node_block_ingest_intra_block_taproot_spend(
+    use_native, monkeypatch
+):
     """A block where tx A creates a P2TR output and tx B key-spends it:
     the spend's (amount, script) resolve from the INTRA-BLOCK map (the
-    C++ out_script lane — no oracle involved), through the full node's
-    native lazy-block ingest on BTC regtest."""
+    C++ out_script lane / the Python intra_block_prevouts dict — no
+    oracle involved), through the full node's lazy-block ingest on BTC
+    regtest.  Both ingest paths must agree."""
     import asyncio
 
     import tpunode.node as node_mod
+
+    if not use_native:
+        monkeypatch.setattr(node_mod, "_native_extract_state", False)
+    elif not node_mod._native_extract_available():
+        pytest.skip("native extractor unavailable")
+    # guard the "both paths" claim: count which lane actually ran
+    lane_calls = {"native": 0}
+    orig_native = node_mod.Node._verify_txs_native
+
+    def counting_native(self, *a, **k):
+        lane_calls["native"] += 1
+        return orig_native(self, *a, **k)
+
+    monkeypatch.setattr(node_mod.Node, "_verify_txs_native", counting_native)
     from tests.fakenet import dummy_peer_connect
     from tests.fixtures import all_blocks
     from tpunode import PeerConnected
@@ -635,9 +653,7 @@ async def test_node_block_ingest_intra_block_taproot_spend():
     from tpunode.verify.engine import VerifyConfig
     from tpunode.wire import Block, BlockHeader, MsgBlock
 
-    if not node_mod._native_extract_available():
-        pytest.skip("native extractor unavailable")
-    priv_a, priv_t = 601, 602
+    priv_t = 602
     # tx A: funds a P2TR output for priv_t (inputs are unsupported shapes
     # — only its OUTPUT matters here)
     tx_a = Tx(
@@ -647,7 +663,6 @@ async def test_node_block_ingest_intra_block_taproot_spend():
          TxOut(5_000, b"\x00\x14" + b"\x01" * 20)),
         0,
     )
-    del priv_a
     # tx B: key-spends tx A's output 0 (same block)
     inputs = (TxIn(OutPoint(tx_a.txid, 0), b"", 0xFFFFFFFF),)
     outputs = (TxOut(100_000, b"\x00\x14" + b"\x02" * 20),)
@@ -691,6 +706,8 @@ async def test_node_block_ingest_intra_block_taproot_spend():
     assert len(ev_b.verdicts) == 1 and ev_b.stats.extracted == 1
     # tx A's garbage input is unsupported, not a failure
     assert got[tx_a.txid].stats.unsupported == 1
+    # the parametrized lane is the lane that ran
+    assert (lane_calls["native"] > 0) == use_native
 
 
 def test_taproot_heavy_mix_coverage():
