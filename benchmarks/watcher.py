@@ -80,6 +80,10 @@ def probe() -> dict:
     return _run_json([sys.executable, "bench.py", "--probe"], PROBE_TIMEOUT)
 
 
+class FatalMismatch(RuntimeError):
+    """Device/oracle verdict mismatch observed by the watcher."""
+
+
 def run_headline() -> dict | None:
     """Pallas ladder, 32768 first.  Returns the successful worker dict,
     or raises FatalMismatch on a device/oracle verdict mismatch."""
@@ -107,10 +111,6 @@ def run_headline() -> dict | None:
             _record("fatal", {"error": res.get("error")})
             raise FatalMismatch(res.get("error", "verdict mismatch"))
     return None
-
-
-class FatalMismatch(RuntimeError):
-    """Device/oracle verdict mismatch observed by the watcher."""
 
 
 def run_config(name: str) -> dict | None:

@@ -267,6 +267,65 @@ def test_mixed_tx_taproot_plus_p2wpkh():
     assert per_sig == [True, True]
 
 
+def test_bip341_digest_independence_properties():
+    """Spec properties of the BIP341 message, checked structurally:
+    ANYONECANPAY digests ignore sibling inputs; NONE ignores outputs;
+    SINGLE commits only to the matching output; DEFAULT != ALL (the
+    hash_type byte itself is committed); the annex always changes the
+    digest; the BIP342 leaf extension always changes the digest."""
+    import dataclasses as _dc
+
+    tx, amounts, scripts = make_taproot_spend([81, 82], n_outputs=3)
+    am = [amounts[i] for i in range(2)]
+    sc = [scripts[i] for i in range(2)]
+
+    def d(t, i, ht, annex=None, leaf=None):
+        out = bip341_sighash(t, i, am, sc, ht, annex, leaf)
+        # equality-only properties must never pass vacuously as None==None
+        assert out is not None, hex(ht)
+        return out
+
+    # ACP: replacing the OTHER input leaves input 0's digest unchanged...
+    tx2 = _dc.replace(
+        tx,
+        inputs=(tx.inputs[0],
+                TxIn(OutPoint(b"\x99" * 32, 7), b"", 0x11111111)),
+    )
+    assert d(tx, 0, 0x81) == d(tx2, 0, 0x81)
+    # ...while the non-ACP digest changes (prevouts/sequences committed)
+    assert d(tx, 0, 0x01) != d(tx2, 0, 0x01)
+
+    # NONE: outputs don't matter; ALL: they do
+    tx3 = _dc.replace(tx, outputs=(TxOut(1, b"\x51"),))
+    assert d(tx, 0, 0x02) == d(tx3, 0, 0x02)
+    assert d(tx, 0, 0x01) != d(tx3, 0, 0x01)
+
+    # SINGLE: only the matching output is committed
+    other_out = _dc.replace(
+        tx, outputs=(tx.outputs[0], TxOut(9, b"\x52"), tx.outputs[2])
+    )
+    assert d(tx, 0, 0x03) == d(other_out, 0, 0x03)  # output 1 changed
+    own_out = _dc.replace(
+        tx, outputs=(TxOut(9, b"\x52"),) + tx.outputs[1:]
+    )
+    assert d(tx, 0, 0x03) != d(own_out, 0, 0x03)  # output 0 changed
+
+    # DEFAULT (0x00) and ALL (0x01) share semantics but differ as digests
+    assert d(tx, 0, 0x00) != d(tx, 0, 0x01)
+    # annex and leaf extension are committed
+    assert d(tx, 0, 0x00) != d(tx, 0, 0x00, annex=b"\x50")
+    leaf = b"\x01" * 32
+    assert d(tx, 0, 0x00) != d(tx, 0, 0x00, leaf=leaf)
+    assert d(tx, 0, 0x00, leaf=leaf) != d(tx, 0, 0x00, leaf=b"\x02" * 32)
+    # amounts and scripts of EVERY input are committed (non-ACP)
+    assert d(tx, 0, 0x00) != bip341_sighash(
+        tx, 0, [am[0], am[1] + 1], sc, 0x00
+    )
+    assert d(tx, 0, 0x00) != bip341_sighash(
+        tx, 0, am, [sc[0], b"\x51\x20" + b"\x03" * 32], 0x00
+    )
+
+
 def test_is_p2tr_and_hashtype_validity():
     assert is_p2tr(b"\x51\x20" + b"\x01" * 32)
     assert not is_p2tr(b"\x51\x21" + b"\x01" * 33)

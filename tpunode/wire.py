@@ -249,8 +249,10 @@ class Tx:
     # (tpunode/txextract.py).  Not part of value identity.
     raw: Optional[bytes] = field(default=None, compare=False, repr=False)
 
-    @property
+    @cached_property
     def has_witness(self) -> bool:
+        # cached: wants_amount consults this per input, and an any() scan
+        # per call would be O(n_inputs^2) on large transactions
         return any(self.witnesses)
 
     def serialize(self, include_witness: bool = True) -> bytes:
