@@ -153,6 +153,12 @@ def _synth_is_p2tr(txid: bytes, vout: int) -> bool:
     return ((txid[1] ^ vout) & 0x03) == 0
 
 
+def _synth_is_p2pk(txid: bytes, vout: int) -> bool:
+    """~1/8 of outpoints are bare-P2PK-typed (disjoint from the taproot
+    set: low two bits 0b10)."""
+    return ((txid[1] ^ vout) & 0x07) == 2
+
+
 def _synth_tap_priv(txid: bytes, vout: int) -> int:
     return (
         int.from_bytes(
@@ -181,6 +187,14 @@ def synth_prevout(txid: bytes, vout: int):
         if script is None:
             P = point_mul(_synth_tap_priv(txid, vout), GENERATOR)
             script = b"\x51\x20" + P.x.to_bytes(32, "big")
+            if len(_TAP_SCRIPT_CACHE) < 1 << 16:
+                _TAP_SCRIPT_CACHE[key] = script
+    elif _synth_is_p2pk(txid, vout):
+        key = (txid, ~vout)
+        script = _TAP_SCRIPT_CACHE.get(key)
+        if script is None:
+            P = point_mul(_synth_tap_priv(txid, vout), GENERATOR)
+            script = b"\x21" + _pub_blob(P) + b"\xac"
             if len(_TAP_SCRIPT_CACHE) < 1 << 16:
                 _TAP_SCRIPT_CACHE[key] = script
     else:
@@ -213,13 +227,15 @@ def _msig_script(m: int, key_blobs: list[bytes]) -> bytes:
 # of genuinely unsupported inputs (taproot SCRIPT-path spends) so the
 # coverage metric measures something.
 _MIX = [
-    (0.18, "p2pkh"),
-    (0.42, "p2wpkh"),
-    (0.53, "p2sh-p2wpkh"),
-    (0.65, "p2sh-msig"),
-    (0.76, "p2wsh-msig"),
-    (0.90, "p2tr"),
-    (0.96, "p2tr-script"),
+    (0.15, "p2pkh"),
+    (0.18, "p2pk"),
+    (0.38, "p2wpkh"),
+    (0.48, "p2sh-p2wpkh"),
+    (0.52, "p2wsh-single"),
+    (0.62, "p2sh-msig"),
+    (0.73, "p2wsh-msig"),
+    (0.89, "p2tr"),
+    (0.95, "p2tr-script"),
     (1.01, "unsupported"),
 ]
 
@@ -261,14 +277,20 @@ def gen_mixed_txs(
     pubs = [point_mul(p, GENERATOR) for p in privs]
     blobs = [_pub_blob(p) for p in pubs]
     redeem = _msig_script(2, blobs)  # shared 2-of-3 template
+    wscript = b"\x21" + blobs[0] + b"\xac"  # shared P2WSH single-key script
     out_script = _p2pkh_script_code(blobs[0])
 
-    def outpoint(want_p2tr: Optional[bool] = None) -> OutPoint:
+    def outpoint(want: str = "other") -> OutPoint:
         """Random outpoint, rejection-sampled to the wanted synthetic
-        script type (None = don't care)."""
+        script type ("p2tr" | "p2pk" | "other")."""
         while True:
             po = OutPoint(rng.randbytes(32), rng.randrange(4))
-            if want_p2tr is None or _synth_is_p2tr(po.txid, po.index) == want_p2tr:
+            kind_of = (
+                "p2tr" if _synth_is_p2tr(po.txid, po.index)
+                else "p2pk" if _synth_is_p2pk(po.txid, po.index)
+                else "other"
+            )
+            if kind_of == want:
                 return po
 
     txs: list[Tx] = []
@@ -280,10 +302,15 @@ def gen_mixed_txs(
         if schnorr_every and t % schnorr_every == schnorr_every - 1:
             kind = "p2pkh-schnorr"
         corrupt = invalid_every and t % invalid_every == invalid_every - 1
-        # taproot kinds pin the synthetic prevout type; the rest avoid
-        # P2TR-typed outpoints so the oracle's script can't reclassify them
-        want_tap = kind in ("p2tr", "p2tr-script", "unsupported")
-        prevouts = tuple(outpoint(want_tap) for _ in range(inputs_per_tx))
+        # taproot/p2pk kinds pin the synthetic prevout type; the rest
+        # avoid those outpoint types so the oracle's script can't
+        # reclassify them
+        want = (
+            "p2tr" if kind in ("p2tr", "p2tr-script", "unsupported")
+            else "p2pk" if kind == "p2pk"
+            else "other"
+        )
+        prevouts = tuple(outpoint(want) for _ in range(inputs_per_tx))
         outputs = (TxOut(50_000 + t, out_script),)
         version = 2 if kind != "p2pkh" else 1
         inputs = tuple(TxIn(po, b"", 0xFFFFFFFF) for po in prevouts)
@@ -307,6 +334,23 @@ def gen_mixed_txs(
                        for _ in prevouts
                    ))
             )
+            continue
+        if kind == "p2pk":
+            # bare P2PK: scriptSig = <sig>, key in the (oracle) prevout
+            # script; legacy sighash with the prevout script as code
+            signed_ins = []
+            for i, po in enumerate(prevouts):
+                pscript = synth_prevout(po.txid, po.index)[1]
+                z = legacy_sighash(unsigned, i, pscript, SIGHASH_ALL)
+                r, s = sign(
+                    _synth_tap_priv(po.txid, po.index), z,
+                    rng.getrandbits(256) % CURVE_N or 1,
+                )
+                if corrupt and i == 0:
+                    s = (s + 1) % CURVE_N or 1
+                sig_blob = _der(r, s) + bytes([SIGHASH_ALL])
+                signed_ins.append(TxIn(po, _push(sig_blob), 0xFFFFFFFF))
+            txs.append(Tx(version, tuple(signed_ins), outputs, 0))
             continue
         if kind in ("p2tr", "p2tr-script"):
             amounts = [synth_amount(po.txid, po.index) for po in prevouts]
@@ -373,6 +417,9 @@ def gen_mixed_txs(
                 z = legacy_sighash(unsigned, i, redeem, SIGHASH_ALL)
             elif kind == "p2wsh-msig":
                 z = bip143_sighash(unsigned, i, redeem, amount, SIGHASH_ALL)
+            elif kind == "p2wsh-single":
+                # witness script <key> OP_CHECKSIG is the script_code
+                z = bip143_sighash(unsigned, i, wscript, amount, SIGHASH_ALL)
             else:  # p2wpkh / p2sh-p2wpkh
                 z = bip143_sighash(unsigned, i, out_script, amount, SIGHASH_ALL)
             if kind in ("p2sh-msig", "p2wsh-msig"):
@@ -406,6 +453,9 @@ def gen_mixed_txs(
                         TxIn(po, _push(sig_blob) + _push(blobs[0]), 0xFFFFFFFF)
                     )
                     wit_stacks.append(())
+                elif kind == "p2wsh-single":
+                    signed_ins.append(TxIn(po, b"", 0xFFFFFFFF))
+                    wit_stacks.append((sig_blob, wscript))
                 else:
                     signed_ins.append(
                         TxIn(po, inputs[i].script, 0xFFFFFFFF)
@@ -470,9 +520,9 @@ def gen_chain(
             f"{net.magic:08x}-{n_blocks}x{txs_per_block}"
             f"-i{inputs_per_tx}-s{seed:x}"
             + (f"-w{segwit_every}" if segwit_every else "")
-            # v2: taproot in the mix (r5) — the key must change with the
-            # workload content or a stale cache silently survives
-            + (("-mixs3" if net.bch else "-mix3") if mix else "")
+            # v4: taproot + tapscript + p2pk + p2wsh-single in the mix (r5) — the
+            # key must change with the workload content or a stale cache survives
+            + (("-mixs4" if net.bch else "-mix4") if mix else "")
         )
         cache = f"{os.path.splitext(cache)[0]}-{key}.bin"
         path = cache_path(cache)

@@ -790,6 +790,27 @@ bool parse_multisig(const uint8_t *s, size_t len, MsigTemplate &out) {
   return k == out.n;
 }
 
+// Bare P2PK template <33/65-byte pubkey> OP_CHECKSIG (also the P2WSH
+// single-key witness-script shape); returns the key span or nullptr.
+const uint8_t *is_p2pk_script(const uint8_t *s, uint32_t len,
+                              size_t *key_len) {
+  if (len == 35 && s[0] == 33 && s[34] == 0xAC) {
+    *key_len = 33;
+    return s + 1;
+  }
+  if (len == 67 && s[0] == 65 && s[66] == 0xAC) {
+    *key_len = 65;
+    return s + 1;
+  }
+  return nullptr;
+}
+
+// Single-push scriptSig (the bare-P2PK spend shape) — mirror of the
+// wants_amount shape check.
+bool single_push_script_sig(const InSpan &in) {
+  return in.script_len >= 10 && in.script_len == uint32_t(in.script[0]) + 1;
+}
+
 // The spend-template classifier shared by txx_scan (capacity) and
 // txx_extract (emission) — mirror of the template dispatch in
 // txverify.extract_sig_items.
@@ -803,7 +824,10 @@ struct InTemplate {
   MsigTemplate ms;  // MULTISIG
   const uint8_t *sigs[16];
   size_t sig_lens[16];
-  const uint8_t *sc = nullptr;  // MULTISIG script_code (redeem/witness script)
+  // script_code: redeem/witness script for MULTISIG; for SINGLE, set
+  // only when it is NOT the derived P2PKH template (P2WSH single-key's
+  // witness script, bare P2PK's prevout script)
+  const uint8_t *sc = nullptr;
   size_t sc_len = 0;
 };
 
@@ -825,11 +849,26 @@ bool is_msig_witness(const InSpan &in, InTemplate &t) {
 
 void classify_input(const InSpan &in, InTemplate &t) {
   if (in.script_len == 0 && in.wit_count == 2) {
-    // P2WPKH
-    t.kind = InTemplate::SINGLE;
-    t.segwit = true;
-    t.sig = in.wit[0]; t.sig_len = in.wit_len[0];
-    t.pub = in.wit[1]; t.pub_len = in.wit_len[1];
+    if (in.wit_len[1] == 33 || in.wit_len[1] == 65) {
+      // P2WPKH: [sig, pubkey]
+      t.kind = InTemplate::SINGLE;
+      t.segwit = true;
+      t.sig = in.wit[0]; t.sig_len = in.wit_len[0];
+      t.pub = in.wit[1]; t.pub_len = in.wit_len[1];
+      return;
+    }
+    size_t klen;
+    const uint8_t *key = is_p2pk_script(in.wit[1], in.wit_len[1], &klen);
+    if (key != nullptr) {
+      // P2WSH single-key: [sig, <key> OP_CHECKSIG]; the witness script
+      // is the BIP143 script_code (a non-matching 2-element witness is
+      // UNSUPPORTED, not auto-invalid — mirror of txverify)
+      t.kind = InTemplate::SINGLE;
+      t.segwit = true;
+      t.sig = in.wit[0]; t.sig_len = in.wit_len[0];
+      t.pub = key; t.pub_len = klen;
+      t.sc = in.wit[1]; t.sc_len = in.wit_len[1];
+    }
     return;
   }
   if (in.script_len == 0 && is_msig_witness(in, t)) {
@@ -862,6 +901,20 @@ void classify_input(const InSpan &in, InTemplate &t) {
     t.kind = InTemplate::MULTISIG;
     t.segwit = true;
     return;
+  }
+  if (np == 1 && plen[0] == 34 && pushes[0][0] == 0x00 &&
+      pushes[0][1] == 0x20 && in.wit_count == 2) {
+    size_t klen;
+    const uint8_t *key = is_p2pk_script(in.wit[1], in.wit_len[1], &klen);
+    if (key != nullptr) {
+      // P2SH-P2WSH single-key
+      t.kind = InTemplate::SINGLE;
+      t.segwit = true;
+      t.sig = in.wit[0]; t.sig_len = in.wit_len[0];
+      t.pub = key; t.pub_len = klen;
+      t.sc = in.wit[1]; t.sc_len = in.wit_len[1];
+      return;
+    }
   }
   if (np >= 2 && np <= 18 && plen[0] == 0 &&
       parse_multisig(pushes[np - 1], plen[np - 1], t.ms) &&
@@ -1331,7 +1384,8 @@ long txx_prevouts(const uint8_t *data, long len, long tx_count, int bch,
     if (!parse_tx(c, tx, /*compute_txid=*/false)) return -1;
     // tx-LEVEL witness gate (mirror of txverify.wants_amount): a taproot
     // keypath input digests EVERY input's amount+script, so any witness
-    // in the tx makes all of its inputs worth a lookup
+    // in the tx makes all of its inputs worth a lookup; a single-push
+    // scriptSig (bare-P2PK shape) wants its own prevout script too
     bool tx_has_wit = false;
     for (const InSpan &in : tx.ins) tx_has_wit |= in.wit_count >= 1;
     for (const InSpan &in : tx.ins) {
@@ -1343,7 +1397,8 @@ long txx_prevouts(const uint8_t *data, long len, long tx_count, int bch,
       // prevout_lookup as the true unsigned value, not a negative int
       vouts[flat] = int64_t(vout);
       bool cb = memcmp(in.prevout, ZERO_TXID, 32) == 0;
-      wants[flat] = (!cb && (bch || tx_has_wit)) ? 1 : 0;
+      wants[flat] =
+          (!cb && (bch || tx_has_wit || single_push_script_sig(in))) ? 1 : 0;
       ++flat;
     }
     ++n;
@@ -1456,7 +1511,8 @@ long txx_prevouts_h(void *hp, int bch, long capacity, uint8_t *txids32,
       memcpy(&vout, in.prevout + 32, 4);
       vouts[flat] = int64_t(vout);
       bool cb = memcmp(in.prevout, ZERO_TXID, 32) == 0;
-      wants[flat] = (!cb && (bch || tx_has_wit)) ? 1 : 0;
+      wants[flat] =
+          (!cb && (bch || tx_has_wit || single_push_script_sig(in))) ? 1 : 0;
       ++flat;
     }
   }
@@ -1788,6 +1844,22 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
 
       InTemplate t;
       classify_input(in, t);
+      if (t.kind == InTemplate::UNSUPPORTED && (got & 2) &&
+          in.wit_count == 0 && single_push_script_sig(in)) {
+        // bare P2PK: scriptSig = <sig>, key in the prevout script — only
+        // the oracle's script makes this classifiable
+        size_t klen;
+        const uint8_t *key = is_p2pk_script(pscript, pscript_len, &klen);
+        if (key != nullptr) {
+          t.kind = InTemplate::SINGLE;
+          t.sig = in.script + 1;
+          t.sig_len = in.script_len - 1;
+          t.pub = key;
+          t.pub_len = klen;
+          t.sc = pscript;
+          t.sc_len = pscript_len;
+        }
+      }
       if (t.kind == InTemplate::UNSUPPORTED) {
         ++unsupported;
         continue;
@@ -1810,21 +1882,30 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
           ++unsupported;
           continue;
         }
-        // script_code: the P2PKH template over hash160(pubkey)
-        uint8_t script_code[25];
-        script_code[0] = 0x76; script_code[1] = 0xA9; script_code[2] = 0x14;
-        hash160(t.pub, t.pub_len, script_code + 3);
-        script_code[23] = 0x88; script_code[24] = 0xAC;
+        // script_code: the template's own script when set (P2WSH
+        // single-key witness script, bare P2PK prevout script), else the
+        // P2PKH template over hash160(pubkey)
+        uint8_t p2pkh_code[25];
+        const uint8_t *script_code = t.sc;
+        size_t sc_len = t.sc_len;
+        if (script_code == nullptr) {
+          p2pkh_code[0] = 0x76; p2pkh_code[1] = 0xA9; p2pkh_code[2] = 0x14;
+          hash160(t.pub, t.pub_len, p2pkh_code + 3);
+          p2pkh_code[23] = 0x88; p2pkh_code[24] = 0xAC;
+          script_code = p2pkh_code;
+          sc_len = 25;
+        }
         uint8_t digest[32];
         if (t.segwit || (bch && (hashtype & SIGHASH_FORKID))) {
           if (!have_amount) {
             ++unsupported;
             continue;
           }
-          bip143_sighash(tx, idx, script_code, 25, amount, hashtype, scratch,
-                         digest);
+          bip143_sighash(tx, idx, script_code, sc_len, amount, hashtype,
+                         scratch, digest);
         } else {
-          legacy_sighash(tx, idx, script_code, 25, hashtype, scratch, digest);
+          legacy_sighash(tx, idx, script_code, sc_len, hashtype, scratch,
+                         digest);
         }
         if (item >= capacity) return -2;
         memcpy(r + item * 32, rbuf, 32);

@@ -1,0 +1,185 @@
+"""Bare P2PK and P2WSH single-key extraction (r5 template additions).
+
+Bare P2PK spends carry no key on the wire — the prevout script (oracle)
+both identifies the template and supplies the key, the same channel
+taproot uses.  P2WSH single-key spends carry the witness script; before
+this template landed, their [sig, script] witness pattern-matched the
+P2WPKH shape and was mis-emitted as an auto-invalid item (a false
+INVALID verdict for a consensus-valid spend) — the shape check is now
+honest: matching templates extract, everything else is unsupported.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from benchmarks.txgen import _der
+from tpunode.sighash import SIGHASH_ALL, bip143_sighash, legacy_sighash
+from tpunode.txverify import (
+    combine_verdicts,
+    extract_sig_items,
+    is_p2pk,
+    wants_amount,
+)
+from tpunode.verify.ecdsa_cpu import (
+    CURVE_N,
+    GENERATOR,
+    point_mul,
+    sign,
+    verify_batch_cpu,
+)
+from tpunode.wire import OutPoint, Tx, TxIn, TxOut
+
+
+def _pub(priv: int) -> bytes:
+    P = point_mul(priv, GENERATOR)
+    return bytes([2 + (P.y & 1)]) + P.x.to_bytes(32, "big")
+
+
+def p2pk_script(priv: int) -> bytes:
+    return b"\x21" + _pub(priv) + b"\xac"
+
+
+def make_p2pk_spend(priv: int = 771, corrupt: bool = False):
+    pscript = p2pk_script(priv)
+    inputs = (TxIn(OutPoint(b"\x77" * 32, 3), b"", 0xFFFFFFFF),)
+    outputs = (TxOut(500, b"\x00\x14" + b"\x0a" * 20),)
+    tx = Tx(1, inputs, outputs, 0)
+    z = legacy_sighash(tx, 0, pscript, SIGHASH_ALL)
+    r, s = sign(priv, z, 0x771)
+    if corrupt:
+        s = (s + 1) % CURVE_N or 1
+    sig = _der(r, s) + bytes([SIGHASH_ALL])
+    script_sig = bytes([len(sig)]) + sig
+    tx = Tx(1, (TxIn(inputs[0].prevout, script_sig, 0xFFFFFFFF),), outputs, 0)
+    return tx, {0: 9_000}, {0: pscript}
+
+
+def run(tx, amounts, scripts):
+    items, stats = extract_sig_items(
+        tx, prevout_amounts=amounts, prevout_scripts=scripts
+    )
+    v = verify_batch_cpu([i.verify_item for i in items])
+    return items, stats, combine_verdicts(items, v)
+
+
+def test_p2pk_extracts_and_verifies():
+    tx, amounts, scripts = make_p2pk_spend()
+    # the single-push scriptSig shape makes the prevout wanted
+    assert wants_amount(tx, 0, False)
+    items, stats, per_sig = run(tx, amounts, scripts)
+    assert stats.extracted == 1 and stats.unsupported == 0
+    assert per_sig == [True]
+    # without the oracle script the spend is unclassifiable: unsupported
+    items, stats = extract_sig_items(tx, prevout_amounts=amounts)
+    assert stats.unsupported == 1 and not items
+
+
+def test_p2pk_wrong_key_fails():
+    tx, amounts, scripts = make_p2pk_spend()
+    scripts[0] = p2pk_script(999)  # different key in the prevout
+    _, stats, per_sig = run(tx, amounts, scripts)
+    assert stats.extracted == 1 and per_sig == [False]
+
+
+def test_p2pk_native_parity():
+    txextract = pytest.importorskip("tpunode.txextract")
+    if not txextract.have_native_extract():  # pragma: no cover
+        pytest.skip("native txextract unavailable")
+    tx, amounts, scripts = make_p2pk_spend()
+    py_items, _ = extract_sig_items(
+        tx, prevout_amounts=amounts, prevout_scripts=scripts
+    )
+    out = txextract.extract_raw(
+        tx.serialize(), 1, ext_amounts=[amounts[0]], ext_scripts=[scripts[0]]
+    )
+    assert out.count == 1 and out.present.tolist() == [1]
+    assert out.to_verify_items() == [py_items[0].verify_item]
+    assert verify_batch_cpu(out.to_verify_items()) == [True]
+
+
+def make_wsh_single_spend(priv: int = 881, nested: bool = False):
+    import hashlib
+
+    wscript = p2pk_script(priv)
+    if nested:
+        prog = b"\x00\x20" + hashlib.sha256(wscript).digest()
+        script_sig = bytes([len(prog)]) + prog
+    else:
+        script_sig = b""
+    inputs = (TxIn(OutPoint(b"\x88" * 32, 1), script_sig, 0xFFFFFFFF),)
+    outputs = (TxOut(600, b"\x00\x14" + b"\x0b" * 20),)
+    tx = Tx(2, inputs, outputs, 0, witnesses=((),))
+    amount = 12_345
+    z = bip143_sighash(tx, 0, wscript, amount, SIGHASH_ALL)
+    r, s = sign(priv, z, 0x881)
+    sig = _der(r, s) + bytes([SIGHASH_ALL])
+    import dataclasses
+
+    tx = dataclasses.replace(tx, witnesses=((sig, wscript),))
+    return tx, {0: amount}, {0: b"\x00\x20" + b"\x00" * 32}
+
+
+@pytest.mark.parametrize("nested", [False, True])
+def test_wsh_single_key_extracts_and_verifies(nested):
+    tx, amounts, scripts = make_wsh_single_spend(nested=nested)
+    items, stats, per_sig = run(tx, amounts, scripts)
+    assert stats.extracted == 1 and stats.unsupported == 0
+    assert per_sig == [True]
+
+
+def test_wsh_nonmatching_witness_script_is_unsupported_not_invalid():
+    """A [sig, <other-script>] witness must be UNSUPPORTED — the old
+    P2WPKH shape check emitted it as an auto-invalid ECDSA item, a false
+    INVALID verdict for a potentially consensus-valid spend."""
+    import dataclasses
+
+    tx, amounts, scripts = make_wsh_single_spend()
+    for wit1 in (b"\x51\x51\x51", b"\x21" + b"\x02" * 33 + b"\xad",
+                 b"\x00" * 40):
+        t2 = dataclasses.replace(tx, witnesses=((tx.witnesses[0][0], wit1),))
+        items, stats = extract_sig_items(
+            t2, prevout_amounts=amounts, prevout_scripts=scripts
+        )
+        assert stats.unsupported == 1 and not items, wit1[:4]
+
+
+def test_wsh_single_native_parity():
+    txextract = pytest.importorskip("tpunode.txextract")
+    if not txextract.have_native_extract():  # pragma: no cover
+        pytest.skip("native txextract unavailable")
+    import dataclasses
+
+    for nested in (False, True):
+        tx, amounts, scripts = make_wsh_single_spend(nested=nested)
+        variants = [tx]
+        # non-matching witness scripts: unsupported on BOTH paths
+        variants.append(
+            dataclasses.replace(
+                tx, witnesses=((tx.witnesses[0][0], b"\x51\x51\x51"),)
+            )
+        )
+        for t in variants:
+            py_items, py_st = extract_sig_items(
+                t, prevout_amounts=amounts, prevout_scripts=scripts
+            )
+            out = txextract.extract_raw(
+                t.serialize(), 1, ext_amounts=[amounts[0]],
+                ext_scripts=[scripts[0]],
+            )
+            assert out.count == len(py_items)
+            st = out.stats(0)
+            assert (st.extracted, st.unsupported) == (
+                py_st.extracted, py_st.unsupported
+            )
+            assert verify_batch_cpu(out.to_verify_items()) == verify_batch_cpu(
+                [i.verify_item for i in py_items]
+            )
+
+
+def test_is_p2pk_shapes():
+    assert is_p2pk(b"\x21" + b"\x02" * 33 + b"\xac") == b"\x02" * 33
+    assert is_p2pk(b"\x41" + b"\x04" * 65 + b"\xac") == b"\x04" * 65
+    assert is_p2pk(b"\x21" + b"\x02" * 33 + b"\xad") is None  # CHECKSIGVERIFY
+    assert is_p2pk(b"\x20" + b"\x02" * 32 + b"\xac") is None  # x-only: tapscript
+    assert is_p2pk(b"") is None

@@ -61,10 +61,19 @@ __all__ = [
     "intra_block_prevouts",
     "wants_amount",
     "is_p2tr",
+    "is_p2pk",
     "is_single_key_tapscript",
     "combine_verdicts",
     "msig_match",
 ]
+
+
+def _is_single_push_sig(script: bytes) -> bool:
+    """One direct push of a plausible DER/Schnorr sig blob — the bare-P2PK
+    spend shape.  Shared by the wants gate and the extractor dispatch so
+    the two can never drift (mirrored by the native
+    single_push_script_sig)."""
+    return len(script) >= 10 and len(script) == script[0] + 1
 
 
 def wants_amount(tx: Tx, idx: int, bch: bool) -> bool:
@@ -77,11 +86,14 @@ def wants_amount(tx: Tx, idx: int, bch: bool) -> bool:
     no-witness siblings — so the gate is tx-level, not per-input
     (review r5: a per-input gate silently downgraded taproot spends in
     mixed legacy+taproot txs to unsupported).  Also True for any input on
-    a FORKID (BCH) network.  Witness-free non-FORKID txs never use
-    prevout data, so callers skip their (possibly expensive) lookups."""
-    if bch:
+    a FORKID (BCH) network, and for single-push scriptSig inputs (the
+    bare-P2PK spend shape: the prevout script both identifies the
+    template and carries its key).  Other witness-free non-FORKID inputs
+    never use prevout data, so callers skip their (possibly expensive)
+    lookups."""
+    if bch or tx.has_witness:
         return True
-    return tx.has_witness
+    return _is_single_push_sig(tx.inputs[idx].script)
 
 
 def intra_block_amounts(txs) -> dict[tuple[bytes, int], int]:
@@ -271,10 +283,30 @@ def extract_sig_items(
             new = _taproot_item(
                 tx, idx, wit, pscript, prevout_amounts, prevout_scripts
             )
+        elif (
+            pscript is not None
+            and (pk := is_p2pk(pscript)) is not None
+            and not wit
+            and _is_single_push_sig(txin.script)
+        ):
+            # bare P2PK: scriptSig = one direct push of <sig>, key lives
+            # in the prevout script (extractable only via the script
+            # oracle)
+            new = _single_item(tx, idx, txin.script[1:], pk, prevout_amounts,
+                               bch, segwit=False, script_code=pscript)
         elif not txin.script and len(wit) == 2:
-            # P2WPKH: empty scriptSig, [sig, pubkey] witness
-            new = _single_item(tx, idx, wit[0], wit[1], prevout_amounts, bch,
-                               segwit=True)
+            if len(wit[1]) in (33, 65):
+                # P2WPKH: empty scriptSig, [sig, pubkey] witness
+                new = _single_item(tx, idx, wit[0], wit[1], prevout_amounts,
+                                   bch, segwit=True)
+            elif (pk := is_p2pk(wit[1])) is not None:
+                # P2WSH single-key: [sig, <key> OP_CHECKSIG] witness; the
+                # witness script is the BIP143 script_code.  (Without this
+                # template the P2WPKH shape check used to mis-emit these
+                # as auto-invalid ECDSA items — review r5.)
+                new = _single_item(tx, idx, wit[0], pk, prevout_amounts,
+                                   bch, segwit=True, script_code=wit[1])
+            # other 2-element witnesses: unsupported, NOT auto-invalid
         elif not txin.script and (ms := _is_multisig_witness(wit)):
             # P2WSH multisig
             new = _msig_items(tx, idx, list(wit[1:-1]), ms[0], ms[1], wit[-1],
@@ -306,6 +338,16 @@ def extract_sig_items(
                 new = _msig_items(tx, idx, list(wit[1:-1]), ms[0], ms[1],
                                   wit[-1], prevout_amounts, bch, segwit=True)
             elif (
+                len(pushes) == 1
+                and len(pushes[0]) == 34
+                and pushes[0][:2] == b"\x00\x20"
+                and len(wit) == 2
+                and (pk := is_p2pk(wit[1])) is not None
+            ):
+                # P2SH-P2WSH single-key
+                new = _single_item(tx, idx, wit[0], pk, prevout_amounts,
+                                   bch, segwit=True, script_code=wit[1])
+            elif (
                 len(pushes) >= 2
                 and pushes[0] == b""
                 and (ms := _parse_multisig(pushes[-1])) is not None
@@ -329,6 +371,16 @@ def is_single_key_tapscript(script: bytes) -> bool:
     """The canonical single-key tapscript: ``<32-byte x-only key>
     OP_CHECKSIG`` (the standard script-path leaf shape)."""
     return len(script) == 34 and script[0] == 0x20 and script[33] == 0xAC
+
+
+def is_p2pk(script: bytes) -> Optional[bytes]:
+    """Bare P2PK output template ``<33/65-byte pubkey> OP_CHECKSIG``;
+    returns the pubkey blob or None."""
+    if len(script) == 35 and script[0] == 33 and script[34] == 0xAC:
+        return script[1:34]
+    if len(script) == 67 and script[0] == 65 and script[66] == 0xAC:
+        return script[1:66]
+    return None
 
 
 def _valid_control_block(cb: bytes) -> bool:
@@ -429,7 +481,12 @@ def _single_item(
     prevout_amounts: Optional[dict[int, int]],
     bch: bool,
     segwit: bool,
+    script_code: Optional[bytes] = None,
 ) -> Optional[list[SigItem]]:
+    """One ECDSA/Schnorr item for a single-key spend.  ``script_code``
+    defaults to the P2PKH template over ``pub_blob`` (P2PKH/P2WPKH);
+    bare P2PK passes the prevout script, P2WSH single-key the witness
+    script."""
     if len(sig_blob) < 9:
         return None
     hashtype = sig_blob[-1]
@@ -443,7 +500,8 @@ def _single_item(
         if rs is None:
             return None
         r, s = rs
-    script_code = _p2pkh_script_code(pub_blob)
+    if script_code is None:
+        script_code = _p2pkh_script_code(pub_blob)
     if segwit or (bch and hashtype & SIGHASH_FORKID):
         if prevout_amounts is None or idx not in prevout_amounts:
             return None
