@@ -14,6 +14,11 @@ import random
 
 import pytest
 
+# Heavy tier: ~75s of differential fuzzing on this box; the per-template
+# native/Python parity tests stay in the fast tier (test_taproot,
+# test_p2pk_wsh, test_txextract).
+pytestmark = pytest.mark.heavy
+
 from benchmarks.txgen import gen_mixed_txs, synth_prevout
 from tpunode.txverify import (
     combine_verdicts,
