@@ -128,6 +128,129 @@ def _mixed_batch(n):
     return items, expect
 
 
+# --- fixed spec vectors -----------------------------------------------------
+#
+# The BCH 2019-05 Schnorr spec adopts the construction of the pre-BIP340
+# "bip-schnorr" draft (e = H(r ‖ compressed(P) ‖ m), jacobi(y(R)) = 1), and
+# points at that draft's published test vectors.  Embedding them as literal
+# constants closes the ADVICE-r4 loophole for this lane the same way
+# tests/test_bip340.py does for taproot: acceptance cannot depend on any
+# in-repo signing/challenge code agreeing with itself.  (The independent
+# hashlib re-derivation above covers the signing side.)
+
+BCH_SCHNORR_VECTORS = [
+    # (compressed pubkey, msg, sig = r ‖ s, expected)
+    ("0279BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798",
+     "0000000000000000000000000000000000000000000000000000000000000000",
+     "787A848E71043D280C50470E8E1532B2DD5D20EE912A45DBDD2BD1DFBF187EF6"
+     "7031A98831859DC34DFFEEDDA86831842CCD0079E1F92AF177F7F22CC1DCED05",
+     True),
+    ("02DFF1D77F2A671C5F36183726DB2341BE58FEAE1DA2DECED843240F7B502BA659",
+     "243F6A8885A308D313198A2E03707344A4093822299F31D0082EFA98EC4E6C89",
+     "2A298DACAE57395A15D0795DDBFD1DCB564DA82B0F269BC70A74F8220429BA1D"
+     "1E51A22CCEC35599B8F266912281F8365FFC2D035A230434A1A64DC59F7013FD",
+     True),
+    ("03FAC2114C2FBB091527EB7C64ECB11F8021CB45E8E7809D3C0938E4B8C0E5F84B",
+     "5E2D58D8B3BCDF1ABADEC7829054F90DDA9805AAB56C77333024B9D0A508B75C",
+     "00DA9B08172A9B6F0466A2DEFD817F2D7AB437E0D253CB5395A963866B3574BE"
+     "00880371D01766935B92D2AB4CD5C8A2A5837EC57FED7660773A05F0DE142380",
+     True),
+    # negated message: the vector-2 signature over m with its low bit set
+    # must NOT verify (draft's "negated message" negative, re-anchored to a
+    # positive row so the constant stays self-checking)
+    ("03FAC2114C2FBB091527EB7C64ECB11F8021CB45E8E7809D3C0938E4B8C0E5F84B",
+     "5E2D58D8B3BCDF1ABADEC7829054F90DDA9805AAB56C77333024B9D0A508B75D",
+     "00DA9B08172A9B6F0466A2DEFD817F2D7AB437E0D253CB5395A963866B3574BE"
+     "00880371D01766935B92D2AB4CD5C8A2A5837EC57FED7660773A05F0DE142380",
+     False),
+]
+
+# x not on the curve (same famous constant BIP340 uses as its first
+# negative): SEC1 decode must fail, and the engine row is auto-invalid.
+SCHNORR_OFFCURVE_PUB = (
+    "02EEFDEA4CDB677750A420FEE807EACF21EB9898AE79B9768766E4FAA04A2D4A34"
+)
+
+
+def _fixed_vector_items():
+    """Fixed vector rows + systematic negatives, as engine tuples."""
+    from tpunode.verify.ecdsa_cpu import decode_pubkey
+
+    items, expect = [], []
+    for pub_hex, msg, sig, res in BCH_SCHNORR_VECTORS:
+        if not res:
+            # literal negatives are covered in test_fixed_vectors_oracle;
+            # the m^1 systematic negative below would duplicate them here
+            continue
+        P = decode_pubkey(bytes.fromhex(pub_hex))
+        assert P is not None
+        m = int(msg, 16)
+        r, s = int(sig[:64], 16), int(sig[64:], 16)
+        items.append((P, schnorr_challenge(r, P, m), r, s, "schnorr"))
+        expect.append(True)
+        # systematic negatives from each positive row
+        items.append((P, schnorr_challenge(r, P, m ^ 1), r, s, "schnorr"))
+        expect.append(False)
+        items.append((P, schnorr_challenge(r, P, m), r,
+                      (s + 1) % CURVE_N, "schnorr"))
+        expect.append(False)
+    assert decode_pubkey(bytes.fromhex(SCHNORR_OFFCURVE_PUB)) is None
+    items.append((None, 0, 1, 1, "schnorr"))
+    expect.append(False)
+    # out-of-range r / s
+    P0 = decode_pubkey(bytes.fromhex(BCH_SCHNORR_VECTORS[0][0]))
+    items.append((P0, 1, CURVE_P, 1, "schnorr"))
+    expect.append(False)
+    items.append((P0, 1, 1, CURVE_N, "schnorr"))
+    expect.append(False)
+    return items, expect
+
+
+def test_fixed_vectors_oracle():
+    from tpunode.verify.ecdsa_cpu import decode_pubkey
+
+    for pub_hex, msg, sig, res in BCH_SCHNORR_VECTORS:
+        P = decode_pubkey(bytes.fromhex(pub_hex))
+        r, s = int(sig[:64], 16), int(sig[64:], 16)
+        assert verify_schnorr(P, int(msg, 16), r, s) is res, pub_hex
+
+
+def test_fixed_vectors_native_cpp():
+    from tpunode.verify.cpu_native import load_native_verifier
+
+    nv = load_native_verifier()
+    if nv is None:
+        pytest.skip("native verifier unavailable")
+    items, expect = _fixed_vector_items()
+    assert nv.verify_batch(items) == expect
+
+
+@pytest.mark.heavy  # device-kernel compile (pytest.ini tiers)
+def test_fixed_vectors_xla_kernel():
+    jax = pytest.importorskip("jax")
+    del jax
+    from tpunode.verify.kernel import verify_batch_tpu
+
+    items, expect = _fixed_vector_items()
+    assert verify_batch_tpu(items, pad_to=16) == expect
+
+
+@pytest.mark.heavy  # device-kernel compile (pytest.ini tiers)
+def test_fixed_vectors_pallas_interpret():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from tpunode.verify.kernel import prepare_batch
+    from tpunode.verify.pallas_kernel import verify_blocked_impl
+
+    items, expect = _fixed_vector_items()
+    prep = prepare_batch(items, pad_to=16)
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    out = verify_blocked_impl(*args, interpret=True, block=16)
+    assert [bool(b) for b in out[: len(expect)]] == expect
+    del jax
+
+
 # --- oracle ----------------------------------------------------------------
 
 
