@@ -75,6 +75,21 @@ def run_json_subprocess(
     }
 
 
+def worker_rung_env(batch: int, kernel: str | None = None):
+    """Env + display label for one device-ladder rung.
+
+    Shared by bench.py's round-end ladder and benchmarks/watcher.py (the
+    round-long sampler) so the TPUNODE_BENCH_* worker contract lives in
+    one place: ``kernel`` None means auto-select (pallas on TPU), "xla"
+    forces the portable XLA program (the Mosaic-outage fallback).
+    """
+    env = {"TPUNODE_BENCH_BATCH": str(batch),
+           "TPUNODE_BENCH_REQUIRE_TPU": "1"}
+    if kernel:
+        env["TPUNODE_BENCH_KERNEL"] = kernel
+    return env, f"tpu{'-' + kernel if kernel else ''}@{batch}"
+
+
 def make_triples(n: int, seed: int = 0xBE5C, invalid_every: int = 16):
     """Deterministic (pubkey, z, r, s) items; every ``invalid_every``-th has
     a corrupted message to keep verifiers honest."""
