@@ -1,0 +1,171 @@
+"""Bounded Mosaic-outage diagnostic (r5).
+
+The axon remote-compile helper is 500ing on every Pallas program this
+round (``MosaicError: .../remote_compile: HTTP 500``) while plain XLA
+programs compile and run on the same device.  This script discriminates
+the two possible causes when an uptime window allows:
+
+1. ``trivial``  — a 2-line Pallas add kernel.  If THIS fails, the compile
+   helper is broken for all Mosaic programs (infra outage; nothing to fix
+   in-repo).
+2. ``field_mul`` — one pallas_field.mul over a (24, 256) block, the verify
+   kernel's core op.  Separates "our field formulas" from "any kernel".
+3. ``flagship`` — the real ``verify_blocked`` at batch 256 (one block).
+   If only this fails, something the r4 lanes added trips the helper and
+   an in-repo fix is worth hunting.
+
+Run by benchmarks/watcher.py once per round after its first successful
+device sweep (or by hand: ``python -m benchmarks.mosaic_diag``).  Prints
+one JSON line; full tracebacks go to benchmarks/mosaic_diag.log.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LOG = os.path.join(REPO, "benchmarks", "mosaic_diag.log")
+
+
+def _log(msg: str) -> None:
+    with open(LOG, "a", encoding="utf-8") as f:
+        f.write(f"[{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] "
+                f"{msg}\n")
+
+
+def _case(name: str, fn) -> dict:
+    t0 = time.perf_counter()
+    try:
+        fn()
+        out = {"case": name, "ok": True,
+               "s": round(time.perf_counter() - t0, 1)}
+    except Exception as e:  # noqa: BLE001 — diagnostic: report, don't die
+        _log(f"{name} FAILED:\n{traceback.format_exc()}")
+        out = {"case": name, "ok": False,
+               "s": round(time.perf_counter() - t0, 1),
+               "error": f"{type(e).__name__}: {e}"[:600]}
+    _log(f"{name}: {json.dumps(out)}")
+    return out
+
+
+# Local logic check without hardware: TPUNODE_DIAG_INTERPRET=1 runs the
+# pallas cases in interpret mode (tests/test_benchmarks.py uses it).
+_INTERPRET = os.environ.get("TPUNODE_DIAG_INTERPRET") == "1"
+
+
+def _trivial() -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def add_one(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1
+
+    x = jnp.zeros((8, 128), jnp.int32)
+    y = pl.pallas_call(
+        add_one, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_INTERPRET,
+    )(x)
+    assert int(y.sum()) == 8 * 128
+
+
+def _field_mul() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    from tpunode.verify import field as F
+    from tpunode.verify import pallas_field as PF
+
+    def mul_kernel(a_ref, b_ref, o_ref):
+        o_ref[...] = PF.canonical(PF.mul(a_ref[...], b_ref[...]))
+
+    b = 256
+    rng = np.random.default_rng(7)
+    av = [int(rng.integers(0, 2**63)) for _ in range(b)]
+    bv = [int(rng.integers(0, 2**63)) for _ in range(b)]
+    a = jnp.asarray(np.stack([F.to_limbs(v) for v in av], axis=1))
+    bb = jnp.asarray(np.stack([F.to_limbs(v) for v in bv], axis=1))
+    out = pl.pallas_call(
+        mul_kernel, out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        interpret=_INTERPRET,
+    )(a, bb)
+    for i in (0, b - 1):
+        got = F.from_limbs(np.asarray(out)[:, i])
+        assert got == (av[i] * bv[i]) % F.P, (i, got)
+
+
+def _flagship() -> None:
+    import jax.numpy as jnp
+
+    from benchmarks.common import make_triples
+    from tpunode.verify.cpu_native import load_native_verifier
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+    from tpunode.verify.kernel import collect_verdicts, prepare_batch
+    from tpunode.verify.pallas_kernel import (
+        verify_blocked,
+        verify_blocked_impl,
+    )
+
+    base = make_triples(256)
+    prep = prepare_batch(base, pad_to=256)
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    if _INTERPRET:
+        out = verify_blocked_impl(*args, interpret=True, block=256)
+    else:
+        out = verify_blocked(*args)
+    got = collect_verdicts(out, len(base))
+    native = load_native_verifier()
+    expect = (native.verify_batch(base) if native is not None
+              else verify_batch_cpu(base))
+    assert got == expect, "flagship verdict mismatch"
+
+
+def main() -> None:
+    res: dict = {"diag": "mosaic", "cases": []}
+    try:
+        import jax
+
+        if _INTERPRET:
+            # Env alone is not enough: this box's TPU shim
+            # (sitecustomize) force-sets jax_platforms in every process,
+            # and a dead tunnel then blocks jax.devices() forever.
+            jax.config.update("jax_platforms", "cpu")
+        dev = jax.devices()[0]
+        res["device"] = f"{getattr(dev, 'platform', '?')}:" \
+                        f"{getattr(dev, 'device_kind', '?')}"
+        if dev.platform != "tpu" and not _INTERPRET:
+            res["error"] = "not a tpu backend; diagnostic meaningless"
+            print(json.dumps(res))
+            return
+    except Exception as e:  # noqa: BLE001
+        res["error"] = f"backend init failed: {e}"[:300]
+        print(json.dumps(res))
+        return
+    for name, fn in (("trivial", _trivial), ("field_mul", _field_mul),
+                     ("flagship", _flagship)):
+        out = _case(name, fn)
+        res["cases"].append(out)
+        if name == "trivial" and not out["ok"]:
+            res["verdict"] = "infra: compile helper broken for ALL pallas"
+            break
+    else:
+        oks = {c["case"]: c["ok"] for c in res["cases"]}
+        if all(oks.values()):
+            res["verdict"] = "mosaic healthy (outage over?)"
+        elif oks.get("trivial") and not oks.get("flagship"):
+            res["verdict"] = ("repo: flagship kernel trips the helper"
+                              + ("" if oks.get("field_mul")
+                                 else " (field ops already fail)"))
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

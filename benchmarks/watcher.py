@@ -124,6 +124,9 @@ def run_headline() -> dict | None:
             [sys.executable, "bench.py", "--worker"], budget, env,
         )
         if res.get("ok"):
+            if kernel is None:
+                # pallas works (again): restore the full-budget ladder
+                _mosaic_broken = False
             _record("headline", {
                 "metric": "sig_verify_throughput",
                 "value": round(res["rate"], 1), "unit": "sigs/sec/chip",
@@ -230,6 +233,21 @@ def main() -> None:
                 for name in ("config2", "config5", "config3"):
                     if name not in swept and run_config(name) is not None:
                         swept.add(name)
+                if _mosaic_broken and "mosaic_diag" not in swept:
+                    # Once per round, after the sweep is banked: pin down
+                    # whether the Mosaic outage is infra-wide or tripped
+                    # by our kernel (benchmarks/mosaic_diag.py).
+                    diag = _run_json(
+                        [sys.executable, "-m", "benchmarks.mosaic_diag"],
+                        480.0,
+                    )
+                    if diag.get("cases"):
+                        _record("mosaic_diag", diag)
+                        swept.add("mosaic_diag")
+                    else:
+                        # transient failure (e.g. tunnel died mid-diag):
+                        # keep the once-per-round slot for a later window
+                        _log(f"mosaic_diag: {diag.get('error', '?')}")
             interval = REFRESH_INTERVAL if head is not None else PROBE_INTERVAL
         else:
             _log(f"probe #{n_probe}: down "

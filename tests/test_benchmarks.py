@@ -97,3 +97,30 @@ def test_churn_soak_short():
     )
     assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
     assert "PASS" in out.stdout
+
+
+def test_mosaic_diag_interpret_cases():
+    """The Mosaic-outage diagnostic's cheap pallas cases run (interpret
+    mode) and the script emits its one JSON verdict line; the flagship
+    case is exercised by the heavy kernel tier's interpret tests."""
+    env = dict(os.environ)
+    env.update(TPUNODE_DIAG_INTERPRET="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "from benchmarks import mosaic_diag as d;"
+            "import json;"
+            "print(json.dumps([d._case('trivial', d._trivial),"
+            "                  d._case('field_mul', d._field_mul)]))",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=150,
+    )
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    cases = json.loads(out.stdout.strip().splitlines()[-1])
+    assert [c["ok"] for c in cases] == [True, True], cases
