@@ -10,9 +10,13 @@ the two possible causes when an uptime window allows:
    in-repo).
 2. ``field_mul`` — one pallas_field.mul over a (24, 256) block, the verify
    kernel's core op.  Separates "our field formulas" from "any kernel".
-3. ``flagship`` — the real ``verify_blocked`` at batch 256 (one block).
-   If only this fails, something the r4 lanes added trips the helper and
-   an in-repo fix is worth hunting.
+3. ``table_build`` — VMEM scratch table via pl.ds dynamic stores in a
+   fori_loop (the kernel's r3-era Q-table pattern).
+4. ``pow_window`` — the r4-added windowed pow with dynamic scalar digit
+   loads from a (2, 64) ref (pallas_kernel.py:190-215), the top suspect.
+5. ``flagship`` — the real ``verify_blocked`` at batch 256 (one block).
+   The first failing construct names the thing to fix (or, if only
+   flagship fails, the interaction/size is the problem).
 
 Run by benchmarks/watcher.py once per round after its first successful
 device sweep (or by hand: ``python -m benchmarks.mosaic_diag``).  Prints
@@ -102,6 +106,114 @@ def _field_mul() -> None:
         assert got == (av[i] * bv[i]) % F.P, (i, got)
 
 
+def _table_build() -> None:
+    """The r3-era construct: a VMEM scratch table built with pl.ds
+    dynamic stores inside a fori_loop (the kernel's Q-table pattern).
+    Passing this while pow_window fails localizes the outage to the
+    r4 digit-window construct."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpunode.verify import field as F
+    from tpunode.verify import pallas_field as PF
+
+    b = 256
+
+    def kernel(a_ref, o_ref, tab_ref):
+        one = jnp.concatenate(
+            [jnp.ones((1, b), jnp.int32),
+             jnp.zeros((F.NLIMBS - 1, b), jnp.int32)], axis=0)
+        a = a_ref[...]
+        tab_ref[0] = one
+        tab_ref[1] = a
+
+        def step(k, carry):
+            tab_ref[pl.ds(k, 1)] = PF.mul(
+                tab_ref[pl.ds(k - 1, 1)][0], a)[None]
+            return carry
+
+        lax.fori_loop(2, 16, step, 0)
+        o_ref[...] = PF.canonical(tab_ref[15])
+
+    rng = np.random.default_rng(11)
+    av = [int(rng.integers(1, 2**61)) for _ in range(b)]
+    a = jnp.asarray(np.stack([F.to_limbs(v) for v in av], axis=1))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        scratch_shapes=[pltpu.VMEM((16, F.NLIMBS, b), jnp.int32)],
+        interpret=_INTERPRET,
+    )(a)
+    got = F.from_limbs(np.asarray(out)[:, 0])
+    assert got == pow(av[0], 15, F.P), got
+
+
+def _pow_window() -> None:
+    """The r4-added construct: windowed constant-exponent pow with the
+    digit sequence in a (2, 64) int32 ref read by a dynamic scalar index
+    inside the window fori_loop (the kernel's jacobi/Fermat lowering,
+    pallas_kernel.py:190-215) — the top suspect for the Mosaic 500s."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpunode.verify import field as F
+    from tpunode.verify import pallas_field as PF
+
+    b = 256
+    exp = (F.P - 1) // 2
+    digits = [(exp >> (4 * (63 - w))) & 0xF for w in range(64)]
+
+    def kernel(a_ref, dig_ref, o_ref, powtab_ref):
+        one = jnp.concatenate(
+            [jnp.ones((1, b), jnp.int32),
+             jnp.zeros((F.NLIMBS - 1, b), jnp.int32)], axis=0)
+        t = a_ref[...]
+        powtab_ref[0] = one
+        powtab_ref[1] = t
+
+        def build(k, carry):
+            powtab_ref[pl.ds(k, 1)] = PF.mul(
+                powtab_ref[pl.ds(k - 1, 1)][0], t)[None]
+            return carry
+
+        lax.fori_loop(2, 16, build, 0)
+
+        def window(w, pacc):
+            pacc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(pacc))))
+            d = dig_ref[0, w]
+            sel = None
+            for tv in range(16):
+                contrib = jnp.where(d == tv, powtab_ref[tv], 0)
+                sel = contrib if sel is None else sel + contrib
+            return PF.mul(pacc, sel)
+
+        pacc = lax.fori_loop(0, 64, window, one)
+        o_ref[...] = PF.canonical(pacc)
+
+    rng = np.random.default_rng(13)
+    av = [int(rng.integers(2, 2**61)) ** 2 % F.P for _ in range(b)]  # QRs
+    a = jnp.asarray(np.stack([F.to_limbs(v) for v in av], axis=1))
+    dig = jnp.asarray(
+        np.stack([digits, digits], axis=0).astype(np.int32))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        scratch_shapes=[pltpu.VMEM((16, F.NLIMBS, b), jnp.int32)],
+        interpret=_INTERPRET,
+    )(a, dig)
+    for i in (0, b - 1):
+        got = F.from_limbs(np.asarray(out)[:, i])
+        assert got == pow(av[i], exp, F.P) == 1, (i, got)
+
+
 def _flagship() -> None:
     import jax.numpy as jnp
 
@@ -150,7 +262,8 @@ def main() -> None:
         print(json.dumps(res))
         return
     for name, fn in (("trivial", _trivial), ("field_mul", _field_mul),
-                     ("flagship", _flagship)):
+                     ("table_build", _table_build),
+                     ("pow_window", _pow_window), ("flagship", _flagship)):
         out = _case(name, fn)
         res["cases"].append(out)
         if name == "trivial" and not out["ok"]:
@@ -161,9 +274,9 @@ def main() -> None:
         if all(oks.values()):
             res["verdict"] = "mosaic healthy (outage over?)"
         elif oks.get("trivial") and not oks.get("flagship"):
-            res["verdict"] = ("repo: flagship kernel trips the helper"
-                              + ("" if oks.get("field_mul")
-                                 else " (field ops already fail)"))
+            first_bad = next(
+                (c["case"] for c in res["cases"] if not c["ok"]), "?")
+            res["verdict"] = f"repo: first failing construct = {first_bad}"
     print(json.dumps(res))
 
 
