@@ -226,3 +226,108 @@ def test_engine_raw_sync_from_native_extract():
     got = eng.verify_raw_sync(raw)
     assert got == eng.verify_sync(raw.to_verify_items())
     assert False in got and True in got
+
+
+@pytest.mark.asyncio
+async def test_engine_big_shape_failure_degrades_not_fails(monkeypatch):
+    """A Mosaic-outage shape: the small device shape compiles and
+    cross-checks but device_batch does not (engine.BigShapeFailed) —
+    the engine must stay on the device path chunked at batch_size
+    instead of pinning itself to the CPU engine."""
+    from tpunode.verify.engine import BigShapeFailed
+
+    def big_shape_boom(bs, db=0):
+        raise BigShapeFailed("tpu:fake", "MosaicError: HTTP 500")
+
+    monkeypatch.setattr(VerifyEngine, "_warmup_fn", staticmethod(big_shape_boom))
+    cfg = VerifyConfig(backend="auto", max_wait=0.0, batch_size=64,
+                       device_batch=4096, min_tpu_batch=10**9)
+    async with VerifyEngine(cfg) as eng:
+        eng._warmup_done.wait(5)
+        assert eng.device_state == "ready"
+        assert eng._device_kind == "tpu:fake"
+        assert eng._device_batch == 64  # degraded to the small shape
+        assert cfg.device_batch == 4096  # caller's config untouched
+        # min_tpu_batch forces CPU for the actual verify (no real device)
+        items, expected = make_items(4, tamper_every=2)
+        assert await eng.verify(items) == expected
+
+
+def test_run_tpu_recovers_from_collect_time_mosaic_error(monkeypatch):
+    """JAX async dispatch surfaces Mosaic RUNTIME failures at collect
+    time, not at the dispatch call: _run_tpu must mark pallas broken and
+    re-run the chunk through the (now XLA) dispatch instead of failing
+    the batch and staying pinned to the broken path."""
+    import tpunode.verify.kernel as K
+    from tpunode.verify.raw import pack_items
+
+    items, expected = make_items(6, tamper_every=2)
+    raw = pack_items([it if len(it) > 4 else tuple(it) for it in items])
+
+    calls = {"dispatch": 0, "collect": 0}
+
+    def fake_dispatch(chunk, pad_to=None):
+        calls["dispatch"] += 1
+        return ("fake-array", len(chunk))
+
+    def fake_collect(arr, count):
+        calls["collect"] += 1
+        if calls["collect"] == 1:
+            raise RuntimeError(
+                "MosaicError: INTERNAL: remote_compile: HTTP 500"
+            )
+        return expected
+
+    monkeypatch.setattr(K, "_PALLAS_BROKEN", False)
+    monkeypatch.setattr(K, "dispatch_batch_tpu_raw", fake_dispatch)
+    monkeypatch.setattr(K, "collect_verdicts", fake_collect)
+    eng = VerifyEngine(
+        VerifyConfig(backend="cpu", warmup=False, min_tpu_batch=1)
+    )
+    assert eng._run_tpu([raw]) == expected
+    assert calls == {"dispatch": 2, "collect": 2}  # one retry, then good
+    assert K.pallas_broken()
+
+    # non-Mosaic collect failures still propagate
+    monkeypatch.setattr(K, "_PALLAS_BROKEN", False)
+    calls["collect"] = 10  # force the non-raising branch off
+    def bad_collect(arr, count):
+        raise ValueError("device OOM")
+    monkeypatch.setattr(K, "collect_verdicts", bad_collect)
+    with pytest.raises(ValueError, match="device OOM"):
+        eng._run_tpu([raw])
+    assert not K.pallas_broken()
+
+
+def test_warmup_recovers_from_collect_time_mosaic_error(monkeypatch):
+    """A Mosaic failure surfacing INSIDE warmup's small-shape cross-check
+    (collect time, past _dispatch_prep's compile-stage catch) must mark
+    pallas broken and retry via the XLA program — not fail warmup and pin
+    the engine to CPU."""
+    import types
+
+    import jax as _jax
+
+    import tpunode.verify.kernel as K
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+    from tpunode.verify.engine import _device_warmup
+
+    calls = {"n": 0}
+
+    def fake_vbt(items, pad_to=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("MosaicError: INTERNAL: remote_compile 500")
+        return verify_batch_cpu(items)
+
+    monkeypatch.setattr(K, "_PALLAS_BROKEN", False)
+    monkeypatch.setattr(K, "verify_batch_tpu", fake_vbt)
+    monkeypatch.setattr(
+        _jax, "devices",
+        lambda *a: [types.SimpleNamespace(platform="tpu",
+                                          device_kind="fake")],
+    )
+    kind = _device_warmup(16, 32)
+    assert kind == "tpu:fake"
+    assert K.pallas_broken()
+    assert calls["n"] == 3  # failed small, retried small, big shape

@@ -71,6 +71,17 @@ def enable_compile_cache(path: Optional[str] = None) -> None:
         log.debug("compilation cache unavailable: %s", e)
 
 
+class BigShapeFailed(RuntimeError):
+    """Warmup outcome: the small device shape compiled and cross-checked
+    but the steady-state ``device_batch`` shape did not compile.  Carries
+    the device kind so the engine can stay on the device path with
+    ``device_batch`` degraded to ``batch_size``."""
+
+    def __init__(self, kind: str, error: str):
+        super().__init__(error)
+        self.kind = kind
+
+
 def _device_warmup(batch_size: int, device_batch: int = 0) -> str:
     """Default warmup body (runs in a daemon thread): init the backend,
     compile the kernel at the engine's fixed batch shapes (the small
@@ -126,16 +137,39 @@ def _device_warmup(batch_size: int, device_batch: int = 0) -> str:
             z ^= 1
         items.append((pub, z, r, s))
         expect.append(i % 3 != 2)
-    got = verify_batch_tpu(items, pad_to=batch_size)
+    from .kernel import mark_pallas_broken_if_mosaic
+
+    kind = f"{devs[0].platform}:{getattr(devs[0], 'device_kind', '?')}"
+    try:
+        got = verify_batch_tpu(items, pad_to=batch_size)
+    except Exception as e:  # noqa: BLE001 — only Mosaic retried
+        # A Mosaic RUNTIME failure surfaces at collect time inside
+        # verify_batch_tpu, past _dispatch_prep's compile-stage catch:
+        # mark pallas broken and retry once through the XLA program
+        # instead of pinning the engine to CPU for the whole process.
+        if not mark_pallas_broken_if_mosaic(e, where="during warmup"):
+            raise
+        got = verify_batch_tpu(items, pad_to=batch_size)
     if got != expect:
         raise RuntimeError("device/oracle verdict mismatch during warmup")
     if device_batch and device_batch != batch_size:
-        got = verify_batch_tpu(items, pad_to=device_batch)
+        try:
+            got = verify_batch_tpu(items, pad_to=device_batch)
+        except Exception as e:  # noqa: BLE001 — verdict errors re-raised below
+            # The small shape works but the steady-state shape doesn't
+            # compile (e.g. the XLA fallback at 32768 during a Mosaic
+            # outage): keep the device path, chunk at the small shape.
+            # (A Mosaic error here is unreachable in practice — the
+            # small-shape pass above already forced the XLA program —
+            # and degrading to the known-good small shape handles it.)
+            raise BigShapeFailed(
+                kind, f"{type(e).__name__}: {e}"[:300]
+            ) from e
         if got != expect:
             raise RuntimeError(
                 "device/oracle verdict mismatch at device_batch"
             )
-    return f"{devs[0].platform}:{getattr(devs[0], 'device_kind', '?')}"
+    return kind
 
 
 @dataclass
@@ -195,6 +229,10 @@ class VerifyEngine:
             from .cpu_native import load_native_verifier
 
             self._cpu = load_native_verifier()
+        # Steady-state device shape actually in use: starts at the config
+        # value, degraded to batch_size if the big shape fails to compile
+        # (never written back into the caller's cfg).
+        self._device_batch = self.cfg.device_batch
         # device readiness state machine: cold -> warming -> ready | failed
         self._device_state = "cold"
         self._device_kind = ""
@@ -221,6 +259,19 @@ class VerifyEngine:
             try:
                 kind = type(self)._warmup_fn(
                     self.cfg.batch_size, self.cfg.device_batch
+                )
+            except BigShapeFailed as e:
+                # Small shape is good; stay on the device path chunked at
+                # the small shape instead of losing the device entirely.
+                self._device_batch = self.cfg.batch_size
+                self._device_kind = e.kind
+                self._device_state = "ready"
+                log.warning(
+                    "[Engine] device ready (%s) but device_batch shape "
+                    "failed to compile (%s) — chunking at batch_size=%d",
+                    e.kind,
+                    e,
+                    self.cfg.batch_size,
                 )
             except Exception as e:  # noqa: BLE001 — any failure disables tpu
                 self._device_error = f"{type(e).__name__}: {e}"
@@ -302,7 +353,7 @@ class VerifyEngine:
             # linger briefly to let a fuller batch accumulate; once the
             # device is up, aim for the big steady-state shape
             target = (
-                self.cfg.device_batch
+                self._device_batch
                 if self._device_state == "ready"
                 else self.cfg.batch_size
             )
@@ -417,11 +468,16 @@ class VerifyEngine:
         N runs on the device (JAX async dispatch), so neither side idles.
         A sub-``min_tpu_batch`` remainder goes to the CPU engine instead of
         paying a full near-empty device step (forced-tpu backend excepted)."""
-        from .kernel import collect_verdicts, dispatch_batch_tpu_raw
+        from .kernel import (
+            collect_verdicts,
+            dispatch_batch_tpu_raw,
+            mark_pallas_broken_if_mosaic,
+        )
 
         raw = concat_raw([as_raw_batch(p) for p in payloads])
-        B = self.cfg.device_batch
-        pending: list = []  # (device array, count) | list[bool]
+        B = self._device_batch
+        # (chunk | None, pad, (device array, count) | list[bool])
+        pending: list = []
         for i in range(0, len(raw), B):
             chunk = raw.slice(i, i + B)
             if (
@@ -429,15 +485,32 @@ class VerifyEngine:
                 and self.cfg.backend != "tpu"
                 and self._cpu is not None
             ):
-                pending.append(self._cpu.verify_raw(chunk))
+                pending.append((None, 0, self._cpu.verify_raw(chunk)))
                 metrics.inc("verify.cpu_items", len(chunk))
             else:
                 # small tails take the small compiled shape, not a mostly
                 # empty device_batch step
                 pad = B if len(chunk) > self.cfg.batch_size else self.cfg.batch_size
-                pending.append(dispatch_batch_tpu_raw(chunk, pad_to=pad))
+                pending.append(
+                    (chunk, pad, dispatch_batch_tpu_raw(chunk, pad_to=pad))
+                )
                 metrics.inc("verify.tpu_items", len(chunk))
         out: list[bool] = []
-        for p in pending:
-            out.extend(p if isinstance(p, list) else collect_verdicts(*p))
+        for chunk, pad, p in pending:
+            if isinstance(p, list):
+                out.extend(p)
+                continue
+            try:
+                out.extend(collect_verdicts(*p))
+            except Exception as e:  # noqa: BLE001 — only Mosaic recovered
+                # JAX async dispatch: a Mosaic RUNTIME failure surfaces
+                # here, not at the dispatch call.  Mark pallas broken and
+                # re-run this chunk once through the XLA program.
+                if not mark_pallas_broken_if_mosaic(e):
+                    raise
+                out.extend(
+                    collect_verdicts(
+                        *dispatch_batch_tpu_raw(chunk, pad_to=pad)
+                    )
+                )
         return out
