@@ -134,3 +134,33 @@ def test_sharded_mixed_algorithms():
     got = verify_batch_sharded(items, mesh=mesh)
     assert got == expect
     assert True in expect and False in expect
+
+
+def test_sharded_falls_back_to_xla_on_mosaic_error(monkeypatch):
+    """r5 Mosaic outage inside shard_map: a pallas trace/compile failure
+    must mark pallas broken and re-run the batch through the XLA program
+    on the same mesh (this is what keeps BASELINE config5 alive when the
+    compile helper 500s)."""
+    import tpunode.verify.kernel as K
+    import tpunode.verify.multichip as MC
+    import tpunode.verify.pallas_kernel as PK
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+
+    def mosaic_boom(*a, **k):
+        raise RuntimeError("MosaicError: INTERNAL: remote_compile: HTTP 500")
+
+    monkeypatch.setattr(K, "_PALLAS_BROKEN", False)
+    monkeypatch.setattr(MC, "_mesh_is_tpu", lambda mesh: True)
+    monkeypatch.setattr(PK, "verify_blocked_impl", mosaic_boom)
+    MC._FN_CACHE.clear()
+    try:
+        mesh = MC.make_mesh()
+        items, _ = make_items(16)
+        got = MC.verify_batch_sharded(items, mesh=mesh)
+        assert got == verify_batch_cpu(items)
+        assert K.pallas_broken()
+        # later calls skip pallas up front (auto + broken flag -> xla)
+        got2 = MC.verify_batch_sharded(items, mesh=mesh)
+        assert got2 == got
+    finally:
+        MC._FN_CACHE.clear()

@@ -37,7 +37,13 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from .ecdsa_cpu import Point
-from .kernel import ARG_IS_2D, prepare_batch, verify_core
+from .kernel import (
+    ARG_IS_2D,
+    mark_pallas_broken_if_mosaic,
+    pallas_broken,
+    prepare_batch,
+    verify_core,
+)
 
 __all__ = ["make_mesh", "sharded_verify_fn", "verify_batch_sharded"]
 
@@ -81,7 +87,9 @@ def sharded_verify_fn(
     """
     if kernel not in ("auto", "pallas", "xla"):
         raise ValueError(f"unknown kernel {kernel!r}: auto|pallas|xla")
-    use_pallas = kernel == "pallas" or (kernel == "auto" and _mesh_is_tpu(mesh))
+    use_pallas = kernel == "pallas" or (
+        kernel == "auto" and _mesh_is_tpu(mesh) and not pallas_broken()
+    )
     cached = _FN_CACHE.get((mesh, use_pallas, interpret, block))
     if cached is not None:
         return cached
@@ -149,7 +157,7 @@ def verify_batch_sharded(
     n = mesh.devices.size
     # Pallas shards need BLOCK-aligned per-shard batches; XLA just needs a
     # multiple of the mesh size.
-    if _mesh_is_tpu(mesh):
+    if _mesh_is_tpu(mesh) and not pallas_broken():
         from .pallas_kernel import BLOCK
 
         quantum = n * BLOCK
@@ -167,5 +175,15 @@ def verify_batch_sharded(
         jax.device_put(np.asarray(a), shard_2d if is2d else shard_1d)
         for a, is2d in zip(prep.device_args, ARG_IS_2D)
     ]
-    ok, _total = fn(*args)
-    return [bool(b) for b in np.asarray(ok)[: prep.count]]
+    try:
+        ok, _total = fn(*args)
+        return [bool(b) for b in np.asarray(ok)[: prep.count]]
+    except Exception as e:  # noqa: BLE001 — only Mosaic recovered
+        # Same Mosaic-outage fallback as the single-chip dispatch
+        # (kernel._dispatch_prep): mark pallas broken process-wide and
+        # re-run once through the XLA program sharded over the same mesh.
+        if not mark_pallas_broken_if_mosaic(e, where="in shard_map"):
+            raise
+        fn = sharded_verify_fn(mesh, kernel="xla")
+        ok, _total = fn(*args)
+        return [bool(b) for b in np.asarray(ok)[: prep.count]]
