@@ -46,7 +46,10 @@ from benchmarks.common import (  # noqa: E402
 RUNS_PATH = os.path.join(REPO, "benchmarks", "device_runs.jsonl")
 PREV_RUNS_PATH = RUNS_PATH + ".prev"
 
-PROBE_INTERVAL = float(os.environ.get("TPUNODE_WATCHER_PROBE_INTERVAL", 240))
+# Uptime windows can be ~9 min (observed r5): a 240s gap between probes
+# could eat half a window, so probe every 150s (each probe is mostly a
+# network-blocked subprocess; ~3s of CPU for the jax import).
+PROBE_INTERVAL = float(os.environ.get("TPUNODE_WATCHER_PROBE_INTERVAL", 150))
 PROBE_TIMEOUT = float(os.environ.get("TPUNODE_WATCHER_PROBE_TIMEOUT", 150))
 # After a fully-successful sweep, re-probe less often and only refresh the
 # cheap headline (the compile caches are warm by then).
@@ -218,6 +221,7 @@ def main() -> None:
     n_probe = 0
     while time.time() < deadline:
         n_probe += 1
+        tick = time.time()
         p = probe()
         if p.get("ok") and p.get("platform") == "tpu":
             _log(f"probe #{n_probe}: TPU UP "
@@ -253,7 +257,11 @@ def main() -> None:
             _log(f"probe #{n_probe}: down "
                  f"({p.get('error') or 'platform=' + str(p.get('platform'))})")
             interval = PROBE_INTERVAL
-        time.sleep(max(5.0, min(interval, deadline - time.time())))
+        # Interval measures probe-start to probe-start: a timed-out probe
+        # (150s) must not ADD a full sleep on top, or the real gap doubles
+        # and can eat most of a short uptime window.
+        elapsed = time.time() - tick
+        time.sleep(max(5.0, min(interval - elapsed, deadline - time.time())))
     _log(f"watcher deadline reached after {n_probe} probes; "
          f"configs captured on-device: {sorted(swept) or 'none'}")
 

@@ -12,6 +12,8 @@ import json
 import os
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -291,3 +293,70 @@ def test_output_is_single_json_line_with_required_keys(monkeypatch):
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in line
     assert isinstance(line["value"], (int, float))  # numeric even on total loss
+
+
+def test_watcher_headline_ladder_mosaic_skip(monkeypatch):
+    """run_headline: a MosaicError on a pallas rung skips the remaining
+    pallas rungs, banks the first XLA success, and remembers the outage
+    so the next sweep leads with one short pallas probe then XLA."""
+    from benchmarks import watcher as W
+
+    monkeypatch.setattr(W, "_mosaic_broken", False)
+    recorded = []
+    monkeypatch.setattr(W, "_record", lambda kind, p: recorded.append((kind, p)))
+    seen = []
+
+    def fake_run(argv, timeout, env=None):
+        batch = int(env["TPUNODE_BENCH_BATCH"])
+        kernel = env.get("TPUNODE_BENCH_KERNEL")
+        seen.append((batch, kernel))
+        if kernel is None:
+            return {"ok": False, "error": "MosaicError: INTERNAL: HTTP 500"}
+        if batch == 16384:
+            return {"ok": False, "error": "timed out after 420s"}
+        return {"ok": True, "rate": 41000.0, "device": "tpu:v5e",
+                "kernel": "xla", "batch": batch}
+
+    monkeypatch.setattr(W, "_run_json", fake_run)
+    res = W.run_headline()
+    assert res is not None and res["kernel"] == "xla"
+    # first sweep: one pallas rung, then straight to the XLA rungs
+    assert seen == [(32768, None), (16384, "xla"), (8192, "xla")]
+    assert recorded and recorded[0][0] == "headline"
+    assert W._mosaic_broken
+
+    # next sweep leads with ONE short pallas probe, then XLA
+    seen.clear()
+    W.run_headline()
+    assert seen[0] == (32768, None)
+    assert all(k == "xla" for _, k in seen[1:])
+
+    # a pallas success clears the flag
+    seen.clear()
+    monkeypatch.setattr(
+        W, "_run_json",
+        lambda argv, t, env=None: {"ok": True, "rate": 210000.0,
+                                   "device": "tpu:v5e", "kernel": "pallas",
+                                   "batch": 32768},
+    )
+    res = W.run_headline()
+    assert res["kernel"] == "pallas"
+    assert not W._mosaic_broken
+
+
+def test_watcher_headline_fatal_poisons(monkeypatch):
+    """A device/oracle verdict mismatch records a fatal row and raises —
+    it must never be retried past or masked by a later rung."""
+    from benchmarks import watcher as W
+
+    monkeypatch.setattr(W, "_mosaic_broken", False)
+    recorded = []
+    monkeypatch.setattr(W, "_record", lambda kind, p: recorded.append((kind, p)))
+    monkeypatch.setattr(
+        W, "_run_json",
+        lambda argv, t, env=None: {"ok": False, "fatal": True,
+                                   "error": "device/oracle verdict mismatch"},
+    )
+    with pytest.raises(W.FatalMismatch):
+        W.run_headline()
+    assert recorded == [("fatal", {"error": "device/oracle verdict mismatch"})]
