@@ -39,6 +39,54 @@ def _emit(obj: dict) -> None:
 # --- config 1: block-800000-shaped tx set, CPU single-core baseline -------
 
 
+
+def _device_batch_override() -> int:
+    """TPUNODE_DEVICE_BATCH, or 0 when unset/invalid (never raises: a bad
+    knob must not kill a config before its JSON line)."""
+    raw = os.environ.get("TPUNODE_DEVICE_BATCH", "").strip()
+    if not raw:
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        print(f"[run] ignoring bad TPUNODE_DEVICE_BATCH={raw!r}",
+              file=sys.stderr)
+        return 0
+
+
+def _verify_cfg(**kw):
+    """VerifyConfig with an optional TPUNODE_DEVICE_BATCH override.
+
+    The watcher sets it during a Mosaic outage: the engine then falls
+    back to the XLA program, whose 32768-shape server-side compile could
+    stall warmup past the config budget — a modest steady-state shape
+    (XLA throughput plateaus by 8192 anyway, PERF.md r3 table) keeps the
+    device run inside its watchdog."""
+    from tpunode.verify.engine import VerifyConfig
+
+    db = _device_batch_override()
+    if db:
+        kw["device_batch"] = db
+    return VerifyConfig(**kw)
+
+
+def _kernel_provenance() -> dict:
+    """Outage provenance for device-config rows in device_runs.jsonl: an
+    XLA-fallback run must be distinguishable from a pallas steady-state
+    one (review r5)."""
+    out = {}
+    try:
+        from tpunode.verify.kernel import pallas_broken
+
+        if pallas_broken():
+            out["pallas_broken"] = True
+    except Exception:
+        pass
+    db = _device_batch_override()
+    if db:
+        out["device_batch_override"] = db
+    return out
+
 def config1() -> None:
     """Single big-block tx set through the C++ CPU verifier (single core).
     This IS the baseline reference point (BASELINE.md config 1): mainnet
@@ -144,6 +192,7 @@ def config2() -> None:
             "wall_s": round(dt, 4),
             "baseline_engine": cpu_engine,
             "note": "includes host prep each batch (end-to-end dispatch)",
+            **_kernel_provenance(),
         }
     )
 
@@ -166,7 +215,6 @@ def config3() -> None:
     from tpunode.node import Node, NodeConfig, TxVerdict, VerifyShed
     from tpunode.params import BCH_REGTEST
     from tpunode.peer import get_blocks
-    from tpunode.verify.engine import VerifyConfig
     from tpunode.wire import (
         HEADER_SIZE,
         InvType,
@@ -270,7 +318,7 @@ def config3() -> None:
             peers=["192.0.2.9:8333"],
             discover=False,
             connect=connect_factory,
-            verify=VerifyConfig(max_wait=0.004),
+            verify=_verify_cfg(max_wait=0.004),
             prevout_lookup=synth_prevout,
         )
         stats = {
@@ -353,6 +401,7 @@ def config3() -> None:
             "note": "end-to-end through the full node: wire framing, "
                     "lazy blocks, C++ extract, batch engine, TxVerdict bus",
             "device": _device_kind(),
+            **_kernel_provenance(),
         }
     )
 
@@ -371,7 +420,6 @@ def config4() -> None:
     from tpunode.node import Node, NodeConfig, TxVerdict
     from tpunode.params import BCH_REGTEST
     from tpunode.store import MemoryKV
-    from tpunode.verify.engine import VerifyConfig
     from tpunode.wire import MsgTx, encode_message
     from benchmarks.txgen import gen_mixed_txs, synth_prevout
     from tests.fakenet import QueueConnection, _fake_remote
@@ -441,7 +489,7 @@ def config4() -> None:
             discover=False,
             max_peers=n_peers,
             connect=lambda sa: firehose_connect(),
-            verify=VerifyConfig(batch_size=batch, max_wait=0.005),
+            verify=_verify_cfg(batch_size=batch, max_wait=0.005),
             prevout_lookup=synth_prevout,
         )
         verdicts = 0
@@ -476,6 +524,7 @@ def config4() -> None:
             "shed_txs": shed,
             "wall_s": round(dt, 2),
             "device": _device_kind(),
+            **_kernel_provenance(),
         }
     )
 
@@ -503,14 +552,31 @@ def config5() -> None:
         items[: 4 * n_dev]
     )
     expected = _tile([bool(b) for b in verify_batch_cpu(uniq)], total)
+    # Mosaic-outage knob: one whole-batch program normally; during an
+    # outage the XLA fallback must not compile at the ~150k shape, so the
+    # batch is driven in fixed device_batch-sized chunks instead (one
+    # modest compile, reused).
+    db = _device_batch_override()
+    step = n_dev * db if db else total
+
+    def run_all():
+        out = []
+        for off in range(0, total, step):
+            out.extend(
+                verify_batch_sharded(
+                    items[off : off + step], mesh=mesh, pad_to=step
+                )
+            )
+        return out
+
     # warm (compile) outside the timed window, then time steady state: the
     # 32MB-block config measures sustained verify throughput, not XLA
     t0 = time.perf_counter()
-    out = verify_batch_sharded(items, mesh=mesh)
+    out = run_all()
     compile_s = time.perf_counter() - t0
     assert out == expected
     t0 = time.perf_counter()
-    out = verify_batch_sharded(items, mesh=mesh)
+    out = run_all()
     dt = time.perf_counter() - t0
     assert out == expected
     _emit(
@@ -524,6 +590,7 @@ def config5() -> None:
             "sigs": total,
             "wall_s": round(dt, 3),
             "first_call_s": round(compile_s, 3),
+            **_kernel_provenance(),
         }
     )
 
