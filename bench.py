@@ -351,11 +351,15 @@ def main() -> None:
     else:
         # Dead/slow tunnel: one last-chance small-batch attempt (the probe
         # itself may have nudged the relay awake), then the cpu fallback.
+        # If the in-round watcher banked its headline via the XLA kernel
+        # (Mosaic outage), aim the last chance at the known-working path.
         attempts.append(
             "probe: "
             + str(probe.get("error") or f"platform={probe.get('platform')}")
         )
-        ladder = ((4096, 150.0, None),)
+        hint = _freshest_device_run()
+        kern = "xla" if (hint and hint.get("kernel") == "xla") else None
+        ladder = ((4096, 150.0, kern),)
     from benchmarks.common import worker_rung_env
 
     # Hard ceiling on total ladder time: however many rungs fail slowly,

@@ -360,3 +360,58 @@ def test_watcher_headline_fatal_poisons(monkeypatch):
     with pytest.raises(W.FatalMismatch):
         W.run_headline()
     assert recorded == [("fatal", {"error": "device/oracle verdict mismatch"})]
+
+
+def _batch_kernel(n, kernel):
+    return lambda mode, env: (
+        mode == "--worker" and env.get("TPUNODE_BENCH_BATCH") == str(n)
+        and env.get("TPUNODE_BENCH_KERNEL") == kernel
+    )
+
+
+def test_mosaic_error_skips_to_xla_rungs(monkeypatch):
+    """bench main: a MosaicError on the first pallas rung skips the
+    remaining pallas rungs and lands the XLA fallback rung (r5 outage)."""
+    bench = _load_bench()
+    line, calls, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch_kernel(8192, "xla"),
+             {"ok": True, "rate": 41000.0, "device": "tpu:v5e",
+              "kernel": "xla", "batch": 8192}),
+            (_batch(32768), {"ok": False,
+                             "error": "MosaicError: INTERNAL: HTTP 500"}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 41000.0 and line["kernel"] == "xla"
+    # probe, one pallas attempt, then straight to the xla rung
+    assert len(calls) == 3
+    assert "tpu-xla@8192: ok" in line["attempts"]
+
+
+def test_dead_probe_last_chance_uses_watcher_kernel_hint(monkeypatch):
+    """With the probe dead and an in-round watcher headline banked via
+    the XLA kernel, the last-chance rung targets the known-working
+    kernel instead of the (broken) pallas auto-selection."""
+    bench = _load_bench()
+    run = {"kind": "headline", "value": 41000.0, "device": "tpu:v5e",
+           "kernel": "xla", "batch": 8192, "unix": 10**10, "ts": "t"}
+    line, calls, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": False, "error": "timed out after 120s"}),
+            (_batch_kernel(4096, "xla"),
+             {"ok": False, "error": "timed out after 150s"}),
+        ],
+        device_run=run,
+    )
+    assert rc == 0
+    # the last-chance attempt carried the xla hint...
+    assert any(c[2].get("TPUNODE_BENCH_KERNEL") == "xla" for c in calls)
+    # ...and the watcher sample was reported with provenance
+    assert line["provenance"] == "in-round-watcher"
+    assert line["value"] == 41000.0
