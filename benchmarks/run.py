@@ -162,10 +162,15 @@ def config2() -> None:
     batch = 128 if SMALL else 4096
     uniq = _make_triples(min(total, 512))
     items = _tile(uniq, total)
-    # correctness first: one chunk vs oracle (also compiles outside timing)
-    assert verify_batch_tpu(items[:64], pad_to=batch) == verify_batch_cpu(
-        items[:64]
+    # correctness first: one chunk vs oracle (also compiles outside timing).
+    # A Mosaic RUNTIME failure surfaces here (compile-stage ones are already
+    # handled inside dispatch): mark pallas broken, retry once via XLA.
+    from tpunode.verify.kernel import with_mosaic_fallback
+
+    got = with_mosaic_fallback(
+        lambda: verify_batch_tpu(items[:64], pad_to=batch), "in config2"
     )
+    assert got == verify_batch_cpu(items[:64])
     # steady state: pipelined dispatch — chunk N+1 host-preps while chunk N
     # runs on the device (the engine's production pattern)
     t0 = time.perf_counter()

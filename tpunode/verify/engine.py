@@ -137,19 +137,17 @@ def _device_warmup(batch_size: int, device_batch: int = 0) -> str:
             z ^= 1
         items.append((pub, z, r, s))
         expect.append(i % 3 != 2)
-    from .kernel import mark_pallas_broken_if_mosaic
+    from .kernel import with_mosaic_fallback
 
     kind = f"{devs[0].platform}:{getattr(devs[0], 'device_kind', '?')}"
-    try:
-        got = verify_batch_tpu(items, pad_to=batch_size)
-    except Exception as e:  # noqa: BLE001 — only Mosaic retried
-        # A Mosaic RUNTIME failure surfaces at collect time inside
-        # verify_batch_tpu, past _dispatch_prep's compile-stage catch:
-        # mark pallas broken and retry once through the XLA program
-        # instead of pinning the engine to CPU for the whole process.
-        if not mark_pallas_broken_if_mosaic(e, where="during warmup"):
-            raise
-        got = verify_batch_tpu(items, pad_to=batch_size)
+    # A Mosaic RUNTIME failure surfaces at collect time inside
+    # verify_batch_tpu, past _dispatch_prep's compile-stage catch: mark
+    # pallas broken and retry once through the XLA program instead of
+    # pinning the engine to CPU for the whole process.
+    got = with_mosaic_fallback(
+        lambda: verify_batch_tpu(items, pad_to=batch_size),
+        "during warmup",
+    )
     if got != expect:
         raise RuntimeError("device/oracle verdict mismatch during warmup")
     if device_batch and device_batch != batch_size:

@@ -673,6 +673,21 @@ def mark_pallas_broken_if_mosaic(e: Exception, where: str = "at collect") -> boo
     return True
 
 
+def with_mosaic_fallback(fn, where: str):
+    """Call ``fn()``; on a Mosaic/remote-compile failure, mark pallas
+    broken process-wide and call it once more (dispatch then selects the
+    XLA program).  Non-Mosaic errors propagate.  The shared shape of the
+    outage recovery at every simple call site (engine warmup, shard_map,
+    benchmark configs); the engine's pipelined collect loop re-dispatches
+    per chunk instead and stays bespoke."""
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 — only Mosaic retried
+        if not mark_pallas_broken_if_mosaic(e, where=where):
+            raise
+        return fn()
+
+
 def _pallas_usable(batch: int) -> bool:
     """The Pallas/Mosaic kernel (pallas_kernel.py) is ~3-6x faster than the
     XLA program but TPU-only and fixed-block: use it when the padded batch

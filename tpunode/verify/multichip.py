@@ -39,10 +39,10 @@ except ImportError:  # pragma: no cover - older jax
 from .ecdsa_cpu import Point
 from .kernel import (
     ARG_IS_2D,
-    mark_pallas_broken_if_mosaic,
     pallas_broken,
     prepare_batch,
     verify_core,
+    with_mosaic_fallback,
 )
 
 __all__ = ["make_mesh", "sharded_verify_fn", "verify_batch_sharded"]
@@ -168,22 +168,18 @@ def verify_batch_sharded(
     size = (size + quantum - 1) // quantum * quantum
     prep = prepare_batch(items, pad_to=size)
 
-    fn = sharded_verify_fn(mesh)
     shard_2d = NamedSharding(mesh, P(None, "batch"))
     shard_1d = NamedSharding(mesh, P("batch"))
     args = [
         jax.device_put(np.asarray(a), shard_2d if is2d else shard_1d)
         for a, is2d in zip(prep.device_args, ARG_IS_2D)
     ]
-    try:
-        ok, _total = fn(*args)
+
+    def run():
+        # resolved inside the retry: after a Mosaic failure marks pallas
+        # broken, the auto selection yields the XLA variant (cached
+        # separately per use_pallas)
+        ok, _total = sharded_verify_fn(mesh)(*args)
         return [bool(b) for b in np.asarray(ok)[: prep.count]]
-    except Exception as e:  # noqa: BLE001 — only Mosaic recovered
-        # Same Mosaic-outage fallback as the single-chip dispatch
-        # (kernel._dispatch_prep): mark pallas broken process-wide and
-        # re-run once through the XLA program sharded over the same mesh.
-        if not mark_pallas_broken_if_mosaic(e, where="in shard_map"):
-            raise
-        fn = sharded_verify_fn(mesh, kernel="xla")
-        ok, _total = fn(*args)
-        return [bool(b) for b in np.asarray(ok)[: prep.count]]
+
+    return with_mosaic_fallback(run, "in shard_map")
