@@ -415,3 +415,24 @@ def test_dead_probe_last_chance_uses_watcher_kernel_hint(monkeypatch):
     # ...and the watcher sample was reported with provenance
     assert line["provenance"] == "in-round-watcher"
     assert line["value"] == 41000.0
+
+
+def test_watcher_run_config_passes_outage_knob(monkeypatch):
+    """During a Mosaic outage the config sweep caps the engine's
+    steady-state shape so the XLA fallback can't stall a config budget."""
+    from benchmarks import watcher as W
+
+    seen = []
+
+    def fake_run(argv, timeout, env=None):
+        seen.append((argv[-1], dict(env or {})))
+        return {"metric": "m", "value": 1.0}
+
+    monkeypatch.setattr(W, "_run_json", fake_run)
+    monkeypatch.setattr(W, "_record", lambda *a, **k: None)
+    monkeypatch.setattr(W, "_mosaic_broken", True)
+    assert W.run_config("config3") is not None
+    monkeypatch.setattr(W, "_mosaic_broken", False)
+    assert W.run_config("config2") is not None
+    assert seen[0][1].get("TPUNODE_DEVICE_BATCH") == "8192"
+    assert "TPUNODE_DEVICE_BATCH" not in seen[1][1]
