@@ -12,11 +12,14 @@ the two possible causes when an uptime window allows:
    kernel's core op.  Separates "our field formulas" from "any kernel".
 3. ``table_build`` — VMEM scratch table via pl.ds dynamic stores in a
    fori_loop (the kernel's r3-era Q-table pattern).
-4. ``pow_window`` — the r4-added windowed pow with dynamic scalar digit
-   loads from a (2, 64) ref (pallas_kernel.py:190-215), the top suspect.
-5. ``flagship`` — the real ``verify_blocked`` at batch 256 (one block).
-   The first failing construct names the thing to fix (or, if only
-   flagship fails, the interaction/size is the problem).
+4. ``pow_window`` — the r4 windowed pow with dynamic scalar digit loads
+   from a (2, 64) VMEM ref (the original suspect construct).
+5. ``pow_window_smem`` — the same pow with the digits in SMEM, the
+   canonical placement the kernel now uses (pallas_kernel.py:190-215).
+   ``pow_window`` failing while this passes confirms the VMEM read as
+   the cause and the SMEM fix as sufficient.
+6. ``flagship`` — the real ``verify_blocked`` at batch 256 (one block).
+   The failing-construct set names the thing to fix.
 
 Run by benchmarks/watcher.py once per round after its first successful
 device sweep (or by hand: ``python -m benchmarks.mosaic_diag``).  Prints
@@ -152,11 +155,15 @@ def _table_build() -> None:
     assert got == pow(av[0], 15, F.P), got
 
 
-def _pow_window() -> None:
+def _pow_window_impl(smem_digits: bool) -> None:
     """The r4-added construct: windowed constant-exponent pow with the
     digit sequence in a (2, 64) int32 ref read by a dynamic scalar index
     inside the window fori_loop (the kernel's jacobi/Fermat lowering,
-    pallas_kernel.py:190-215) — the top suspect for the Mosaic 500s."""
+    pallas_kernel.py:190-215) — the top suspect for the Mosaic 500s.
+    ``smem_digits`` selects the digit ref's memory space: False is the
+    r4 original (VMEM — the suspect), True is the canonical SMEM
+    placement the kernel now uses; their pass/fail split pins the
+    diagnosis."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -203,15 +210,29 @@ def _pow_window() -> None:
     a = jnp.asarray(np.stack([F.to_limbs(v) for v in av], axis=1))
     dig = jnp.asarray(
         np.stack([digits, digits], axis=0).astype(np.int32))
+    dig_spec = (
+        pl.BlockSpec((2, 64), memory_space=pltpu.SMEM)
+        if smem_digits
+        else pl.BlockSpec((2, 64))
+    )
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        in_specs=[pl.BlockSpec(a.shape), dig_spec],
         scratch_shapes=[pltpu.VMEM((16, F.NLIMBS, b), jnp.int32)],
         interpret=_INTERPRET,
     )(a, dig)
     for i in (0, b - 1):
         got = F.from_limbs(np.asarray(out)[:, i])
         assert got == pow(av[i], exp, F.P) == 1, (i, got)
+
+
+def _pow_window() -> None:
+    _pow_window_impl(smem_digits=False)
+
+
+def _pow_window_smem() -> None:
+    _pow_window_impl(smem_digits=True)
 
 
 def _flagship() -> None:
@@ -263,7 +284,9 @@ def main() -> None:
         return
     for name, fn in (("trivial", _trivial), ("field_mul", _field_mul),
                      ("table_build", _table_build),
-                     ("pow_window", _pow_window), ("flagship", _flagship)):
+                     ("pow_window", _pow_window),
+                     ("pow_window_smem", _pow_window_smem),
+                     ("flagship", _flagship)):
         out = _case(name, fn)
         res["cases"].append(out)
         if name == "trivial" and not out["ok"]:
@@ -271,12 +294,16 @@ def main() -> None:
             break
     else:
         oks = {c["case"]: c["ok"] for c in res["cases"]}
+        failed = [c["case"] for c in res["cases"] if not c["ok"]]
         if all(oks.values()):
             res["verdict"] = "mosaic healthy (outage over?)"
-        elif oks.get("trivial") and not oks.get("flagship"):
-            first_bad = next(
-                (c["case"] for c in res["cases"] if not c["ok"]), "?")
-            res["verdict"] = f"repo: first failing construct = {first_bad}"
+        elif failed == ["pow_window"]:
+            # The expected signature once the kernel's SMEM placement
+            # works: only the VMEM digit-read probe fails.
+            res["verdict"] = ("repo: VMEM dynamic scalar digit read "
+                              "confirmed as cause; SMEM kernel fix works")
+        elif oks.get("trivial"):
+            res["verdict"] = f"repo: failing constructs = {','.join(failed)}"
     print(json.dumps(res))
 
 

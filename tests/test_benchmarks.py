@@ -115,7 +115,9 @@ def test_mosaic_diag_interpret_cases():
             "print(json.dumps([d._case('trivial', d._trivial),"
             "                  d._case('field_mul', d._field_mul),"
             "                  d._case('table_build', d._table_build),"
-            "                  d._case('pow_window', d._pow_window)]))",
+            "                  d._case('pow_window', d._pow_window),"
+            "                  d._case('pow_window_smem',"
+            "                          d._pow_window_smem)]))",
         ],
         cwd=REPO,
         env=env,
@@ -125,4 +127,4 @@ def test_mosaic_diag_interpret_cases():
     )
     assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
     cases = json.loads(out.stdout.strip().splitlines()[-1])
-    assert [c["ok"] for c in cases] == [True] * 4, cases
+    assert [c["ok"] for c in cases] == [True] * 5, cases

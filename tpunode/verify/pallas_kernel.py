@@ -290,7 +290,14 @@ def verify_blocked_impl(
             col(F.NLIMBS),
             col(F.NLIMBS),
             col(4),
-            pl.BlockSpec((2, 64), lambda i: (0, 0)),
+            # Exponent digits live in SMEM: the kernel reads them with
+            # dynamic scalar indices inside the window fori_loop, which is
+            # scalar memory's canonical job — a VMEM block read that way
+            # is the r5 Mosaic-outage suspect (benchmarks/mosaic_diag.py
+            # probes both placements).
+            pl.BlockSpec(
+                (2, 64), lambda i: (0, 0), memory_space=pltpu.SMEM
+            ),
         ],
         out_specs=col(1),
         scratch_shapes=[
