@@ -330,7 +330,31 @@ def _freshest_device_run(path: str = DEVICE_RUNS) -> dict | None:
     return best
 
 
+BENCH_LOCK = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "benchmarks", ".bench_running"
+)
+
+
 def main() -> None:
+    # Tunnel clients block each other: the round-long watcher pauses its
+    # probing while this lock exists so the driver's round-end bench gets
+    # the device to itself (watcher ignores locks older than 30 min in
+    # case a bench dies without cleanup).
+    try:
+        with open(BENCH_LOCK, "w", encoding="utf-8") as f:
+            f.write(f"{os.getpid()} {time.time()}\n")
+    except OSError:
+        pass
+    try:
+        _main_locked()
+    finally:
+        try:
+            os.remove(BENCH_LOCK)
+        except OSError:
+            pass
+
+
+def _main_locked() -> None:
     # CPU single-core baseline first: jax-free, can't hang on TPU init.
     from benchmarks.common import cpu_single_core_bench, make_triples
 

@@ -224,7 +224,19 @@ def main() -> None:
     _log(f"watcher up (pid {os.getpid()}), deadline in "
          f"{DEADLINE_S/3600:.1f}h, probing every {PROBE_INTERVAL:.0f}s")
     n_probe = 0
+    bench_lock = os.path.join(REPO, "benchmarks", ".bench_running")
     while time.time() < deadline:
+        # The driver's round-end bench gets the tunnel to itself: clients
+        # block each other, so probing while it runs could starve the
+        # official artifact.  Stale locks (>30 min — a dead bench) are
+        # ignored.
+        try:
+            if time.time() - os.path.getmtime(bench_lock) < 1800:
+                _log("bench.py running — pausing sampling")
+                time.sleep(60)
+                continue
+        except OSError:
+            pass
         n_probe += 1
         tick = time.time()
         p = probe()
