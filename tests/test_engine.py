@@ -331,3 +331,38 @@ def test_warmup_recovers_from_collect_time_mosaic_error(monkeypatch):
     assert kind == "tpu:fake"
     assert K.pallas_broken()
     assert calls["n"] == 3  # failed small, retried small, big shape
+
+
+def test_with_mosaic_fallback_contract(monkeypatch):
+    """Direct unit for the shared retry helper: one retry after a Mosaic
+    failure (flag set), non-Mosaic errors propagate untouched, and a
+    second Mosaic failure (the retry itself) propagates too."""
+    import tpunode.verify.kernel as K
+
+    monkeypatch.setattr(K, "_PALLAS_BROKEN", False)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("MosaicError: INTERNAL: HTTP 500")
+        return "ok"
+
+    assert K.with_mosaic_fallback(flaky, "in test") == "ok"
+    assert len(calls) == 2 and K.pallas_broken()
+
+    monkeypatch.setattr(K, "_PALLAS_BROKEN", False)
+    with pytest.raises(ValueError, match="not mosaic"):
+        K.with_mosaic_fallback(
+            lambda: (_ for _ in ()).throw(ValueError("not mosaic")),
+            "in test",
+        )
+    assert not K.pallas_broken()
+
+    def always_mosaic():
+        raise RuntimeError("MosaicError: still broken")
+
+    monkeypatch.setattr(K, "_PALLAS_BROKEN", False)
+    with pytest.raises(RuntimeError, match="still broken"):
+        K.with_mosaic_fallback(always_mosaic, "in test")
+    assert K.pallas_broken()
