@@ -256,21 +256,25 @@ def main() -> None:
                 for name in ("config2", "config3", "config5"):
                     if name not in swept and run_config(name) is not None:
                         swept.add(name)
-                if _mosaic_broken and "mosaic_diag" not in swept:
-                    # Once per round, after the sweep is banked: pin down
-                    # whether the Mosaic outage is infra-wide or tripped
-                    # by our kernel (benchmarks/mosaic_diag.py).
-                    diag = _run_json(
-                        [sys.executable, "-m", "benchmarks.mosaic_diag"],
-                        480.0,
-                    )
-                    if diag.get("cases"):
-                        _record("mosaic_diag", diag)
-                        swept.add("mosaic_diag")
-                    else:
-                        # transient failure (e.g. tunnel died mid-diag):
-                        # keep the once-per-round slot for a later window
-                        _log(f"mosaic_diag: {diag.get('error', '?')}")
+            if (
+                (head is None or _mosaic_broken)
+                and "mosaic_diag" not in swept
+            ):
+                # Run the diagnostic when the Mosaic outage was seen OR
+                # the whole ladder failed on a live device — either way
+                # this window must at least produce a diagnosis
+                # (benchmarks/mosaic_diag.py; once per round).
+                diag = _run_json(
+                    [sys.executable, "-m", "benchmarks.mosaic_diag"],
+                    480.0,
+                )
+                if diag.get("cases"):
+                    _record("mosaic_diag", diag)
+                    swept.add("mosaic_diag")
+                else:
+                    # transient failure (e.g. tunnel died mid-diag):
+                    # keep the once-per-round slot for a later window
+                    _log(f"mosaic_diag: {diag.get('error', '?')}")
             interval = REFRESH_INTERVAL if head is not None else PROBE_INTERVAL
         else:
             _log(f"probe #{n_probe}: down "
