@@ -71,6 +71,22 @@ LADDER = (
     (8192, 300.0, "xla"),
     (4096, 240.0, "xla"),
 )
+# Until the round's FIRST headline is banked, lead with the
+# fast-compiling XLA rungs instead of gambling a short uptime window on
+# the 360 s pallas compile: the observed r5 window (03:48-03:54Z) was
+# burned entirely by one hanging pallas compile, and ANY banked device
+# number beats an empty artifact (VERDICT r4 item 1).  8192 first — its
+# compile is quick and its throughput is already at the XLA plateau
+# (PERF.md r3 table); after an XLA bank, main() immediately re-runs the
+# ladder pallas-only in the same window (the upgrade attempt), and the
+# pallas rungs below only run directly if every XLA rung failed.
+FIRSTBANK_LADDER = (
+    (8192, 300.0, "xla"),
+    (4096, 240.0, "xla"),
+    (32768, 360.0, None),
+    (8192, 180.0, None),
+    (16384, 420.0, "xla"),
+)
 CONFIG_BUDGETS = {"config2": 600.0, "config5": 900.0, "config3": 900.0}
 
 
@@ -102,25 +118,57 @@ class FatalMismatch(RuntimeError):
     """Device/oracle verdict mismatch observed by the watcher."""
 
 
-# Tunnel uptime windows are short (observed r5: ~9 min).  Once a sweep
+# Tunnel uptime windows are short (observed r5: ~6-9 min).  Once a sweep
 # sees the Mosaic compile helper broken, later sweeps keep only ONE
 # short pallas probe rung (a still-broken helper MosaicErrors in ~45s;
 # a recovered one benefits from the server-side compile surviving the
 # kill) before the XLA rungs, so an uptime window banks a headline
 # instead of burning on doomed compiles.
 _mosaic_broken = False
+# Set after the first banked headline: later sweeps chase the pallas
+# number; until then FIRSTBANK_LADDER banks the quickest device number.
+_headline_banked = False
+
+BENCH_LOCK = os.path.join(REPO, "benchmarks", ".bench_running")
 
 
-def run_headline() -> dict | None:
-    """Device ladder: pallas 32768-first, then XLA fallback rungs.
-    Returns the successful worker dict, or raises FatalMismatch on a
-    device/oracle verdict mismatch."""
-    global _mosaic_broken
-    rungs = list(LADDER)
-    if _mosaic_broken:
+def _bench_running() -> bool:
+    """The driver's round-end bench holds the tunnel exclusively (clients
+    block each other) — checked between probes AND between rungs, so a
+    bench that starts mid-sweep isn't starved by our workers."""
+    try:
+        return time.time() - os.path.getmtime(BENCH_LOCK) < 1800
+    except OSError:
+        return False
+
+
+def run_headline(pallas_only: bool = False) -> tuple[dict | None, str]:
+    """Device ladder: XLA-first until a headline is banked this round,
+    pallas 32768-first after.  Returns ``(worker_dict, "banked")`` on
+    success, or ``(None, reason)`` with reason one of ``"exhausted"``
+    (device live, every rung failed — worth diagnosing), ``"yielded"``
+    (bench.py took the tunnel) or ``"tunnel-lost"`` (the uptime window
+    closed mid-sweep) — the caller must NOT run more tunnel clients for
+    the last two.  Raises FatalMismatch on a device/oracle verdict
+    mismatch.
+
+    ``pallas_only``: the same-window upgrade attempt after an XLA
+    first-bank — only the pallas rungs are worth running (an XLA number
+    is already on disk)."""
+    global _mosaic_broken, _headline_banked
+    if pallas_only:
+        rungs = [r for r in LADDER if r[2] is None]
+    elif _mosaic_broken:
         rungs = ([(32768, 150.0, None)]
-                 + [r for r in rungs if r[2] == "xla"])
+                 + [r for r in LADDER if r[2] == "xla"])
+    elif not _headline_banked:
+        rungs = list(FIRSTBANK_LADDER)
+    else:
+        rungs = list(LADDER)
     while rungs:
+        if _bench_running():
+            _log("bench.py started mid-sweep — yielding the tunnel")
+            return None, "yielded"
         batch, budget, kernel = rungs.pop(0)
         env, label = worker_rung_env(batch, kernel)
         res = _run_json(
@@ -130,6 +178,7 @@ def run_headline() -> dict | None:
             if kernel is None:
                 # pallas works (again): restore the full-budget ladder
                 _mosaic_broken = False
+            _headline_banked = True
             _record("headline", {
                 "metric": "sig_verify_throughput",
                 "value": round(res["rate"], 1), "unit": "sigs/sec/chip",
@@ -138,26 +187,46 @@ def run_headline() -> dict | None:
                 "compile_s": res.get("compile_s"),
                 "init_s": res.get("init_s"),
             })
-            return res
-        _log(f"headline {label}: {res.get('error', '?')}")
+            return res, "banked"
+        err = str(res.get("error", ""))
+        _log(f"headline {label}: {err or '?'}")
         if res.get("fatal"):
             # Correctness failure, not an infra flake: record it (which
             # poisons bench.py's watcher fallback for the round) and stop
             # sampling — a later flaky pass must never mask a mismatch.
             _record("fatal", {"error": res.get("error")})
             raise FatalMismatch(res.get("error", "verdict mismatch"))
-        if kernel is None and "MosaicError" in str(res.get("error", "")):
+        if "initializing backend" in err or "probing backend" in err:
+            # jax.devices() blocked for the rung's whole budget: the
+            # tunnel closed under us (live init is 0.1-5.8 s when up).
+            # Abort the sweep — burning the remaining rungs against a
+            # dead tunnel delays the next probe by up to 16 min
+            # (observed r5, 03:54-04:16Z).
+            _log("tunnel lost mid-sweep — back to probing")
+            return None, "tunnel-lost"
+        if kernel is None and (
+            "MosaicError" in err or "timed out" in err
+        ):
             # The compile helper is rejecting pallas programs outright
-            # (observed r5: HTTP 500 on every pallas compile while plain
-            # XLA works); skip the remaining pallas rungs this sweep and
-            # lead with XLA next sweep (pallas retried at the tail).
-            _log("mosaic compile broken — skipping to the XLA rungs")
+            # (observed r5: HTTP 500 on every pallas compile) or hanging
+            # on them (observed r5 03:48Z: backend up in 0.2 s, then the
+            # 32768 compile sat for 360 s) while plain XLA works.  Any
+            # pallas timeout PAST backend init (the branch above caught
+            # the init stage) is a post-init hang — at host prep, the
+            # compile RPC, or the oracle check — and retrying a smaller
+            # pallas compile in the same window is the losing bet; skip
+            # to the XLA rungs this sweep and lead with XLA next sweep
+            # (pallas retried at the tail).
+            _log("mosaic compile broken/hanging — skipping to XLA rungs")
             _mosaic_broken = True
             rungs = [r for r in rungs if r[2] == "xla"]
-    return None
+    return None, "exhausted"
 
 
 def run_config(name: str) -> dict | None:
+    if _bench_running():
+        _log(f"{name}: bench.py running — yielding the tunnel")
+        return None
     # During a Mosaic outage the engine falls back to the XLA program; a
     # modest steady-state shape keeps its server-side compile (and so the
     # whole config) inside the watchdog — XLA throughput plateaus by 8192
@@ -224,19 +293,15 @@ def main() -> None:
     _log(f"watcher up (pid {os.getpid()}), deadline in "
          f"{DEADLINE_S/3600:.1f}h, probing every {PROBE_INTERVAL:.0f}s")
     n_probe = 0
-    bench_lock = os.path.join(REPO, "benchmarks", ".bench_running")
     while time.time() < deadline:
         # The driver's round-end bench gets the tunnel to itself: clients
         # block each other, so probing while it runs could starve the
         # official artifact.  Stale locks (>30 min — a dead bench) are
         # ignored.
-        try:
-            if time.time() - os.path.getmtime(bench_lock) < 1800:
-                _log("bench.py running — pausing sampling")
-                time.sleep(60)
-                continue
-        except OSError:
-            pass
+        if _bench_running():
+            _log("bench.py running — pausing sampling")
+            time.sleep(60)
+            continue
         n_probe += 1
         tick = time.time()
         p = probe()
@@ -244,7 +309,7 @@ def main() -> None:
             _log(f"probe #{n_probe}: TPU UP "
                  f"({p.get('device_kind')}, init {p.get('init_s')}s)")
             try:
-                head = run_headline()
+                head, why = run_headline()
             except FatalMismatch as e:
                 _log(f"FATAL verdict mismatch — watcher stops sampling: {e}")
                 return
@@ -256,14 +321,32 @@ def main() -> None:
                 for name in ("config2", "config3", "config5"):
                     if name not in swept and run_config(name) is not None:
                         swept.add(name)
+                if head.get("kernel") == "xla" and not _mosaic_broken:
+                    # FIRSTBANK banked the quick XLA number and pallas
+                    # has not been seen broken: chase the pallas
+                    # headline NOW — the ~6-9 min windows don't survive
+                    # a 15 min refresh wait (review r5).
+                    _log("same-window upgrade: pallas ladder attempt")
+                    try:
+                        up_head, _ = run_headline(pallas_only=True)
+                    except FatalMismatch as e:
+                        _log("FATAL verdict mismatch — watcher stops "
+                             f"sampling: {e}")
+                        return
+                    if up_head is not None:
+                        head = up_head
             if (
-                (head is None or _mosaic_broken)
+                (why == "exhausted" or (head is not None and _mosaic_broken))
                 and "mosaic_diag" not in swept
             ):
                 # Run the diagnostic when the Mosaic outage was seen OR
                 # the whole ladder failed on a live device — either way
                 # this window must at least produce a diagnosis
-                # (benchmarks/mosaic_diag.py; once per round).
+                # (benchmarks/mosaic_diag.py; once per round).  A
+                # "yielded"/"tunnel-lost" sweep must NOT reach here: the
+                # diag is itself a tunnel client, and running it would
+                # contend with the bench it just yielded to (or burn
+                # 480 s against a dead tunnel).
                 diag = _run_json(
                     [sys.executable, "-m", "benchmarks.mosaic_diag"],
                     480.0,
