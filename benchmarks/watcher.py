@@ -227,11 +227,17 @@ def run_config(name: str) -> dict | None:
     if _bench_running():
         _log(f"{name}: bench.py running — yielding the tunnel")
         return None
-    # During a Mosaic outage the engine falls back to the XLA program; a
-    # modest steady-state shape keeps its server-side compile (and so the
-    # whole config) inside the watchdog — XLA throughput plateaus by 8192
+    # During a Mosaic outage the config subprocess must start on the XLA
+    # program: its fresh engine would otherwise pick pallas and — in the
+    # outage's hang mode — sit in the compile until the watchdog kills
+    # the whole config (TPUNODE_VERIFY_KERNEL seeds kernel.py's broken
+    # flag).  A modest steady-state shape keeps the XLA server-side
+    # compile inside the watchdog too — XLA throughput plateaus by 8192
     # (PERF.md r3 table), so nothing is lost.
-    env = {"TPUNODE_DEVICE_BATCH": "8192"} if _mosaic_broken else None
+    env = (
+        {"TPUNODE_DEVICE_BATCH": "8192", "TPUNODE_VERIFY_KERNEL": "xla"}
+        if _mosaic_broken else None
+    )
     res = _run_json([sys.executable, "-m", "benchmarks.run", name],
                     CONFIG_BUDGETS[name], env)
     if res.get("metric"):
@@ -281,6 +287,64 @@ def _rotate_runs_file() -> list[dict]:
     return fatals
 
 
+def handle_window(swept: set) -> float:
+    """One live-window pass: headline sweep, same-window pallas upgrade,
+    config sweep, once-per-round Mosaic diagnostic.  Mutates ``swept``
+    (the on-device captures so far this round) and returns the sleep
+    interval until the next probe.  Raises FatalMismatch to stop the
+    watcher for the round.
+
+    Order is load-bearing (review r5): the pallas upgrade runs BEFORE
+    the configs — if pallas is hang-broken the upgrade detects it in one
+    360 s rung and the configs then get the XLA knob; configs-first
+    would feed config3's fresh engine a hanging pallas warmup and burn
+    its whole 900 s budget.  The diagnostic (itself a tunnel client)
+    only runs when the ladder proved the device live: never after a
+    "yielded" sweep (it would contend with the bench we just yielded
+    to) or a "tunnel-lost" one (480 s against a dead tunnel)."""
+    head, why = run_headline()
+    if head is not None:
+        if head.get("kernel") == "xla" and not _mosaic_broken:
+            # FIRSTBANK banked the quick XLA number and pallas has not
+            # been seen broken: chase the pallas headline NOW — the
+            # ~6-9 min windows don't survive a 15 min refresh wait.
+            _log("same-window upgrade: pallas ladder attempt")
+            up_head, up_why = run_headline(pallas_only=True)
+            if up_head is not None:
+                head = up_head
+            elif up_why in ("yielded", "tunnel-lost"):
+                # The window closed (or bench.py took the tunnel) during
+                # the upgrade: no more tunnel clients — skip the configs
+                # and go straight back to cheap probing.
+                return PROBE_INTERVAL
+        # config2 is cheap; config3 (full-node IBD on device) is the
+        # VERDICT item-2 money shot and must be banked before config5,
+        # whose ~150k-sig batch is the slowest compile during an outage
+        # (review r5).
+        for name in ("config2", "config3", "config5"):
+            if name not in swept and run_config(name) is not None:
+                swept.add(name)
+    if (
+        (why == "exhausted" or (head is not None and _mosaic_broken))
+        and "mosaic_diag" not in swept
+    ):
+        # The outage was seen, or the whole ladder failed on a live
+        # device — either way this window must at least produce a
+        # diagnosis (benchmarks/mosaic_diag.py; once per round).
+        diag = _run_json(
+            [sys.executable, "-m", "benchmarks.mosaic_diag"],
+            480.0,
+        )
+        if diag.get("cases"):
+            _record("mosaic_diag", diag)
+            swept.add("mosaic_diag")
+        else:
+            # transient failure (e.g. tunnel died mid-diag): keep the
+            # once-per-round slot for a later window
+            _log(f"mosaic_diag: {diag.get('error', '?')}")
+    return REFRESH_INTERVAL if head is not None else PROBE_INTERVAL
+
+
 def main() -> None:
     start = time.time()
     deadline = start + DEADLINE_S
@@ -309,56 +373,10 @@ def main() -> None:
             _log(f"probe #{n_probe}: TPU UP "
                  f"({p.get('device_kind')}, init {p.get('init_s')}s)")
             try:
-                head, why = run_headline()
+                interval = handle_window(swept)
             except FatalMismatch as e:
                 _log(f"FATAL verdict mismatch — watcher stops sampling: {e}")
                 return
-            if head is not None:
-                # config2 is cheap; config3 (full-node IBD on device) is
-                # the VERDICT item-2 money shot and must be banked before
-                # config5, whose ~150k-sig batch is the slowest compile
-                # during an outage (review r5).
-                for name in ("config2", "config3", "config5"):
-                    if name not in swept and run_config(name) is not None:
-                        swept.add(name)
-                if head.get("kernel") == "xla" and not _mosaic_broken:
-                    # FIRSTBANK banked the quick XLA number and pallas
-                    # has not been seen broken: chase the pallas
-                    # headline NOW — the ~6-9 min windows don't survive
-                    # a 15 min refresh wait (review r5).
-                    _log("same-window upgrade: pallas ladder attempt")
-                    try:
-                        up_head, _ = run_headline(pallas_only=True)
-                    except FatalMismatch as e:
-                        _log("FATAL verdict mismatch — watcher stops "
-                             f"sampling: {e}")
-                        return
-                    if up_head is not None:
-                        head = up_head
-            if (
-                (why == "exhausted" or (head is not None and _mosaic_broken))
-                and "mosaic_diag" not in swept
-            ):
-                # Run the diagnostic when the Mosaic outage was seen OR
-                # the whole ladder failed on a live device — either way
-                # this window must at least produce a diagnosis
-                # (benchmarks/mosaic_diag.py; once per round).  A
-                # "yielded"/"tunnel-lost" sweep must NOT reach here: the
-                # diag is itself a tunnel client, and running it would
-                # contend with the bench it just yielded to (or burn
-                # 480 s against a dead tunnel).
-                diag = _run_json(
-                    [sys.executable, "-m", "benchmarks.mosaic_diag"],
-                    480.0,
-                )
-                if diag.get("cases"):
-                    _record("mosaic_diag", diag)
-                    swept.add("mosaic_diag")
-                else:
-                    # transient failure (e.g. tunnel died mid-diag):
-                    # keep the once-per-round slot for a later window
-                    _log(f"mosaic_diag: {diag.get('error', '?')}")
-            interval = REFRESH_INTERVAL if head is not None else PROBE_INTERVAL
         else:
             _log(f"probe #{n_probe}: down "
                  f"({p.get('error') or 'platform=' + str(p.get('platform'))})")

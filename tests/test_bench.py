@@ -595,7 +595,11 @@ def test_watcher_run_config_passes_outage_knob(monkeypatch):
     monkeypatch.setattr(W, "_mosaic_broken", False)
     assert W.run_config("config2") is not None
     assert seen[0][1].get("TPUNODE_DEVICE_BATCH") == "8192"
+    # the fresh config subprocess must not pick pallas during the outage
+    # (its hang mode would burn the whole config watchdog in warmup)
+    assert seen[0][1].get("TPUNODE_VERIFY_KERNEL") == "xla"
     assert "TPUNODE_DEVICE_BATCH" not in seen[1][1]
+    assert "TPUNODE_VERIFY_KERNEL" not in seen[1][1]
 
 
 def test_watcher_evidence_parses_probe_log(tmp_path):
@@ -709,3 +713,131 @@ def test_watcher_pallas_only_upgrade_rungs(monkeypatch):
     assert res is None and why == "exhausted"
     assert seen == [(32768, None), (8192, None), (4096, None)]
     assert all(k is None for _, k in seen)
+
+
+def _setup_window(monkeypatch, W, head, why, mosaic=False):
+    """Stub run_headline/run_config/_run_json for handle_window tests;
+    returns (config_calls, diag_calls, record_calls)."""
+    configs, diags, recs = [], [], []
+    monkeypatch.setattr(W, "_mosaic_broken", mosaic)
+    monkeypatch.setattr(W, "run_headline",
+                        lambda pallas_only=False: (head, why))
+    monkeypatch.setattr(
+        W, "run_config", lambda name: configs.append(name) or {"metric": name}
+    )
+    monkeypatch.setattr(
+        W, "_run_json",
+        lambda argv, t, env=None: diags.append(argv) or {"cases": ["x"]},
+    )
+    monkeypatch.setattr(W, "_record", lambda k, p: recs.append(k))
+    return configs, diags, recs
+
+
+def test_handle_window_banked_runs_configs_and_diag_on_outage(monkeypatch):
+    from benchmarks import watcher as W
+
+    head = {"kernel": "xla", "rate": 41000.0}
+    configs, diags, recs = _setup_window(
+        monkeypatch, W, head, "banked", mosaic=True
+    )
+    swept = set()
+    interval = W.handle_window(swept)
+    assert configs == ["config2", "config3", "config5"]
+    assert len(diags) == 1 and "mosaic_diag" in swept
+    assert recs == ["mosaic_diag"]
+    assert interval == W.REFRESH_INTERVAL
+
+
+def test_handle_window_yield_and_tunnel_lost_run_nothing(monkeypatch):
+    """After yielding to bench.py (or losing the window) no more tunnel
+    clients may launch — no configs, no diagnostic (the r5 review bug:
+    the diag used to fire on ANY None sweep, contending with the bench
+    it had just yielded to)."""
+    from benchmarks import watcher as W
+
+    for why in ("yielded", "tunnel-lost"):
+        configs, diags, _ = _setup_window(
+            monkeypatch, W, None, why, mosaic=True
+        )
+        interval = W.handle_window(set())
+        assert configs == [] and diags == []
+        assert interval == W.PROBE_INTERVAL
+
+
+def test_handle_window_exhausted_runs_diag_only(monkeypatch):
+    from benchmarks import watcher as W
+
+    configs, diags, _ = _setup_window(monkeypatch, W, None, "exhausted")
+    swept = set()
+    interval = W.handle_window(swept)
+    assert configs == []
+    assert len(diags) == 1 and "mosaic_diag" in swept
+    assert interval == W.PROBE_INTERVAL
+
+
+def test_handle_window_diag_transient_failure_keeps_slot(monkeypatch):
+    from benchmarks import watcher as W
+
+    configs, diags, recs = _setup_window(monkeypatch, W, None, "exhausted")
+    monkeypatch.setattr(
+        W, "_run_json",
+        lambda argv, t, env=None: diags.append(argv) or {"error": "timeout"},
+    )
+    swept = set()
+    W.handle_window(swept)
+    assert "mosaic_diag" not in swept and recs == []
+
+
+def test_handle_window_upgrade_before_configs(monkeypatch):
+    """After an XLA first-bank with pallas not yet seen broken, the
+    pallas upgrade attempt runs BEFORE the configs — a hang-broken
+    pallas must be detected before config3's engine warms up."""
+    from benchmarks import watcher as W
+
+    order = []
+    monkeypatch.setattr(W, "_mosaic_broken", False)
+
+    def fake_headline(pallas_only=False):
+        order.append(("headline", pallas_only))
+        if pallas_only:
+            return {"kernel": "pallas", "rate": 210000.0}, "banked"
+        return {"kernel": "xla", "rate": 41000.0}, "banked"
+
+    monkeypatch.setattr(W, "run_headline", fake_headline)
+    monkeypatch.setattr(
+        W, "run_config", lambda name: order.append(("config", name)) or {"m": 1}
+    )
+    monkeypatch.setattr(W, "_run_json", lambda *a, **k: {"cases": []})
+    monkeypatch.setattr(W, "_record", lambda *a, **k: None)
+    W.handle_window(set())
+    assert order == [
+        ("headline", False), ("headline", True),
+        ("config", "config2"), ("config", "config3"), ("config", "config5"),
+    ]
+
+
+def test_handle_window_tunnel_lost_during_upgrade_skips_configs(monkeypatch):
+    """If the window closes during the same-window pallas upgrade, the
+    config sweep must NOT run against the dead tunnel (it would burn up
+    to 40 min of watchdog budget) — straight back to cheap probing."""
+    from benchmarks import watcher as W
+
+    monkeypatch.setattr(W, "_mosaic_broken", False)
+    calls = []
+
+    def fake_headline(pallas_only=False):
+        if pallas_only:
+            return None, "tunnel-lost"
+        return {"kernel": "xla", "rate": 41000.0}, "banked"
+
+    monkeypatch.setattr(W, "run_headline", fake_headline)
+    monkeypatch.setattr(
+        W, "run_config", lambda name: calls.append(name) or {"m": 1}
+    )
+    monkeypatch.setattr(
+        W, "_run_json", lambda *a, **k: calls.append("diag") or {"cases": []}
+    )
+    monkeypatch.setattr(W, "_record", lambda *a, **k: None)
+    interval = W.handle_window(set())
+    assert calls == []
+    assert interval == W.PROBE_INTERVAL

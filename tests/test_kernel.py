@@ -241,3 +241,23 @@ def test_dispatch_reraises_non_mosaic_errors(monkeypatch):
     with pytest.raises(ValueError, match="boom"):
         K.verify_batch_tpu(items, pad_to=16)
     assert not K.pallas_broken()
+
+
+def test_env_knob_seeds_pallas_broken(monkeypatch):
+    """TPUNODE_VERIFY_KERNEL=xla seeds the sticky pallas-broken flag at
+    import: the watcher forces fresh config subprocesses straight to the
+    XLA program during a Mosaic outage whose hang mode (observed r5,
+    03:48Z window) cannot be caught in-process."""
+    import importlib
+
+    from tpunode.verify import kernel as K
+
+    monkeypatch.setenv("TPUNODE_VERIFY_KERNEL", "xla")
+    try:
+        importlib.reload(K)
+        assert K.pallas_broken()
+        assert not K._pallas_usable(32768)
+    finally:
+        monkeypatch.delenv("TPUNODE_VERIFY_KERNEL")
+        importlib.reload(K)
+    assert not K.pallas_broken()

@@ -33,6 +33,7 @@ oracle (tested property-style in tests/test_kernel.py).
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -639,7 +640,16 @@ verify_device = jax.jit(verify_core)
 # on every pallas program while plain XLA compiles and runs).  Dispatch
 # then stays on the XLA program so the engine keeps a device path instead
 # of failing warmup and pinning itself to the CPU fallback.
-_PALLAS_BROKEN = False
+#
+# TPUNODE_VERIFY_KERNEL=xla seeds the flag at import: a parent that has
+# already diagnosed the outage (the round-long watcher) can force fresh
+# subprocesses straight to the XLA program.  The r5 outage's hang mode
+# makes this necessary — a pallas compile that HANGS (rather than
+# erroring) cannot be caught in-process, so warmup in an engine-bearing
+# config run would otherwise burn the whole subprocess watchdog.
+_PALLAS_BROKEN = (
+    os.environ.get("TPUNODE_VERIFY_KERNEL", "").strip().lower() == "xla"
+)
 
 
 def pallas_broken() -> bool:
