@@ -16,16 +16,22 @@ sampling into a round-long process instead of a round-end event:
   live attempt can't reach the device;
 * after a full sweep, keep refreshing the cheap headline number each
   uptime window so the freshest entry stays recent, and log every
-  probe so a tunnel that never comes up leaves evidence
-  (``benchmarks/watcher.log``).
+  probe so a tunnel that never comes up leaves evidence (the probe
+  log, e.g. ``benchmarks/watcher_r5.log`` — parsed into bench.py's
+  ``watcher_evidence`` artifact field).
 
 Single-core box discipline: when the tunnel is down the watcher is a
 sleeping process plus one network-blocked probe subprocess — no CPU
 burned while the builder's tests run in the foreground.
 
-Run detached from the repo root:
+Run detached from the repo root (round start):
 
-    nohup python -m benchmarks.watcher >> benchmarks/watcher.log 2>&1 &
+    nohup python -m benchmarks.watcher >> benchmarks/watcher_r5.log 2>&1 &
+
+For a MID-ROUND relaunch (watcher died / code updated) add
+``TPUNODE_WATCHER_KEEP_RUNS=1`` so already-banked in-round samples are
+kept instead of rotated away; a pidfile guard (.watcher_pid) refuses to
+start a second concurrent watcher either way.
 """
 
 from __future__ import annotations
@@ -88,6 +94,11 @@ FIRSTBANK_LADDER = (
     (16384, 420.0, "xla"),
 )
 CONFIG_BUDGETS = {"config2": 600.0, "config5": 900.0, "config3": 900.0}
+# Sweep order: config2 is cheap; config3 (full-node IBD on device) is
+# the VERDICT item-2 money shot and must be banked before config5,
+# whose ~150k-sig batch is the slowest compile during an outage.  One
+# constant drives both the sweep loop and the all-banked cadence check.
+CONFIG_ORDER = ("config2", "config3", "config5")
 
 
 def _log(msg: str) -> None:
@@ -249,6 +260,66 @@ def run_config(name: str) -> dict | None:
 
 FATAL_WINDOW_S = 12 * 3600  # matches bench.py's DEVICE_RUN_MAX_AGE
 
+PID_PATH = os.path.join(REPO, "benchmarks", ".watcher_pid")
+
+
+def _another_watcher_alive() -> bool:
+    """Is a DIFFERENT live watcher process already registered in
+    ``.watcher_pid``?  Two watchers would contend for the tunnel (probes
+    block each other) and double-sample; a relaunch race nearly created
+    this (observed r5, 04:38Z).  Best-effort: any read/parse failure
+    means "no".  The cmdline match requires the interpreter AND the
+    module form (``python -m benchmarks.watcher``) so a recycled pid on
+    e.g. ``tail -F benchmarks/watcher_r5.log`` can't false-positive and
+    block the round's sampler."""
+    try:
+        pid = int(open(PID_PATH, encoding="utf-8").read().split()[0])
+    except (OSError, ValueError, IndexError):
+        return False
+    if pid == os.getpid():
+        return False
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmd = f.read().decode("utf-8", "replace")
+    except OSError:
+        return False
+    return "python" in cmd and "benchmarks.watcher" in cmd
+
+
+def _claim_pidfile(retries: int = 6, wait_s: float = 5.0) -> bool:
+    """Register this process as THE watcher; False means another live
+    watcher kept the claim.
+
+    A kill-and-relaunch race must not strand the round with no sampler:
+    if another watcher looks alive, wait briefly for it to finish dying
+    before giving up.  Two simultaneous launches both reaching the write
+    are then disambiguated by re-reading after a beat — the loser (the
+    one whose pid is no longer in the file while the winner lives)
+    exits."""
+    for i in range(retries):
+        if not _another_watcher_alive():
+            break
+        if i == retries - 1:
+            return False
+        time.sleep(wait_s)
+    try:
+        with open(PID_PATH, "w", encoding="utf-8") as f:
+            f.write(f"{os.getpid()}\n")
+    except OSError:
+        return True  # unwritable pidfile: claim uncontested, proceed
+    time.sleep(1.0)
+    return not _another_watcher_alive()
+
+
+def _release_pidfile() -> None:
+    """Remove the pidfile iff it is still ours (a stale file would feed
+    the pid-reuse scenario on the next round)."""
+    try:
+        if int(open(PID_PATH, encoding="utf-8").read().split()[0]) == os.getpid():
+            os.remove(PID_PATH)
+    except (OSError, ValueError, IndexError):
+        pass
+
 
 def _rotate_runs_file() -> list[dict]:
     """One rotation per round: a previous round's committed samples must
@@ -257,26 +328,60 @@ def _rotate_runs_file() -> list[dict]:
     Recent ``fatal`` rows (device/oracle verdict mismatches) are carried
     FORWARD into the fresh file: a mid-round watcher relaunch must not
     launder a correctness failure behind a later flaky pass (review r5).
-    Returns the carried rows so main() can refuse to sample."""
+    Returns the carried rows so main() can refuse to sample.
+
+    ``TPUNODE_WATCHER_KEEP_RUNS=1`` skips the rotation entirely — the
+    flag for a MID-ROUND relaunch (watcher died, code updated), where
+    rotating would discard genuinely in-round banked samples that
+    bench.py should still report.  Fatal rows in the kept file still
+    poison sampling (scanned and returned exactly as after a rotation).
+    """
     if not os.path.exists(RUNS_PATH):
         return []
+    keep = os.environ.get("TPUNODE_WATCHER_KEEP_RUNS", "") == "1"
     fatals: list[dict] = []
+    kept_rows: list[str] = []   # in-window rows, verbatim
+    dropped = 0
     now = time.time()
     try:
         with open(RUNS_PATH, encoding="utf-8") as f:
             for line in f:
                 try:
                     row = json.loads(line)
-                except json.JSONDecodeError:
+                    fresh = (
+                        isinstance(row, dict)
+                        and now - float(row.get("unix", 0)) < FATAL_WINDOW_S
+                    )
+                except (json.JSONDecodeError, TypeError, ValueError):
+                    fresh = False
+                if not fresh:
+                    dropped += 1
                     continue
-                if (
-                    isinstance(row, dict)
-                    and row.get("kind") == "fatal"
-                    and now - float(row.get("unix", 0)) < FATAL_WINDOW_S
-                ):
+                kept_rows.append(line)
+                if row.get("kind") == "fatal":
                     fatals.append(row)
     except OSError:
         pass
+    if keep:
+        # Fail closed against a leaked flag at a round-START launch:
+        # even under keep, rows older than the in-round window are
+        # rewritten away (same cap bench.py applies), so a previous
+        # round's samples can never be reported as in-round.  Atomic
+        # temp+replace: a kill mid-rewrite must not lose the banked
+        # samples the keep flag exists to preserve.
+        if dropped:
+            try:
+                tmp = RUNS_PATH + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.writelines(kept_rows)
+                os.replace(tmp, RUNS_PATH)
+            except OSError:
+                pass
+        _log(f"mid-round relaunch: keeping runs file "
+             f"({len(kept_rows)} in-round row(s), {dropped} stale dropped"
+             + (f", {len(fatals)} fatal row(s) still poison sampling)"
+                if fatals else ")"))
+        return fatals
     os.replace(RUNS_PATH, PREV_RUNS_PATH)
     _log(f"rotated stale {RUNS_PATH} -> {PREV_RUNS_PATH}")
     if fatals:
@@ -317,11 +422,7 @@ def handle_window(swept: set) -> float:
                 # the upgrade: no more tunnel clients — skip the configs
                 # and go straight back to cheap probing.
                 return PROBE_INTERVAL
-        # config2 is cheap; config3 (full-node IBD on device) is the
-        # VERDICT item-2 money shot and must be banked before config5,
-        # whose ~150k-sig batch is the slowest compile during an outage
-        # (review r5).
-        for name in ("config2", "config3", "config5"):
+        for name in CONFIG_ORDER:
             if name not in swept and run_config(name) is not None:
                 swept.add(name)
     if (
@@ -342,12 +443,32 @@ def handle_window(swept: set) -> float:
             # transient failure (e.g. tunnel died mid-diag): keep the
             # once-per-round slot for a later window
             _log(f"mosaic_diag: {diag.get('error', '?')}")
-    return REFRESH_INTERVAL if head is not None else PROBE_INTERVAL
+    # Back off to the slow refresh cadence only once every config is
+    # banked: with all of them captured the next window owes us nothing
+    # but a headline refresh, but while configs are missing the next
+    # short, rare window must be caught within one probe interval.
+    return (
+        REFRESH_INTERVAL
+        if head is not None and swept.issuperset(CONFIG_ORDER)
+        else PROBE_INTERVAL
+    )
 
 
 def main() -> None:
     start = time.time()
     deadline = start + DEADLINE_S
+    if not _claim_pidfile():
+        _log("another live watcher kept the claim in "
+             f"{PID_PATH} — exiting (two watchers would contend "
+             "for the tunnel)")
+        return
+    try:
+        _main_claimed(deadline)
+    finally:
+        _release_pidfile()
+
+
+def _main_claimed(deadline: float) -> None:
     if _rotate_runs_file():
         _log("recent FATAL verdict mismatch on record — refusing to "
              "sample until the kernel is fixed and the fatal rows are "

@@ -745,7 +745,30 @@ def test_handle_window_banked_runs_configs_and_diag_on_outage(monkeypatch):
     assert configs == ["config2", "config3", "config5"]
     assert len(diags) == 1 and "mosaic_diag" in swept
     assert recs == ["mosaic_diag"]
+    # every config banked -> slow refresh cadence
     assert interval == W.REFRESH_INTERVAL
+
+
+def test_handle_window_keeps_probing_until_configs_banked(monkeypatch):
+    """A banked headline with configs still missing must NOT back off to
+    the 15 min refresh cadence — the next short window has work to do."""
+    from benchmarks import watcher as W
+
+    head = {"kernel": "pallas", "rate": 210000.0}
+    monkeypatch.setattr(W, "_mosaic_broken", False)
+    monkeypatch.setattr(W, "run_headline",
+                        lambda pallas_only=False: (head, "banked"))
+    # config3/config5 fail (window closed mid-sweep)
+    monkeypatch.setattr(
+        W, "run_config",
+        lambda name: {"metric": name} if name == "config2" else None,
+    )
+    monkeypatch.setattr(W, "_run_json", lambda *a, **k: {"cases": []})
+    monkeypatch.setattr(W, "_record", lambda *a, **k: None)
+    swept = set()
+    interval = W.handle_window(swept)
+    assert swept == {"config2"}
+    assert interval == W.PROBE_INTERVAL
 
 
 def test_handle_window_yield_and_tunnel_lost_run_nothing(monkeypatch):
@@ -841,3 +864,137 @@ def test_handle_window_tunnel_lost_during_upgrade_skips_configs(monkeypatch):
     interval = W.handle_window(set())
     assert calls == []
     assert interval == W.PROBE_INTERVAL
+
+
+def test_rotate_runs_file_keep_flag(tmp_path, monkeypatch):
+    """TPUNODE_WATCHER_KEEP_RUNS=1 (mid-round relaunch) keeps banked
+    in-round samples instead of rotating them away; fatal rows still
+    poison sampling either way."""
+    import time as _time
+
+    from benchmarks import watcher as W
+
+    runs = tmp_path / "device_runs.jsonl"
+    prev = tmp_path / "device_runs.jsonl.prev"
+    monkeypatch.setattr(W, "RUNS_PATH", str(runs))
+    monkeypatch.setattr(W, "PREV_RUNS_PATH", str(prev))
+    now = int(_time.time())
+    sample = {"kind": "headline", "device": "tpu:v5e", "unix": now,
+              "ts": "t", "value": 41000.0}
+    runs.write_text(json.dumps(sample) + "\n")
+
+    monkeypatch.setenv("TPUNODE_WATCHER_KEEP_RUNS", "1")
+    assert W._rotate_runs_file() == []
+    assert runs.exists() and not prev.exists()  # kept in place
+
+    # fatal rows are still found in the kept file
+    fatal = {"kind": "fatal", "unix": now, "ts": "f", "error": "mismatch"}
+    runs.write_text(json.dumps(sample) + "\n" + json.dumps(fatal) + "\n")
+    carried = W._rotate_runs_file()
+    assert len(carried) == 1 and carried[0]["kind"] == "fatal"
+    assert runs.exists() and not prev.exists()
+
+    # without the flag: rotation as before (fatals carried forward)
+    monkeypatch.delenv("TPUNODE_WATCHER_KEEP_RUNS")
+    carried = W._rotate_runs_file()
+    assert len(carried) == 1
+    assert prev.exists()
+    kept = [json.loads(x) for x in runs.read_text().splitlines()]
+    assert [r["kind"] for r in kept] == ["fatal"]
+
+
+def test_another_watcher_alive_detection(tmp_path, monkeypatch):
+    import subprocess
+    import sys as _sys
+
+    from benchmarks import watcher as W
+
+    pidfile = tmp_path / ".watcher_pid"
+    monkeypatch.setattr(W, "PID_PATH", str(pidfile))
+
+    assert not W._another_watcher_alive()          # no pidfile
+    pidfile.write_text("not-a-pid\n")
+    assert not W._another_watcher_alive()          # unparseable
+    pidfile.write_text(f"{os.getpid()}\n")
+    assert not W._another_watcher_alive()          # ourselves
+    pidfile.write_text("1\n")
+    assert not W._another_watcher_alive()          # live but not a watcher
+
+    # a live process whose cmdline mentions the watcher module
+    proc = subprocess.Popen(
+        [_sys.executable, "-c", "import time; time.sleep(60)",
+         "benchmarks.watcher"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        pidfile.write_text(f"{proc.pid}\n")
+        assert W._another_watcher_alive()
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_claim_pidfile_lifecycle(tmp_path, monkeypatch):
+    """_claim_pidfile: solo launch claims and registers; a live foreign
+    watcher keeps the claim (after bounded retries); _release_pidfile
+    removes only our own registration."""
+    import subprocess
+    import sys as _sys
+
+    from benchmarks import watcher as W
+
+    pidfile = tmp_path / ".watcher_pid"
+    monkeypatch.setattr(W, "PID_PATH", str(pidfile))
+
+    # solo: claim succeeds and registers us
+    assert W._claim_pidfile(retries=2, wait_s=0.01)
+    assert pidfile.read_text().strip() == str(os.getpid())
+
+    # release removes our own pid...
+    W._release_pidfile()
+    assert not pidfile.exists()
+    # ...but never someone else's
+    pidfile.write_text("1\n")
+    W._release_pidfile()
+    assert pidfile.read_text().strip() == "1"
+
+    # a live foreign watcher keeps the claim
+    proc = subprocess.Popen(
+        [_sys.executable, "-c", "import time; time.sleep(60)",
+         "benchmarks.watcher"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        pidfile.write_text(f"{proc.pid}\n")
+        assert not W._claim_pidfile(retries=2, wait_s=0.01)
+        assert pidfile.read_text().strip() == str(proc.pid)  # untouched
+    finally:
+        proc.kill()
+        proc.wait()
+
+    # the dead watcher's stale pidfile no longer blocks a claim
+    assert W._claim_pidfile(retries=2, wait_s=0.01)
+    W._release_pidfile()
+
+
+def test_rotate_keep_drops_stale_rows(tmp_path, monkeypatch):
+    """Fail-closed: even under TPUNODE_WATCHER_KEEP_RUNS=1 a leaked flag
+    at a round-start launch cannot resurface a previous round's samples
+    — rows beyond the in-round window are dropped from the kept file."""
+    import time as _time
+
+    from benchmarks import watcher as W
+
+    runs = tmp_path / "device_runs.jsonl"
+    monkeypatch.setattr(W, "RUNS_PATH", str(runs))
+    monkeypatch.setattr(W, "PREV_RUNS_PATH", str(runs) + ".prev")
+    now = int(_time.time())
+    fresh = {"kind": "headline", "device": "tpu:v5e", "unix": now - 60,
+             "ts": "new", "value": 41000.0}
+    stale = {"kind": "headline", "device": "tpu:v5e",
+             "unix": now - 13 * 3600, "ts": "old", "value": 99999.0}
+    runs.write_text(json.dumps(stale) + "\n" + json.dumps(fresh) + "\n")
+    monkeypatch.setenv("TPUNODE_WATCHER_KEEP_RUNS", "1")
+    assert W._rotate_runs_file() == []
+    kept = [json.loads(x) for x in runs.read_text().splitlines()]
+    assert [r["ts"] for r in kept] == ["new"]
