@@ -261,3 +261,100 @@ def test_env_knob_seeds_pallas_broken(monkeypatch):
         monkeypatch.delenv("TPUNODE_VERIFY_KERNEL")
         importlib.reload(K)
     assert not K.pallas_broken()
+
+
+def test_acceptance_pows_gated_per_batch():
+    """verify_core gates the jacobi/parity acceptance pows on a
+    batch-level any() (lax.cond).  All four predicate combinations must
+    verdict exactly like the oracle — including rejections that ONLY the
+    gated pow can produce: signatures from a NON-canonicalized nonce,
+    whose R satisfies the x-match but fails jacobi/parity.  (A naive
+    s -> n-s tamper moves x(R) and dies at the x-match, which would let
+    a wrongly-taken skip path hide — review r5.)"""
+    from tpunode.verify.ecdsa_cpu import (
+        bip340_challenge,
+        jacobi,
+        lift_x,
+        schnorr_challenge,
+        sign_bip340,
+        sign_schnorr,
+        verify_batch_cpu,
+    )
+
+    def ecdsa_items(n):
+        out = []
+        for i in range(n):
+            priv = rng.getrandbits(256) % CURVE_N or 1
+            pub = point_mul(priv, GENERATOR)
+            z = rng.getrandbits(256)
+            r, s = sign(priv, z, rng.getrandbits(256) % CURVE_N or 1)
+            if i % 3 == 2:
+                z ^= 1
+            out.append((pub, z, r, s))
+        return out
+
+    def _nonce_with(pred):
+        """A nonce k whose R = kG satisfies ``pred(R)`` (rejection twins:
+        the signer's canonicalization step deliberately skipped)."""
+        while True:
+            k = rng.getrandbits(256) % CURVE_N or 1
+            R = point_mul(k, GENERATOR)
+            if pred(R):
+                return k, R
+
+    def schnorr_items(n):
+        out = []
+        for i in range(n):
+            priv = rng.getrandbits(256) % CURVE_N or 1
+            pub = point_mul(priv, GENERATOR)
+            m = rng.getrandbits(256)
+            if i % 3 == 2:
+                # x-matching twin that ONLY the jacobi pow rejects
+                k, R = _nonce_with(lambda R: jacobi(R.y) != 1)
+                r = R.x
+                e = schnorr_challenge(r, pub, m)
+                s = (k + e * priv) % CURVE_N
+            else:
+                r, s = sign_schnorr(priv, m, rng.getrandbits(256))
+                e = schnorr_challenge(r, pub, m)
+            out.append((pub, e, r, s, "schnorr"))
+        return out
+
+    def bip340_items(n):
+        out = []
+        for i in range(n):
+            priv = rng.getrandbits(256) % CURVE_N or 1
+            P0 = point_mul(priv, GENERATOR)
+            pub = lift_x(P0.x)
+            # the secret for the even-y (lifted) pubkey
+            d = priv if P0.y % 2 == 0 else CURVE_N - priv
+            m = rng.getrandbits(256)
+            if i % 3 == 2:
+                # x-matching twin that ONLY the parity pow rejects
+                k, R = _nonce_with(lambda R: R.y % 2 != 0)
+                r = R.x
+                e = bip340_challenge(r, P0.x, m)
+                s = (k + e * d) % CURVE_N
+            else:
+                r, s = sign_bip340(priv, m, rng.getrandbits(256))
+                e = bip340_challenge(r, P0.x, m)
+            out.append((pub, e, r, s, "bip340"))
+        return out
+
+    sch, bip = schnorr_items(8), bip340_items(8)
+    # the twins' ONLY defect is jacobi/parity: the oracle rejects exactly
+    # the i % 3 == 2 lanes (had the x-match failed too, this test could
+    # not distinguish a broken skip gate)
+    assert verify_batch_cpu(sch) == [i % 3 != 2 for i in range(8)]
+    assert verify_batch_cpu(bip) == [i % 3 != 2 for i in range(8)]
+    batches = [
+        ecdsa_items(8),                      # both pows skipped
+        sch,                                 # jacobi pow only
+        bip,                                 # parity pow only
+        ecdsa_items(3) + schnorr_items(3) + bip340_items(2),  # both
+    ]
+    for items in batches:
+        got = verify_batch_tpu(items, pad_to=8)
+        expect = verify_batch_cpu(items)
+        assert got == expect, (got, expect)
+        assert True in got and False in got  # non-degenerate both ways
