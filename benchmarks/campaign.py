@@ -17,10 +17,14 @@ valid signatures plus adversarial shapes for all three algorithms:
 Run (CPU-only, never touches the tunnel):
 
     JAX_PLATFORMS=cpu python -m benchmarks.campaign [unique_pool] [batch]
+    JAX_PLATFORMS=cpu python -m benchmarks.campaign --pallas [pool] [batch]
 
-Prints one JSON line: items compared, mismatches (MUST be 0), and the
-per-shape tally.  Replaces the one-off scripts behind PERF.md's r5
-campaign notes with a committed, re-runnable harness.
+``--pallas`` sends the same pool through the flagship Pallas program in
+interpret mode (numpy semantics of the exact Mosaic program; block 32)
+instead of the XLA program — both device paths validated by one
+harness.  Prints one JSON line: items compared, mismatches (MUST be 0),
+and the per-shape tally.  Replaces the one-off scripts behind PERF.md's
+r5 campaign notes with a committed, re-runnable harness.
 """
 
 from __future__ import annotations
@@ -121,10 +125,10 @@ def build_pool(n_base: int, rng: random.Random):
     return items, shapes, expects
 
 
-def main() -> None:
-    n_base = int(sys.argv[1]) if len(sys.argv) > 1 else 256
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
-
+def run_campaign(n_base: int, batch: int, pallas: bool = False) -> dict:
+    """Build the pool and compare the chosen device program against the
+    C++ verifier AND each shape's required verdict.  Returns the result
+    dict (``mismatches`` MUST be 0)."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -135,6 +139,23 @@ def main() -> None:
     from tpunode.verify.kernel import verify_batch_tpu
 
     enable_compile_cache()
+    if pallas:
+        import jax.numpy as jnp
+
+        from tpunode.verify.kernel import collect_verdicts, prepare_batch
+        from tpunode.verify.pallas_kernel import verify_blocked
+
+        def device_verify(chunk, pad_to):
+            prep = prepare_batch(chunk, pad_to=pad_to)
+            out = verify_blocked(
+                *(jnp.asarray(a) for a in prep.device_args),
+                interpret=True, block=32,
+            )
+            return collect_verdicts(out, len(chunk))
+    else:
+        def device_verify(chunk, pad_to):
+            return verify_batch_tpu(chunk, pad_to=pad_to)
+
     rng = random.Random(0xCA4)
     t0 = time.time()
     items, shapes, expects = build_pool(n_base, rng)
@@ -151,7 +172,7 @@ def main() -> None:
     tally: dict[str, list[int]] = {}
     for lo in range(0, len(items), batch):
         chunk = items[lo:lo + batch]
-        got = verify_batch_tpu(chunk, pad_to=batch)
+        got = device_verify(chunk, batch)
         expect = oracle(chunk)
         for j, (g, e) in enumerate(zip(got, expect)):
             shape = shapes[lo + j]
@@ -163,17 +184,30 @@ def main() -> None:
                      "oracle": e, "required": expects[lo + j]}
                 )
     run_s = time.time() - t0
-    print(json.dumps({
+    return {
         "items": len(items),
         "mismatches": len(mismatches),
         "mismatch_detail": mismatches[:10],
+        "kernel": "pallas-interpret" if pallas else "xla",
         "gen_s": round(gen_s, 1),
         "run_s": round(run_s, 1),
         "oracle": "native-cpp" if native is not None else "python",
         "tally": {k: {"accepted": v[0], "total": v[1]}
                   for k, v in sorted(tally.items())},
-    }))
-    if mismatches:
+    }
+
+
+def main() -> None:
+    pallas = "--pallas" in sys.argv
+    pos = [a for a in sys.argv[1:] if a != "--pallas"]
+    n_base = int(pos[0]) if pos else (32 if pallas else 256)
+    batch = int(pos[1]) if len(pos) > 1 else (256 if pallas else 2048)
+    if pallas and batch % 32:
+        sys.exit(f"--pallas batch must be a multiple of the 32-lane "
+                 f"interpret block (got {batch})")
+    res = run_campaign(n_base, batch, pallas=pallas)
+    print(json.dumps(res))
+    if res["mismatches"]:
         sys.exit(1)
 
 

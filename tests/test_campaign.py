@@ -1,0 +1,47 @@
+"""The committed adversarial-campaign harness (benchmarks/campaign.py)
+at small scale: pins the harness itself against bitrot so the PERF.md
+campaign evidence stays reproducible.  Compile-heavy (jits the full
+device programs) -> heavy tier."""
+
+from __future__ import annotations
+
+import pytest
+
+from benchmarks.campaign import build_pool, run_campaign
+
+pytestmark = pytest.mark.heavy
+
+
+def test_pool_shapes_and_expectations():
+    import random
+
+    items, shapes, expects = build_pool(9, random.Random(1))
+    assert len(items) == len(shapes) == len(expects)
+    # every algorithm contributes, and required verdicts mix both ways
+    assert any(s.startswith("ecdsa") for s in shapes)
+    assert any(s.startswith("schnorr") for s in shapes)
+    assert any(s.startswith("bip340") for s in shapes)
+    assert any(expects) and not all(expects)
+    # the pow-pinning twins are present
+    assert "schnorr-jacobi-twin" in shapes
+    assert "bip340-parity-twin" in shapes
+
+
+def test_campaign_xla_small():
+    res = run_campaign(6, 64)
+    assert res["mismatches"] == 0, res["mismatch_detail"]
+    assert res["kernel"] == "xla"
+    assert res["items"] > 40
+    t = res["tally"]
+    assert t["ecdsa-valid"]["accepted"] == t["ecdsa-valid"]["total"]
+    assert t["schnorr-jacobi-twin"]["accepted"] == 0
+    assert t["bip340-parity-twin"]["accepted"] == 0
+
+
+def test_campaign_pallas_interpret_small():
+    res = run_campaign(3, 32, pallas=True)
+    assert res["mismatches"] == 0, res["mismatch_detail"]
+    assert res["kernel"] == "pallas-interpret"
+    t = res["tally"]
+    assert t["schnorr-jacobi-twin"]["accepted"] == 0
+    assert t["bip340-parity-twin"]["accepted"] == 0
