@@ -170,3 +170,49 @@ def test_native_prep_bit_identical_to_python():
         a = np.asarray(getattr(py, name)).astype(np.int64)
         b = np.asarray(getattr(nat, name)).astype(np.int64)
         assert np.array_equal(a, b), name
+
+
+def test_pallas_schnorr_free_variant_matches_oracle():
+    """The ECDSA-only program variant (acceptance pows pruned at trace
+    time via the static schnorr_free flag) must verdict identically to
+    the oracle AND to the full program on an ECDSA-only batch."""
+    items, expected = _mixed_items(9)
+    prep = prepare_batch(items, pad_to=16)
+    assert not (prep.schnorr.any() or prep.bip340.any())  # ECDSA-only
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    pruned = verify_blocked(*args, interpret=True, block=8,
+                            schnorr_free=True)
+    full = verify_blocked(*args, interpret=True, block=8)
+    got = [bool(x) for x in np.asarray(pruned)[: prep.count]]
+    assert got == expected
+    assert np.array_equal(np.asarray(pruned), np.asarray(full))
+
+
+def test_dispatch_derives_schnorr_free_from_flags(monkeypatch):
+    """kernel._dispatch_prep selects the pruned variant exactly when no
+    lane carries a schnorr/bip340 flag — a wrong True on a mixed batch
+    would accept jacobi/parity forgeries."""
+    from tpunode.verify import kernel as K
+    from tpunode.verify import pallas_kernel as PK
+    from tpunode.verify.ecdsa_cpu import (
+        schnorr_challenge,
+        sign_schnorr,
+    )
+
+    seen = []
+
+    def fake_blocked(*args, schnorr_free=False, **kw):
+        seen.append(schnorr_free)
+        return jnp.zeros((args[8].shape[-1],), dtype=jnp.bool_)
+
+    monkeypatch.setattr(PK, "verify_blocked", fake_blocked)
+    monkeypatch.setattr(K, "_pallas_usable", lambda b: True)
+
+    ecdsa, _ = _mixed_items(4)
+    K._dispatch_prep(prepare_batch(ecdsa, pad_to=8))
+    priv = 77
+    pub = point_mul(priv, GENERATOR)
+    r, s = sign_schnorr(priv, 99, 1234)
+    mixed = ecdsa + [(pub, schnorr_challenge(r, pub, 99), r, s, "schnorr")]
+    K._dispatch_prep(prepare_batch(mixed, pad_to=8))
+    assert seen == [True, False]

@@ -172,6 +172,14 @@ class PreparedBatch:
     def device_args(self) -> tuple:
         return tuple(getattr(self, name) for name, _ in _DEVICE_FIELDS)
 
+    @property
+    def schnorr_free(self) -> bool:
+        """No lane carries a Schnorr/BIP340 flag: the batch may use the
+        program variants with the jacobi/parity acceptance pows pruned.
+        The ONE derivation every dispatch site must use — a wrong True
+        would accept jacobi/parity forgeries."""
+        return not (np.any(self.schnorr) or np.any(self.bip340))
+
 
 def _batch_inverse_mod_n(values: list[int]) -> list[int]:
     """Montgomery batch inversion mod n: one pow() for the whole batch."""
@@ -741,8 +749,16 @@ def _dispatch_prep(prep: PreparedBatch) -> tuple[jnp.ndarray, int]:
     if _pallas_usable(args[8].shape[-1]):
         from .pallas_kernel import verify_blocked
 
+        # STATIC program choice from the host-side flags: an ECDSA-only
+        # batch (the common real shape) selects the variant with the
+        # jacobi/parity acceptance pows pruned at trace time.  The XLA
+        # program below gets the same effect at runtime via lax.cond.
+        schnorr_free = prep.schnorr_free
         try:
-            return verify_blocked(*args), prep.count
+            return (
+                verify_blocked(*args, schnorr_free=schnorr_free),
+                prep.count,
+            )
         except Exception as e:  # noqa: BLE001 — only Mosaic errors handled
             if not mark_pallas_broken_if_mosaic(e, where="at compile"):
                 raise

@@ -93,12 +93,18 @@ def _kernel(
     r1_ref,
     r2_ref,
     flags_ref,  # (4, B) int32: [r2_valid, host_valid, schnorr, bip340]
-    euler_ref,  # (2, 64) int32: (p-1)/2 and p-2 exponent digits, MSB first
-    out_ref,  # (1, B) int32
-    qtab_ref,  # scratch (16, 3, L, B)
-    lqtab_ref,  # scratch (16, 3, L, B)
-    powtab_ref,  # scratch (16, L, B): Euler pow window table
+    # remaining refs depend on the STATIC variant (pallas passes inputs,
+    # then outputs, then scratch, positionally):
+    #   full:         euler_ref, out_ref, qtab, lqtab, powtab
+    #   schnorr_free: out_ref, qtab, lqtab   (no digits, no pow scratch)
+    *rest,
+    schnorr_free: bool = False,
 ):
+    if schnorr_free:
+        euler_ref = powtab_ref = None
+        out_ref, qtab_ref, lqtab_ref = rest
+    else:
+        euler_ref, out_ref, qtab_ref, lqtab_ref, powtab_ref = rest
     b = out_ref.shape[-1]
     L = F.NLIMBS
     zero = jnp.zeros((L, b), jnp.int32)
@@ -175,46 +181,58 @@ def _kernel(
     # y = Y/Z so jacobi(y) = jacobi(Y·Z); Euler pow t^((p-1)/2) == 1 as a
     # windowed 4-bit exponentiation: the digit sequence is a compile-time
     # constant (_EULER_DIGITS), the 16-entry power table lives in VMEM.
-    t = PF.mul(Y, Z)
-    powtab_ref[0] = one
-    powtab_ref[1] = t
+    #
+    # ``schnorr_free`` (STATIC, set by the dispatcher when no lane in the
+    # batch carries a Schnorr/BIP340 flag — the common real shape: BTC
+    # mainnet has no BCH Schnorr, IBD-era blocks no taproot, and the
+    # ECDSA-only headline bench workload) prunes BOTH acceptance pows at
+    # trace time; the placeholders below are never selected by algo_ok.
+    if schnorr_free:
+        jac_ok = jnp.ones((1, b), dtype=jnp.bool_)
+        even_ok = jnp.ones((1, b), dtype=jnp.bool_)
+    else:
+        t = PF.mul(Y, Z)
+        powtab_ref[0] = one
+        powtab_ref[1] = t
 
-    def pow_build(k, carry):
-        powtab_ref[pl.ds(k, 1)] = PF.mul(powtab_ref[pl.ds(k - 1, 1)][0], t)[
-            None
-        ]
-        return carry
+        def pow_build(k, carry):
+            powtab_ref[pl.ds(k, 1)] = PF.mul(
+                powtab_ref[pl.ds(k - 1, 1)][0], t
+            )[None]
+            return carry
 
-    lax.fori_loop(2, 16, pow_build, 0)
+        lax.fori_loop(2, 16, pow_build, 0)
 
-    def pow_window_for(row):
-        def pow_window(w, pacc):
-            pacc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(pacc))))
-            d = euler_ref[row, w]
-            sel = None
-            for tv in range(16):
-                contrib = jnp.where(d == tv, powtab_ref[tv], 0)
-                sel = contrib if sel is None else sel + contrib
-            return PF.mul(pacc, sel)
+        def pow_window_for(row):
+            def pow_window(w, pacc):
+                pacc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(pacc))))
+                d = euler_ref[row, w]
+                sel = None
+                for tv in range(16):
+                    contrib = jnp.where(d == tv, powtab_ref[tv], 0)
+                    sel = contrib if sel is None else sel + contrib
+                return PF.mul(pacc, sel)
 
-        return pow_window
+            return pow_window
 
-    pacc = lax.fori_loop(0, 64, pow_window_for(0), one)
-    jac_ok = PF.eq(pacc, one)
+        pacc = lax.fori_loop(0, 64, pow_window_for(0), one)
+        jac_ok = PF.eq(pacc, one)
 
-    # BIP340 evenness: affine y = Y/Z via Fermat inverse Z^(p-2), then the
-    # canonical representative's low bit — reuse the power table with t=Z
-    powtab_ref[1] = Z
-    def pow_build_z(k, carry):
-        powtab_ref[pl.ds(k, 1)] = PF.mul(powtab_ref[pl.ds(k - 1, 1)][0], Z)[
-            None
-        ]
-        return carry
+        # BIP340 evenness: affine y = Y/Z via Fermat inverse Z^(p-2), then
+        # the canonical representative's low bit — reuse the power table
+        # with t=Z
+        powtab_ref[1] = Z
 
-    lax.fori_loop(2, 16, pow_build_z, 0)
-    zinv = lax.fori_loop(0, 64, pow_window_for(1), one)
-    y_aff = PF.mul(Y, zinv)
-    even_ok = (PF.canonical(y_aff)[0:1] & 1) == 0
+        def pow_build_z(k, carry):
+            powtab_ref[pl.ds(k, 1)] = PF.mul(
+                powtab_ref[pl.ds(k - 1, 1)][0], Z
+            )[None]
+            return carry
+
+        lax.fori_loop(2, 16, pow_build_z, 0)
+        zinv = lax.fori_loop(0, 64, pow_window_for(1), one)
+        y_aff = PF.mul(Y, zinv)
+        even_ok = (PF.canonical(y_aff)[0:1] & 1) == 0
 
     is_sch = flags_ref[2:3] != 0
     is_b340 = flags_ref[3:4] != 0
@@ -245,9 +263,14 @@ def verify_blocked_impl(
     *,
     interpret: bool = False,
     block: int = BLOCK,
+    schnorr_free: bool = False,
 ) -> jnp.ndarray:
     """Un-jitted kernel body — reused inside shard_map by multichip.py
-    (a jitted callee cannot be shard_mapped).  See :func:`verify_blocked`."""
+    (a jitted callee cannot be shard_mapped).  See :func:`verify_blocked`.
+
+    ``schnorr_free`` statically prunes the jacobi/parity acceptance pows
+    (see _kernel) — only set it when NO lane carries a schnorr/bip340
+    flag; verdicts are bit-identical for such batches."""
     blk = block
     bsz = qx.shape[-1]
     if bsz % blk != 0:
@@ -273,40 +296,21 @@ def verify_blocked_impl(
     tab_spec = pl.BlockSpec(
         (16, 3, F.NLIMBS, blk), lambda i: (0, 0, 0, 0)
     )
-    out = pl.pallas_call(
-        _kernel,
-        out_shape=jax.ShapeDtypeStruct((1, bsz), jnp.int32),
-        grid=(grid,),
-        in_specs=[
-            tab_spec,
-            tab_spec,
-            col(WINDOWS),
-            col(WINDOWS),
-            col(WINDOWS),
-            col(WINDOWS),
-            col(4),
-            col(F.NLIMBS),
-            col(F.NLIMBS),
-            col(F.NLIMBS),
-            col(F.NLIMBS),
-            col(4),
-            # Exponent digits live in SMEM: the kernel reads them with
-            # dynamic scalar indices inside the window fori_loop, which is
-            # scalar memory's canonical job — a VMEM block read that way
-            # is the r5 Mosaic-outage suspect (benchmarks/mosaic_diag.py
-            # probes both placements).
-            pl.BlockSpec(
-                (2, 64), lambda i: (0, 0), memory_space=pltpu.SMEM
-            ),
-        ],
-        out_specs=col(1),
-        scratch_shapes=[
-            pltpu.VMEM((16, 3, F.NLIMBS, blk), jnp.int32),
-            pltpu.VMEM((16, 3, F.NLIMBS, blk), jnp.int32),
-            pltpu.VMEM((16, F.NLIMBS, blk), jnp.int32),
-        ],
-        interpret=interpret,
-    )(
+    in_specs = [
+        tab_spec,
+        tab_spec,
+        col(WINDOWS),
+        col(WINDOWS),
+        col(WINDOWS),
+        col(WINDOWS),
+        col(4),
+        col(F.NLIMBS),
+        col(F.NLIMBS),
+        col(F.NLIMBS),
+        col(F.NLIMBS),
+        col(4),
+    ]
+    operands = [
         _const_table(_G_NP, blk),
         _const_table(_LG_NP, blk),
         d1a.astype(jnp.int32),
@@ -319,18 +323,52 @@ def verify_blocked_impl(
         r1,
         r2,
         flags,
-        jnp.stack(
-            [jnp.asarray(_EULER_DIGITS), jnp.asarray(_PM2_DIGITS)], axis=0
-        ),
-    )
+    ]
+    scratch = [
+        pltpu.VMEM((16, 3, F.NLIMBS, blk), jnp.int32),
+        pltpu.VMEM((16, 3, F.NLIMBS, blk), jnp.int32),
+    ]
+    if not schnorr_free:
+        # Exponent digits live in SMEM: the kernel reads them with
+        # dynamic scalar indices inside the window fori_loop, which is
+        # scalar memory's canonical job — a VMEM block read that way
+        # is the r5 Mosaic-outage suspect (benchmarks/mosaic_diag.py
+        # probes both placements).  The schnorr_free variant omits the
+        # digits AND the (16, L, blk) pow-table scratch entirely — the
+        # pruned program reclaims that VMEM as headroom.
+        in_specs.append(
+            pl.BlockSpec((2, 64), lambda i: (0, 0), memory_space=pltpu.SMEM)
+        )
+        operands.append(
+            jnp.stack(
+                [jnp.asarray(_EULER_DIGITS), jnp.asarray(_PM2_DIGITS)],
+                axis=0,
+            )
+        )
+        scratch.append(pltpu.VMEM((16, F.NLIMBS, blk), jnp.int32))
+    out = pl.pallas_call(
+        partial(_kernel, schnorr_free=schnorr_free),
+        out_shape=jax.ShapeDtypeStruct((1, bsz), jnp.int32),
+        grid=(grid,),
+        in_specs=in_specs,
+        out_specs=col(1),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*operands)
     return out[0].astype(jnp.bool_)
 
 
-@partial(jax.jit, static_argnames=("interpret", "block"))
-def verify_blocked(*args, interpret: bool = False, block: int = BLOCK):
+@partial(jax.jit, static_argnames=("interpret", "block", "schnorr_free"))
+def verify_blocked(*args, interpret: bool = False, block: int = BLOCK,
+                   schnorr_free: bool = False):
     """Drop-in replacement for :func:`kernel.verify_core` (same argument
     order — PreparedBatch.device_args) running the Pallas kernel over
     lane blocks of ``block`` (default BLOCK; tests use small blocks in
     interpret mode).  Batch size must be a multiple of the block size
-    (prepare_batch pads to the engine's fixed shape)."""
-    return verify_blocked_impl(*args, interpret=interpret, block=block)
+    (prepare_batch pads to the engine's fixed shape).  ``schnorr_free``
+    selects the ECDSA-only program variant (acceptance pows pruned at
+    trace time) — callers must only set it when no lane carries a
+    schnorr/bip340 flag (kernel._dispatch_prep derives it from the
+    prepared batch)."""
+    return verify_blocked_impl(*args, interpret=interpret, block=block,
+                               schnorr_free=schnorr_free)
