@@ -245,14 +245,24 @@ def _worker_bench() -> None:
             )
             return
 
-        from tpunode.trace import profile_to
+        from tpunode.metrics import metrics
+        from tpunode.trace import profile_to, span
+        from tpunode.verify.engine import VerifyEngine
 
         times = []
         with profile_to(os.environ.get("TPUNODE_PROFILE")):
             for _ in range(iters):
                 t0 = time.perf_counter()
-                device_fn(*args, **kw).block_until_ready()
+                # spanned like the engine's dispatch so the telemetry
+                # section reports the same distribution the node would
+                with span("verify.dispatch"):
+                    device_fn(*args, **kw).block_until_ready()
                 times.append(time.perf_counter() - t0)
+                metrics.observe(
+                    "verify.occupancy",
+                    1.0,  # the bench pads with real (tiled) items
+                    buckets=VerifyEngine.OCCUPANCY_BUCKETS,
+                )
         dt = statistics.median(times)
         print(
             json.dumps(
@@ -265,6 +275,7 @@ def _worker_bench() -> None:
                     "step_ms": round(dt * 1e3, 3),
                     "compile_s": round(compile_s, 1),
                     "init_s": round(init_s, 1),
+                    "telemetry": metrics.telemetry(),
                 }
             )
         )
@@ -588,6 +599,17 @@ def _main_locked() -> None:
             out[k] = res[k]
     if probe.get("init_s") is not None:
         out["probe_init_s"] = probe["init_s"]
+    # Telemetry section (span percentiles, occupancy histogram, event
+    # counts): normally measured inside the successful worker; when the
+    # number came from the watcher/fallback paths, fall back to this
+    # process's registry so the section is always present and labeled.
+    tel = res.get("telemetry")
+    if not isinstance(tel, dict):
+        from tpunode.metrics import metrics as _metrics
+
+        tel = _metrics.telemetry()
+        tel["source"] = "driver-local"
+    out["telemetry"] = tel
     print(json.dumps(out))
     if res.get("fatal"):
         sys.exit(1)  # kernel correctness failure must not look like success

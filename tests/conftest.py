@@ -34,6 +34,12 @@ try:
 except Exception:
     pass
 
+# Python 3.10: make asyncio.timeout exist (tpunode/compat.py backport) so
+# tests written against 3.11 run unchanged.  No-op on 3.11+.
+from tpunode.compat import install_asyncio_timeout
+
+install_asyncio_timeout()
+
 # Minimal async test support (pytest-asyncio is not in the image): run any
 # coroutine test function on a fresh event loop.
 import asyncio

@@ -977,6 +977,94 @@ def test_claim_pidfile_lifecycle(tmp_path, monkeypatch):
     W._release_pidfile()
 
 
+def test_span_overhead_micro():
+    """Hot-loop guard (ISSUE 1 satellite): one span enter+exit must cost
+    < 5µs so per-batch instrumentation never shows up in the profile.
+    Early-exits on the first batch under the bound (steady-state cost is
+    ~2.7µs) and only fails if ~20 attempts never once get a clean slice —
+    robust to scheduler noise on a busy shared box."""
+    import time
+
+    from tpunode.trace import span
+
+    def one_batch(n=3000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with span("bench.overhead"):
+                pass
+        return (time.perf_counter() - t0) / n
+
+    one_batch(500)  # warm caches
+    best = min(one_batch() for _ in range(3))
+    attempts = 0
+    while best >= 5e-6 and attempts < 20:
+        attempts += 1
+        best = min(best, one_batch())
+    assert best < 5e-6, f"span overhead {best * 1e6:.2f}µs >= 5µs"
+
+
+def test_span_disabled_escape_hatch(monkeypatch):
+    """TPUNODE_NO_METRICS=1 (metrics.disabled) makes spans record nothing."""
+    from tpunode.metrics import metrics
+    from tpunode.trace import span
+
+    monkeypatch.setattr(metrics, "disabled", True)
+    before = metrics.get("span.unit-disabled.count")
+    with span("unit-disabled"):
+        pass
+    assert metrics.get("span.unit-disabled.count") == before
+    assert metrics.histogram("span.unit-disabled") is None
+
+
+def test_bench_telemetry_passthrough(monkeypatch):
+    """A worker-reported telemetry section lands in the artifact line."""
+    bench = _load_bench()
+    tel = {
+        "spans": {"verify.dispatch": {"count": 5, "p50": 0.15, "p90": 0.16,
+                                      "p99": 0.16, "sum": 0.76, "min": 0.15,
+                                      "max": 0.16}},
+        "occupancy": {"count": 5, "p50": 1.0, "p90": 1.0, "p99": 1.0,
+                      "sum": 5.0, "min": 1.0, "max": 1.0,
+                      "buckets": {"1": 5}},
+        "events": {},
+    }
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 3.0}),
+            (_batch(32768), {"ok": True, "rate": 200000.0, "device": "tpu:v5e",
+                             "kernel": "pallas", "batch": 32768,
+                             "telemetry": tel}),
+        ],
+    )
+    assert rc == 0
+    assert line["telemetry"] == tel
+    assert line["telemetry"]["spans"]["verify.dispatch"]["p99"] == 0.16
+
+
+def test_bench_telemetry_always_present(monkeypatch):
+    """Fallback paths still carry a telemetry section (driver-local,
+    stable shape) so the BENCH JSON is self-describing every round."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": False, "error": "timed out after 120s"}),
+            (_batch(4096), {"ok": False, "error": "timed out after 150s"}),
+            (_is_fallback, {"ok": True, "rate": 460.0, "device": "cpu:cpu",
+                            "kernel": "xla", "batch": 2048}),
+        ],
+    )
+    assert rc == 0
+    tel = line["telemetry"]
+    assert tel["source"] == "driver-local"
+    assert "verify.dispatch" in tel["spans"]
+    assert "count" in tel["spans"]["verify.dispatch"]
+    assert "occupancy" in tel
+
+
 def test_rotate_keep_drops_stale_rows(tmp_path, monkeypatch):
     """Fail-closed: even under TPUNODE_WATCHER_KEEP_RUNS=1 a leaked flag
     at a round-start launch cannot resurface a previous round's samples

@@ -1,0 +1,159 @@
+"""Structured event log tests: ring buffer, JSONL schema/file sink, the
+observer hook, and the StatsReporter actor."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from tpunode.events import EventLog, StatsReporter
+from tpunode.metrics import Metrics
+
+
+def test_emit_and_tail():
+    log = EventLog(maxlen=8)
+    log.emit("peer.connect", peer="a:1", online=1)
+    log.emit("peer.disconnect", peer="a:1", online=0, error=None)
+    evs = log.tail(10)
+    assert [e["type"] for e in evs] == ["peer.connect", "peer.disconnect"]
+    assert evs[0]["peer"] == "a:1"
+    assert log.tail(10, type="peer.connect")[0]["online"] == 1
+
+
+def test_ring_eviction_keeps_counts():
+    log = EventLog(maxlen=4)
+    for i in range(10):
+        log.emit("chain.headers", count=i)
+    assert len(log.tail(100)) == 4
+    assert log.tail(100)[-1]["count"] == 9
+    # totals survive eviction
+    assert log.counts() == {"chain.headers": 10}
+
+
+def test_event_schema_golden():
+    """Every event is one flat JSON object with ``ts`` (unix seconds) and
+    ``type`` first — the JSONL contract consumers grep against."""
+    log = EventLog()
+    ev = log.emit(
+        "verify.dispatch", backend="cpu", size=128, occupancy=0.5,
+        seconds=0.01,
+    )
+    line = json.dumps(ev)
+    back = json.loads(line)
+    assert list(back)[:2] == ["ts", "type"]
+    assert isinstance(back["ts"], float) and back["ts"] > 1e9
+    assert back["type"] == "verify.dispatch"
+    assert back["backend"] == "cpu"
+    assert back["size"] == 128
+    assert back["occupancy"] == 0.5
+    assert back["seconds"] == 0.01
+
+
+def test_jsonl_file_sink(tmp_path):
+    path = tmp_path / "events.jsonl"
+    log = EventLog(path=str(path))
+    log.emit("peer.connect", peer="x")
+    log.emit("peer.ban", peer="x", reason="PeerSentBadHeaders", error="bad")
+    log.close()
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    rows = [json.loads(l) for l in lines]
+    assert rows[0]["type"] == "peer.connect"
+    assert rows[1]["reason"] == "PeerSentBadHeaders"
+    # appending across instances (restart) keeps the file append-only
+    log2 = EventLog(path=str(path))
+    log2.emit("stats")
+    log2.close()
+    assert len(path.read_text().splitlines()) == 3
+
+
+def test_env_var_sink(tmp_path, monkeypatch):
+    path = tmp_path / "env_events.jsonl"
+    monkeypatch.setenv("TPUNODE_EVENTS", str(path))
+    log = EventLog()
+    log.emit("chain.reorg", depth=2)
+    log.close()
+    assert json.loads(path.read_text())["depth"] == 2
+
+
+def test_broken_sink_degrades_to_memory(tmp_path):
+    log = EventLog(path=str(tmp_path / "no" / "such" / "dir" / "x.jsonl"))
+    log.emit("stats")  # must not raise
+    assert log.counts() == {"stats": 1}
+
+
+def test_subscribe_observer():
+    log = EventLog()
+    seen = []
+    unsub = log.subscribe(seen.append)
+    log.emit("peer.connect", peer="a")
+    assert seen and seen[0]["type"] == "peer.connect"
+    unsub()
+    log.emit("peer.connect", peer="b")
+    assert len(seen) == 1
+
+    # a broken observer never breaks the emitter
+    def boom(ev):
+        raise RuntimeError("observer bug")
+
+    log.subscribe(boom)
+    log.emit("peer.connect", peer="c")
+
+
+def test_stats_reporter_windowed_rates(monkeypatch):
+    import sys
+
+    M = sys.modules["tpunode.metrics"]
+    t = [5000.0]
+    monkeypatch.setattr(M.time, "monotonic", lambda: t[0])
+    reg = Metrics(disabled=False)
+    monkeypatch.setattr(sys.modules["tpunode.events"], "metrics", reg)
+    log = EventLog()
+    rep = StatsReporter(interval=10.0, log=log)
+
+    rep.tick()  # first tick: no previous snapshot, no rates
+    assert log.tail(1)[0]["rates"] == {}
+
+    reg.inc("chain.headers", 2000)
+    reg.inc("peer.msgs", labels={"peer": "a:1", "cmd": "ping"})
+    t[0] += 10.0
+    ev = rep.tick()
+    assert ev["rates"]["chain.headers"] == pytest.approx(200.0)
+    assert ev["counters"]["chain.headers"] == 2000.0
+    # unbounded-cardinality labeled series stay out of the persisted event
+    assert not any("{" in k for k in ev["counters"])
+
+    # an idle interval reports ~0, not a diluted lifetime average
+    t[0] += 10.0
+    ev = rep.tick()
+    assert ev["rates"]["chain.headers"] == pytest.approx(0.0)
+    assert log.counts()["stats"] == 3
+
+
+def test_stats_reporter_extra_hook_and_errors():
+    log = EventLog()
+    rep = StatsReporter(interval=1.0, log=log, extra=lambda: {"height": 7})
+    assert rep.tick()["height"] == 7
+    rep2 = StatsReporter(
+        interval=1.0, log=log, extra=lambda: 1 / 0  # broken embedder hook
+    )
+    assert "extra_error" in rep2.tick()
+
+
+@pytest.mark.asyncio
+async def test_stats_reporter_run_loop():
+    log = EventLog()
+    rep = StatsReporter(interval=0.01, log=log)
+    task = asyncio.get_running_loop().create_task(rep.run())
+
+    async def wait_two():
+        while log.counts().get("stats", 0) < 2:
+            await asyncio.sleep(0.01)
+
+    try:
+        await asyncio.wait_for(wait_two(), timeout=5)
+    finally:
+        task.cancel()
+    assert log.counts()["stats"] >= 2

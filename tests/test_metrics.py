@@ -1,0 +1,353 @@
+"""Telemetry registry tests: histograms, labels, rates, exposition, and
+the metric-name schema lint (OBSERVABILITY.md)."""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import threading
+
+import pytest
+
+from tpunode.metrics import (
+    DEFAULT_BUCKETS,
+    Histogram,
+    Metrics,
+    percentiles,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- histogram ---------------------------------------------------------------
+
+
+def test_histogram_empty():
+    h = Histogram()
+    assert h.count == 0
+    assert h.quantile(0.5) is None
+    assert h.quantile(0.99) is None
+    assert h.mean is None
+    s = h.summary()
+    assert s["count"] == 0 and s["p50"] is None and s["p99"] is None
+
+
+def test_histogram_single_sample_is_exact():
+    h = Histogram()
+    h.observe(0.0042)
+    for p in (0.0, 0.5, 0.9, 0.99, 1.0):
+        assert h.quantile(p) == pytest.approx(0.0042)
+    assert h.mean == pytest.approx(0.0042)
+    assert h.min == h.max == 0.0042
+
+
+def test_histogram_buckets_are_log_scaled_and_ordered():
+    assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+    ratios = {
+        DEFAULT_BUCKETS[i + 1] / DEFAULT_BUCKETS[i]
+        for i in range(len(DEFAULT_BUCKETS) - 1)
+    }
+    assert all(abs(r - 2.0) < 1e-9 for r in ratios)
+
+
+def test_histogram_quantiles_split_bimodal_distribution():
+    h = Histogram()
+    for _ in range(90):
+        h.observe(0.001)
+    for _ in range(10):
+        h.observe(1.0)
+    # p50 lands in the 1ms mode, p99 in the 1s mode (log-bucket midpoints
+    # are within one bucket factor of the true value)
+    assert h.quantile(0.5) < 0.003
+    assert h.quantile(0.99) > 0.3
+    assert h.count == 100
+    assert h.total == pytest.approx(90 * 0.001 + 10.0)
+
+
+def test_histogram_overflow_and_underflow():
+    h = Histogram()
+    h.observe(1e-9)   # below the first bound
+    h.observe(1e6)    # beyond the last bound
+    assert h.count == 2
+    assert h.quantile(0.0) >= h.min
+    assert h.quantile(1.0) <= h.max
+
+
+def test_histogram_custom_buckets():
+    h = Histogram(bounds=(0.25, 0.5, 0.75, 1.0))
+    for v in (0.1, 0.6, 0.6, 0.9):
+        h.observe(v)
+    assert sum(h.counts) == 4
+    assert h.counts[0] == 1  # <= 0.25
+    assert h.counts[2] == 2  # (0.5, 0.75]
+
+
+def test_percentiles_helper():
+    assert percentiles([], (0.5,)) == {}
+    assert percentiles([3.0], (0.5, 0.99)) == {"p50": 3.0, "p99": 3.0}
+    out = percentiles([1.0, 2.0, 3.0, 4.0], (0.5,))
+    assert out["p50"] == pytest.approx(2.5)
+
+
+# --- counters / gauges / labels ---------------------------------------------
+
+
+def test_labeled_snapshot_round_trip():
+    m = Metrics(disabled=False)
+    m.inc("peer.msgs", labels={"peer": "a:1", "cmd": "ping"})
+    m.inc("peer.msgs", 2, labels={"peer": "a:1", "cmd": "pong"})
+    m.inc("peer.msgs", labels={"cmd": "ping", "peer": "b:2"})  # order-free
+    snap = m.snapshot()
+    assert snap['peer.msgs{cmd="ping",peer="a:1"}'] == 1.0
+    assert snap['peer.msgs{cmd="pong",peer="a:1"}'] == 2.0
+    assert snap['peer.msgs{cmd="ping",peer="b:2"}'] == 1.0
+    # series() round-trips the normalized label tuples
+    series = m.series("peer.msgs")
+    assert series[(("cmd", "pong"), ("peer", "a:1"))] == 2.0
+    assert len(series) == 3
+    # labeled get
+    assert m.get("peer.msgs", labels={"peer": "a:1", "cmd": "pong"}) == 2.0
+    assert m.get("peer.msgs") == 0.0  # unlabeled series is separate
+
+
+def test_drop_label_evicts_peer_series():
+    """Session-end eviction: labeled series for a dead peer disappear,
+    other peers' series and the unlabeled aggregates survive."""
+    m = Metrics(disabled=False)
+    m.inc("peer.msgs", labels={"peer": "a:1", "cmd": "ping"})
+    m.inc("peer.msgs", labels={"peer": "b:2", "cmd": "ping"})
+    m.inc("peer.msgs_in", 2)
+    m.observe("peer.rtt", 0.01)
+    m.observe("peer.rtt", 0.01, labels={"peer": "a:1"})
+    m.set_gauge("peer.state", 1, labels={"peer": "a:1"})
+    m.drop_label("peer", "a:1")
+    assert m.series("peer.msgs") == {(("cmd", "ping"), ("peer", "b:2")): 1.0}
+    assert m.histogram("peer.rtt", labels={"peer": "a:1"}) is None
+    assert m.histogram("peer.rtt").count == 1  # aggregate untouched
+    assert m.get("peer.msgs_in") == 2.0
+    assert m.series("peer.state") == {}
+
+
+def test_gauge_and_counter_coexist():
+    m = Metrics(disabled=False)
+    m.inc("layer.things", 5)
+    m.set_gauge("layer.level", 0.5)
+    assert m.get("layer.things") == 5
+    assert m.get("layer.level") == 0.5
+    snap = m.snapshot()
+    assert snap["layer.things"] == 5 and snap["layer.level"] == 0.5
+
+
+def test_windowed_rate_and_lifetime_rate(monkeypatch):
+    import sys
+
+    # the package attribute `tpunode.metrics` is shadowed by the registry
+    # object (`from .metrics import metrics`); fetch the module itself
+    M = sys.modules["tpunode.metrics"]
+
+    t = [1000.0]
+    monkeypatch.setattr(M.time, "monotonic", lambda: t[0])
+    m = Metrics(disabled=False)
+    # 100 increments over 10 seconds
+    for i in range(10):
+        t[0] += 1.0
+        m.inc("layer.work", 10)
+    # idle hour
+    t[0] += 3600.0
+    # windowed rate over the last 60s of idleness is ~0, the lifetime
+    # rate is diluted, and neither is the other (the satellite fix)
+    assert m.rate("layer.work", window=60.0) == pytest.approx(0.0)
+    assert 0 < m.lifetime_rate("layer.work") < 0.1
+    # a fresh burst shows up in the window at ~burst/window scale
+    for i in range(5):
+        t[0] += 1.0
+        m.inc("layer.work", 100)
+    r = m.rate("layer.work", window=30.0)
+    assert r == pytest.approx(500 / 30.0, rel=0.5)
+
+
+def test_rate_of_unknown_counter_is_zero():
+    m = Metrics(disabled=False)
+    assert m.rate("layer.nothing") == 0.0
+    assert m.lifetime_rate("layer.nothing") == 0.0
+
+
+def test_disabled_registry_records_nothing():
+    m = Metrics(disabled=True)
+    m.inc("layer.things")
+    m.set_gauge("layer.level", 1.0)
+    m.observe("layer.hist", 0.5)
+    assert m.get("layer.things") == 0.0
+    assert m.get("layer.level") == 0.0
+    assert m.histogram("layer.hist") is None
+    assert m.snapshot() == {}
+
+
+def test_no_metrics_env_escape_hatch(monkeypatch):
+    monkeypatch.setenv("TPUNODE_NO_METRICS", "1")
+    assert Metrics().disabled
+    monkeypatch.delenv("TPUNODE_NO_METRICS")
+    assert not Metrics().disabled
+
+
+def test_thread_safety_under_concurrent_mutation():
+    m = Metrics(disabled=False)
+    N, T = 2000, 8
+
+    def work(i):
+        for _ in range(N):
+            m.inc("layer.counter")
+            m.observe("layer.hist", 0.001)
+            m.inc("layer.labeled", labels={"t": str(i % 2)})
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(T)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert m.get("layer.counter") == N * T
+    assert m.histogram("layer.hist").count == N * T
+    assert sum(m.series("layer.labeled").values()) == N * T
+
+
+# --- exposition --------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+-]+$"
+)
+
+
+def test_render_prometheus_parses():
+    m = Metrics(disabled=False)
+    m.inc("peer.msgs", labels={"peer": "[::1]:1", "cmd": "ping"})
+    m.inc("bus.dropped", 3)
+    m.set_gauge("peermgr.peers", 4)
+    m.observe("span.verify.dispatch", 0.01)
+    m.observe("span.verify.dispatch", 0.02)
+    text = m.render_prometheus()
+    assert text.endswith("\n")
+    lines = text.strip().split("\n")
+    types = {}
+    for line in lines:
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            types[name] = kind
+            continue
+        assert _PROM_LINE.match(line), line
+    assert types["tpunode_bus_dropped"] == "counter"
+    assert types["tpunode_peermgr_peers"] == "gauge"
+    assert types["tpunode_span_verify_dispatch"] == "histogram"
+    # histogram invariants: cumulative buckets end at count, +Inf present
+    bucket_lines = [
+        l for l in lines if l.startswith("tpunode_span_verify_dispatch_bucket")
+    ]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in bucket_lines]
+    assert counts == sorted(counts) and counts[-1] == 2
+    assert any('le="+Inf"' in l for l in bucket_lines)
+    assert "tpunode_span_verify_dispatch_count 2" in lines
+    # label values with special characters are escaped, not mangled
+    assert 'peer="[::1]:1"' in text
+
+
+def test_render_prometheus_no_duplicate_sample_names():
+    """The legacy span.<name>.seconds/.count counters must not collide
+    with the span histogram's _sum/_count series (Prometheus rejects a
+    scrape with duplicate sample names)."""
+    m = Metrics(disabled=False)
+    # exactly what trace.span records: histogram + both legacy counters
+    m.time_span("span.verify.dispatch", "span.verify.dispatch.seconds",
+                "span.verify.dispatch.count", 0.01)
+    text = m.render_prometheus()
+    names = [
+        line.split(" ")[0].split("{")[0]
+        for line in text.strip().split("\n")
+        if not line.startswith("#")
+    ]
+    non_bucket = [n for n in names if not n.endswith("_bucket")]
+    assert len(non_bucket) == len(set(non_bucket)), sorted(non_bucket)
+    assert "tpunode_span_verify_dispatch_count" in non_bucket  # histogram's
+
+
+def test_render_prometheus_full_precision_counters():
+    m = Metrics(disabled=False)
+    m.inc("peer.bytes_in", 123456789)
+    assert "tpunode_peer_bytes_in 123456789.0" in m.render_prometheus()
+
+
+def test_inc_batch_one_lock_semantics():
+    m = Metrics(disabled=False)
+    m.inc_batch((
+        ("peer.msgs_in", 1.0, None),
+        ("peer.bytes_in", 90.0, None),
+        ("peer.msgs", 1.0, {"peer": "a:1", "cmd": "ping"}),
+    ))
+    assert m.get("peer.msgs_in") == 1.0
+    assert m.get("peer.bytes_in") == 90.0
+    assert m.get("peer.msgs", labels={"peer": "a:1", "cmd": "ping"}) == 1.0
+    m2 = Metrics(disabled=True)
+    m2.inc_batch((("peer.msgs_in", 1.0, None),))
+    assert m2.get("peer.msgs_in") == 0.0
+
+
+def test_telemetry_section_shape():
+    m = Metrics(disabled=False)
+    tel = m.telemetry()
+    # the verify.dispatch row is always present, even empty
+    assert tel["spans"]["verify.dispatch"]["count"] == 0
+    assert tel["spans"]["verify.dispatch"]["p99"] is None
+    assert tel["occupancy"]["count"] == 0
+    m.observe("span.verify.dispatch", 0.125)
+    m.observe("verify.occupancy", 0.75, buckets=tuple(i / 20 for i in range(1, 21)))
+    tel = m.telemetry()
+    d = tel["spans"]["verify.dispatch"]
+    assert d["count"] == 1
+    assert d["p50"] == pytest.approx(0.125)
+    assert d["p90"] == pytest.approx(0.125)
+    assert d["p99"] == pytest.approx(0.125)
+    assert tel["occupancy"]["count"] == 1
+    assert tel["occupancy"]["p50"] == pytest.approx(0.75)
+    assert tel["occupancy"]["buckets"] == {"0.75": 1}
+
+
+# --- name-schema lint --------------------------------------------------------
+
+NAME_RE = re.compile(r"^[a-z]+(\.[a-z_]+)+$")
+# literal first-arg call sites of the recording APIs (multiline-tolerant)
+_CALL_RE = re.compile(
+    r"""(?:metrics\.(?:inc|observe|set_gauge)|span)\(\s*["']([^"']+)["']""",
+)
+
+
+def _iter_source_files():
+    for root, _, files in os.walk(os.path.join(REPO, "tpunode")):
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+    yield os.path.join(REPO, "bench.py")
+
+
+def test_telemetry_core_is_jax_free():
+    """metrics.py and events.py must never import jax (even lazily-at-top):
+    the telemetry core is used by the jax-free bench parent process and
+    must run anywhere (the CI sweep runs it under JAX_PLATFORMS=cpu)."""
+    for mod in ("metrics.py", "events.py"):
+        with open(os.path.join(REPO, "tpunode", mod), encoding="utf-8") as f:
+            src = f.read()
+        assert "import jax" not in src, f"{mod} imports jax"
+
+
+def test_metric_names_follow_schema():
+    """Every literal metrics.inc/observe/set_gauge name and span name in
+    the package follows the documented ``<layer>.<name>`` convention."""
+    bad = []
+    seen = 0
+    for path in _iter_source_files():
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for mo in _CALL_RE.finditer(src):
+            seen += 1
+            if not NAME_RE.match(mo.group(1)):
+                bad.append(f"{os.path.relpath(path, REPO)}: {mo.group(1)!r}")
+    assert seen > 20, "lint regex stopped matching call sites"
+    assert not bad, "metric names violating ^[a-z]+(\\.[a-z_]+)+$: " + "; ".join(bad)

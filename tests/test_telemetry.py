@@ -1,0 +1,185 @@
+"""Fakenet integration tests for the telemetry subsystem: structured
+events, RTT observations, wire-loop counters, and the Node.stats()/
+Node.health() snapshot API — no sockets, no TPU (JAX_PLATFORMS=cpu)."""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+
+import pytest
+
+from tests.fakenet import dummy_peer_connect
+from tests.fixtures import all_blocks
+from tpunode import (
+    BCH_REGTEST,
+    ChainBestBlock,
+    Namespaced,
+    Node,
+    NodeConfig,
+    PeerConnected,
+    Publisher,
+)
+from tpunode.events import events
+from tpunode.metrics import metrics
+from tpunode.peer import PeerError
+from tpunode.store import MemoryKV
+from tpunode.wire import NetworkAddress
+
+NET = BCH_REGTEST
+
+
+@contextlib.asynccontextmanager
+async def telemetry_node(timeout: float = 0.4, stats_interval: float = 0.05):
+    """test_node.make_test_node with telemetry-friendly knobs: a short
+    health-check timeout so the RTT ping fires within the test window,
+    and a fast StatsReporter cadence."""
+    pub = Publisher(name="node-events")
+    blocks = all_blocks()
+    cfg = NodeConfig(
+        net=NET,
+        store=Namespaced(MemoryKV(), b"node:"),
+        pub=pub,
+        max_peers=20,
+        peers=["[::1]:17486"],
+        discover=False,
+        address=NetworkAddress.from_host_port("0.0.0.0", 0, services=1),
+        timeout=timeout,
+        max_peer_life=48 * 3600,
+        stats_interval=stats_interval,
+        connect=lambda sa: dummy_peer_connect(NET, blocks),
+    )
+    async with pub.subscription() as evs:
+        async with Node(cfg) as node:
+            yield node, evs
+
+
+async def _poll(predicate, timeout: float = 10.0, what: str = "condition"):
+    async def loop():
+        while not predicate():
+            await asyncio.sleep(0.01)
+
+    try:
+        await asyncio.wait_for(loop(), timeout=timeout)
+    except asyncio.TimeoutError:
+        raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.asyncio
+async def test_session_emits_events_rtt_and_stats():
+    """One fakenet session produces ≥3 distinct structured event types,
+    RTT observations after the simulated handshake, and a coherent
+    Node.stats()/health() snapshot (ISSUE 1 acceptance)."""
+    events.reset()
+    rtt_before = 0
+    h = metrics.histogram("peer.rtt")
+    if h is not None:
+        rtt_before = h.count
+    msgs_before = metrics.get("peer.msgs_in")
+
+    async with telemetry_node() as (node, evs):
+        # handshake completes and headers sync
+        await _poll(
+            lambda: events.counts().get("peer.connect", 0) >= 1,
+            what="peer.connect event",
+        )
+        await _poll(
+            lambda: events.counts().get("chain.headers", 0) >= 1,
+            what="chain.headers event",
+        )
+        # the health-check loop pings after ~timeout of quiet; fakenet
+        # pongs immediately -> an RTT observation lands
+        await _poll(
+            lambda: (metrics.histogram("peer.rtt") or None) is not None
+            and metrics.histogram("peer.rtt").count > rtt_before,
+            what="peer.rtt observation",
+        )
+        # per-peer RTT samples reach the fleet book-keeping too
+        await _poll(
+            lambda: any(o.pings for o in node.peer_mgr.get_peers()),
+            what="OnlinePeer.pings sample",
+        )
+        # the StatsReporter emitted at least one stats event
+        await _poll(
+            lambda: events.counts().get("stats", 0) >= 1, what="stats event"
+        )
+
+        # snapshot API: chain height, per-peer RTT quantiles, verify error
+        # counts — one call (ISSUE 1 acceptance)
+        s = node.stats()
+        assert s["chain"]["height"] == 15
+        assert s["peers"], "fleet missing from stats"
+        online = [p for p in s["peers"] if p["online"]]
+        assert online and online[0]["rtt_samples"] >= 1
+        assert set(online[0]["rtt"]) == {"p50", "p90", "p99"}
+        assert online[0]["rtt"]["p50"] >= 0.0
+        assert s["verify"]["enabled"] is False
+        assert s["verify"]["errors"] == metrics.get("node.verify_errors")
+        assert s["events"]["peer.connect"] >= 1
+
+        h = node.health()
+        assert h["ok"] is True
+        assert h["height"] == 15
+        assert h["peers_online"] >= 1
+        assert h["verify"] == "off"
+        assert h["uptime_seconds"] > 0
+
+        # wire-loop counters moved during the session
+        assert metrics.get("peer.msgs_in") > msgs_before
+        assert metrics.get("peer.bytes_in") > 0
+        assert metrics.get("peer.bytes_out") > 0
+        # labeled per-peer/per-command counters exist
+        assert any(
+            dict(lk).get("cmd") == "headers"
+            for lk in metrics.series("peer.msgs")
+        )
+
+        # kill the peer: the death must surface as a peer.disconnect event
+        p = node.peer_mgr.get_peers()[0].peer
+        p.kill(PeerError("test-kill"))
+        await _poll(
+            lambda: events.counts().get("peer.disconnect", 0) >= 1,
+            what="peer.disconnect event",
+        )
+
+    counts = events.counts()
+    distinct = [t for t, n in counts.items() if n > 0]
+    assert len(distinct) >= 3, f"want >=3 distinct event types, got {counts}"
+    for expected in ("peer.handshake", "peer.connect", "chain.headers",
+                     "stats", "peer.disconnect"):
+        assert counts.get(expected, 0) >= 1, (expected, counts)
+
+
+@pytest.mark.asyncio
+async def test_handshake_event_carries_peer_metadata():
+    events.reset()
+    async with telemetry_node(stats_interval=0) as (node, evs):
+        await _poll(
+            lambda: events.counts().get("peer.handshake", 0) >= 1,
+            what="peer.handshake event",
+        )
+        hs = events.tail(5, type="peer.handshake")[0]
+        assert hs["ok"] is True
+        assert hs["user_agent"] == "/fakenet:0/"
+        assert hs["version"] == 70012
+        assert hs["dial_seconds"] >= 0
+        # connect-attempt / fleet instrumentation moved
+        assert metrics.get("peermgr.connect_attempts") >= 1
+        assert metrics.get("peermgr.peers") >= 1
+        d = metrics.histogram("peermgr.dial_seconds")
+        assert d is not None and d.count >= 1
+
+
+@pytest.mark.asyncio
+async def test_stats_event_includes_node_context():
+    events.reset()
+    async with telemetry_node(stats_interval=0.05) as (node, evs):
+        await _poll(
+            lambda: any(
+                "height" in e for e in events.tail(50, type="stats")
+            ),
+            what="stats event with node context",
+        )
+        ev = events.tail(50, type="stats")[-1]
+        assert "peers" in ev and "peers_online" in ev
+        assert "rates" in ev and "counters" in ev

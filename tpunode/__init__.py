@@ -16,6 +16,8 @@ src/Haskoin/Node.hs:10-19).
 """
 
 from .actors import LinkedTasks, Mailbox, Publisher, Supervisor
+from .events import EventLog, StatsReporter, events
+from .metrics import Histogram, Metrics, metrics
 from .chain import (
     Chain,
     ChainBestBlock,

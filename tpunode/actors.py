@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 
+from .compat import timeout as _timeout
 from .metrics import metrics
 from typing import (
     AsyncIterator,
@@ -111,7 +112,7 @@ async def receive_match(
     """``receive_match`` with an optional timeout (NQE ``receiveMatchS``)."""
     if timeout is None:
         return await mailbox.receive_match(select)
-    async with asyncio.timeout(timeout):
+    async with _timeout(timeout):
         return await mailbox.receive_match(select)
 
 

@@ -33,6 +33,7 @@ from .headers import (
 )
 from .params import Network, PROTOCOL_VERSION
 from .peer import Peer, PeerSentBadHeaders, PeerTimeout
+from .events import events
 from .metrics import metrics
 from .store import KVStore, put_op
 from .trace import span
@@ -257,6 +258,9 @@ class Chain:
                 log.warning(
                     "[Chain] peer %s sent bad headers: %s", p.label, e
                 )
+                # the peer.ban event comes from the peer manager's death
+                # path (PeerSentBadHeaders is in _BAN_ERRORS) — emitting
+                # here too would double-count the incident
                 p.kill(PeerSentBadHeaders(str(e)))
                 return
             self.db.put_headers(nodes, best if best.hash != prev_best.hash else None)
@@ -268,6 +272,38 @@ class Chain:
                 p.label,
                 nodes[-1].height,
             )
+            events.emit(
+                "chain.headers", peer=p.label, count=len(nodes),
+                height=nodes[-1].height,
+            )
+        if best.hash != prev_best.hash:
+            metrics.set_gauge("chain.height", best.height)
+            # Reorg detection: if the new best simply extends the old tip
+            # (the first imported node's parent IS the old tip, or the old
+            # tip lies on the new nodes' path) this is free; otherwise one
+            # ancestor walk finds the fork point.
+            extended = bool(nodes) and (
+                nodes[0].header.prev == prev_best.hash
+                or any(n.hash == prev_best.hash for n in nodes)
+            )
+            if not extended:
+                try:
+                    fork = split_point(self.db, prev_best, best)
+                except BadHeaders:
+                    fork = None
+                if fork is not None and fork.hash != prev_best.hash:
+                    depth = prev_best.height - fork.height
+                    metrics.inc("chain.reorgs")
+                    log.warning(
+                        "[Chain] reorg depth %d: %s -> %s (fork at %d)",
+                        depth, prev_best.hash_hex, best.hash_hex, fork.height,
+                    )
+                    events.emit(
+                        "chain.reorg", depth=depth,
+                        fork_height=fork.height,
+                        old_tip=prev_best.hash_hex, old_height=prev_best.height,
+                        new_tip=best.hash_hex, new_height=best.height,
+                    )
         if self._syncing is not None:
             self._syncing.timestamp = time.monotonic()
             if nodes:

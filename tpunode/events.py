@@ -1,0 +1,197 @@
+"""Structured event log: append-only JSONL sink + StatsReporter actor.
+
+The reference's only observability is ad-hoc textual logging; this module
+records *typed* events — peer connect/disconnect/ban, handshake results,
+chain reorgs, header-batch imports, verify-batch dispatches and failures —
+into an in-memory ring buffer, optionally mirrored to a JSONL file
+(``TPUNODE_EVENTS=<path>``).  Every event is one JSON object::
+
+    {"ts": <unix seconds>, "type": "<layer>.<name>", ...fields}
+
+so a session's history can be replayed, grepped, or diffed (the schema is
+pinned by tests/test_events.py).  Emission is thread-safe (the verify
+engine emits from its dispatch worker thread) and cheap enough for the
+per-batch path; it is NOT wired into per-message hot loops.
+
+:class:`StatsReporter` is the periodic telemetry actor: it snapshots the
+metrics registry on an interval, computes *windowed* rates by diffing
+successive snapshots (fixing the since-process-start ``rate()``), and
+emits a ``stats`` event — the node links it like its other loops
+(tpunode/actors.py substrate).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import os
+import threading
+import time
+from collections import Counter, deque
+from typing import Callable, Optional
+
+from .metrics import metrics
+
+__all__ = ["EventLog", "events", "StatsReporter"]
+
+
+class EventLog:
+    """Ring buffer of typed events with an optional JSONL file sink."""
+
+    def __init__(self, maxlen: int = 4096, path: Optional[str] = None):
+        self._lock = threading.Lock()
+        # Separate sink lock: TextIOWrapper is NOT thread-safe, so file
+        # writes must serialize — but behind their own lock, so a slow
+        # disk stalls only writers, never ring readers/counters.
+        self._sink_lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=maxlen)
+        self._counts: Counter[str] = Counter()
+        self._file: Optional[io.TextIOBase] = None
+        self._path = path if path is not None else os.environ.get("TPUNODE_EVENTS")
+        # observers get every event dict (node republishes to its bus)
+        self._observers: list[Callable[[dict], None]] = []
+
+    def emit(self, type: str, **fields) -> dict:
+        """Record one event; returns the event dict (with ``ts`` set)."""
+        ev = {"ts": round(time.time(), 6), "type": type}
+        ev.update(fields)
+        with self._lock:
+            self._ring.append(ev)
+            self._counts[type] += 1
+            if self._path is not None and self._file is None:
+                try:
+                    # line-buffered: every event line reaches the OS
+                    # without an explicit flush() per emit
+                    self._file = open(
+                        self._path, "a", encoding="utf-8", buffering=1
+                    )
+                except OSError:
+                    self._path = None  # sink broken: memory ring only
+            sink = self._file
+            observers = tuple(self._observers)
+        if sink is not None:
+            line = json.dumps(ev, default=str) + "\n"
+            try:
+                with self._sink_lock:
+                    sink.write(line)
+            except (OSError, ValueError):
+                with self._lock:
+                    self._file = None
+                    self._path = None
+        for cb in observers:
+            try:
+                cb(ev)
+            except Exception:
+                pass  # a broken observer must not break emitters
+        return ev
+
+    def tail(self, n: int = 100, type: Optional[str] = None) -> list[dict]:
+        """Newest ``n`` events (oldest first), optionally one type only."""
+        with self._lock:
+            evs = list(self._ring)
+        if type is not None:
+            evs = [e for e in evs if e["type"] == type]
+        return evs[-n:]
+
+    def counts(self) -> dict[str, int]:
+        """Total events per type since start (survives ring eviction)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def subscribe(self, cb: Callable[[dict], None]) -> Callable[[], None]:
+        """Register an observer; returns an unsubscribe callable."""
+        with self._lock:
+            self._observers.append(cb)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                if cb in self._observers:
+                    self._observers.remove(cb)
+
+        return unsubscribe
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._counts.clear()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                finally:
+                    self._file = None
+
+
+# Process-wide event log (tests may construct their own).
+events = EventLog()
+
+
+# Counters surfaced as windowed rates in every stats event (the headline
+# node signals; anything else can be read from the snapshot itself).
+_RATED = (
+    "chain.headers",
+    "node.verify_txs",
+    "node.verify_inputs",
+    "verify.items",
+    "peer.msgs_in",
+    "peer.bytes_in",
+    "peer.bytes_out",
+)
+
+
+class StatsReporter:
+    """Periodic registry snapshot -> windowed rates -> ``stats`` events.
+
+    Rates are computed by diffing successive snapshots over the actual
+    elapsed interval, so an idle hour does not dilute the current
+    throughput the way ``lifetime_rate`` does.  Run it linked like any
+    node loop::
+
+        reporter = StatsReporter(interval=30.0)
+        tasks.link(reporter.run(), name="stats")
+    """
+
+    def __init__(
+        self,
+        interval: float = 30.0,
+        log: Optional[EventLog] = None,
+        extra: Optional[Callable[[], dict]] = None,
+    ):
+        self.interval = interval
+        self.log = log if log is not None else events
+        self.extra = extra  # node hook: chain height, fleet size, backlog
+        self._last: Optional[tuple[float, dict[str, float]]] = None
+
+    def tick(self) -> dict:
+        """One report (synchronous; the loop and tests both use it)."""
+        now = time.monotonic()
+        # unlabeled series only: the labeled families (per-peer msgs/RTT)
+        # are unbounded-cardinality and belong to Node.stats()/
+        # render_prometheus() pulls, not to an event persisted every tick
+        snap = {
+            k: v for k, v in metrics.snapshot().items() if "{" not in k
+        }
+        rates: dict[str, float] = {}
+        if self._last is not None:
+            t0, prev = self._last
+            dt = max(1e-9, now - t0)
+            for name in _RATED:
+                cur = snap.get(name, 0.0)
+                if cur or prev.get(name):
+                    rates[name] = round((cur - prev.get(name, 0.0)) / dt, 3)
+        self._last = (now, snap)
+        fields: dict = {"rates": rates, "counters": snap}
+        if self.extra is not None:
+            try:
+                fields.update(self.extra())
+            except Exception as e:
+                fields["extra_error"] = repr(e)
+        return self.log.emit("stats", **fields)
+
+    async def run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            self.tick()

@@ -15,8 +15,10 @@ import os
 import struct
 import subprocess
 import threading
+import time
 from typing import Iterator, Optional, Sequence
 
+from .metrics import metrics
 from .store import BatchOp, delete_op, put_op
 
 __all__ = ["NativeKV", "load_kvstore_lib", "ensure_native_lib"]
@@ -128,24 +130,40 @@ class NativeKV:
     def __init__(self, path: str, fsync: bool = False):
         self.path = path
         self.fsync = fsync
+        self._read_tick = 0
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._lib = load_kvstore_lib()
         self._h = self._lib.kv_open(path.encode())
         if not self._h:
             raise OSError(f"kv_open failed for {path!r}")
 
+    # Same 1-in-64 read-latency sampling as LogKV (store.py): the registry
+    # lock must not dominate a sub-µs native lookup.
+    _READ_SAMPLE_MASK = 63
+
     def get(self, key: bytes) -> Optional[bytes]:
+        sample = False
+        if not metrics.disabled:
+            self._read_tick += 1
+            sample = not (self._read_tick & self._READ_SAMPLE_MASK)
+        t0 = time.perf_counter() if sample else 0.0
         out = ctypes.c_void_p()
         outlen = ctypes.c_uint64()
         found = self._lib.kv_get(
             self._h, key, len(key), ctypes.byref(out), ctypes.byref(outlen)
         )
-        if not found:
-            return None
         try:
-            return ctypes.string_at(out.value, outlen.value)
+            if not found:
+                return None
+            try:
+                return ctypes.string_at(out.value, outlen.value)
+            finally:
+                self._lib.kv_buf_free(out)
         finally:
-            self._lib.kv_buf_free(out)
+            if sample:
+                metrics.observe(
+                    "store.read_seconds", time.perf_counter() - t0
+                )
 
     def put(self, key: bytes, value: bytes) -> None:
         self.write_batch([put_op(key, value)])
@@ -162,11 +180,15 @@ class NativeKV:
                 blob += _REC.pack(_OP_DEL, len(k), 0) + k
             else:
                 raise ValueError(f"unknown batch op {op!r}")
+        t0 = 0.0 if metrics.disabled else time.perf_counter()
         rc = self._lib.kv_write_batch(
             self._h, bytes(blob), len(blob), 1 if self.fsync else 0
         )
         if rc != 0:
             raise OSError(f"kv_write_batch failed ({rc})")
+        if not metrics.disabled:
+            metrics.observe("store.write_seconds", time.perf_counter() - t0)
+            metrics.inc("store.writes", len(ops))
 
     def scan_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
         out = ctypes.c_void_p()

@@ -23,7 +23,8 @@ from typing import Callable, Optional
 
 from .actors import LinkedTasks, Publisher, Supervisor
 from .chain import Chain, ChainBestBlock, ChainConfig, ChainEvent
-from .metrics import metrics
+from .events import StatsReporter, events
+from .metrics import metrics, percentiles
 from .txverify import (
     ExtractStats,
     combine_verdicts,
@@ -143,6 +144,9 @@ class NodeConfig:
     # north-star hook: when set, inbound tx/block signatures stream through
     # the batch verify engine and TxVerdict events reach the user bus
     verify: Optional[VerifyConfig] = None
+    # telemetry: seconds between StatsReporter snapshots (windowed rates +
+    # ``stats`` events on the structured event log); 0 disables the loop
+    stats_interval: float = 30.0
     # prevout oracle for BIP143 (P2WPKH / BCH FORKID) and BIP341 (taproot)
     # sighashes: (prevout txid, vout) -> satoshi amount, or
     # (amount, scriptPubKey), or None if unknown.  The tuple form enables
@@ -226,6 +230,8 @@ class Node:
         self._shed_counts: dict = {}
         self._shed_last_pub = 0.0
         self._shed_flush: Optional[asyncio.Task] = None
+        self._started_at: Optional[float] = None
+        self._stats_reporter: Optional[StatsReporter] = None
 
     @staticmethod
     def _verify_task_died(task, exc) -> None:
@@ -264,6 +270,12 @@ class Node:
         await self._stack.enter_async_context(self.peer_mgr)
         self._tasks.link(self._chain_events(chain_sub), name="glue-chain")
         self._tasks.link(self._peer_events(peer_sub), name="glue-peer")
+        self._started_at = _time.monotonic()
+        if self.cfg.stats_interval > 0:
+            self._stats_reporter = StatsReporter(
+                interval=self.cfg.stats_interval, extra=self._stats_extra
+            )
+            self._tasks.link(self._stats_reporter.run(), name="stats-reporter")
         log.info(
             "[Node] started on %s (%d static peers, discover=%s, verify=%s)",
             self.cfg.net.name,
@@ -284,6 +296,112 @@ class Node:
         # embedding scope was aborted with.
         if self._failure is not None and isinstance(exc, asyncio.CancelledError):
             raise self._failure
+
+    # -- telemetry snapshot API ---------------------------------------------
+
+    def _stats_extra(self) -> dict:
+        """Node-level context merged into every ``stats`` event."""
+        fleet = self.peer_mgr.fleet()
+        extra = {
+            "height": self._best_height(),
+            "peers": len(fleet),
+            "peers_online": sum(1 for o in fleet if o.online),
+        }
+        if self.verify_engine is not None:
+            extra["verify_backlog"] = self.verify_engine.queue_depth()
+            extra["verify_pending"] = self._verify_pending
+        return extra
+
+    def _uptime(self) -> float:
+        if self._started_at is None:
+            return 0.0  # not started yet: never report wall-clock garbage
+        return round(_time.monotonic() - self._started_at, 3)
+
+    def _best_height(self) -> Optional[int]:
+        """Best height, or None before the chain DB is initialized — a
+        probe scraped during startup must get an unhealthy snapshot, not
+        a RuntimeError from the uninitialized header store."""
+        try:
+            return self.chain.get_best().height
+        except Exception:
+            return None
+
+    def health(self) -> dict:
+        """Cheap liveness summary (the load-balancer probe shape)."""
+        fleet = self.peer_mgr.fleet()
+        return {
+            "ok": self._failure is None and self._started_at is not None,
+            "failure": repr(self._failure) if self._failure else None,
+            "uptime_seconds": self._uptime(),
+            "height": self._best_height(),
+            "synced": self.chain.is_synced(),
+            "peers": len(fleet),
+            "peers_online": sum(1 for o in fleet if o.online),
+            "verify": (
+                self.verify_engine.device_state
+                if self.verify_engine is not None
+                else "off"
+            ),
+        }
+
+    def stats(self) -> dict:
+        """Full telemetry snapshot in one call: chain height, per-peer
+        fleet state with RTT quantiles, verify-engine backlog and error
+        counts, event totals.  Everything here is lock-cheap reads — safe
+        to call from an embedder's status endpoint."""
+        try:
+            best = self.chain.get_best()
+        except Exception:  # pre-start: DB not initialized yet
+            best = None
+        now = _time.monotonic()
+        peers = []
+        for o in self.peer_mgr.fleet():
+            v = o.version
+            peers.append(
+                {
+                    "peer": o.peer.label,
+                    "address": f"{o.address[0]}:{o.address[1]}",
+                    "online": o.online,
+                    "connected_seconds": round(now - o.connected, 3),
+                    "rtt": percentiles(o.pings, (0.5, 0.9, 0.99)),
+                    "rtt_samples": len(o.pings),
+                    "user_agent": (
+                        v.user_agent.decode("latin-1") if v else None
+                    ),
+                    "start_height": v.start_height if v else None,
+                }
+            )
+        verify: dict = {
+            "enabled": self.verify_engine is not None,
+            "txs": metrics.get("node.verify_txs"),
+            "inputs": metrics.get("node.verify_inputs"),
+            "errors": metrics.get("node.verify_errors"),
+            "dropped": metrics.get("node.verify_dropped"),
+        }
+        if self.verify_engine is not None:
+            verify.update(self.verify_engine.stats())
+            verify.update(
+                pending_ingest=self._verify_pending,
+                accumulated_txs=len(self._tx_accum),
+            )
+        return {
+            "uptime_seconds": self._uptime(),
+            "chain": {
+                "height": best.height if best is not None else None,
+                "hash": best.hash_hex if best is not None else None,
+                "synced": self.chain.is_synced(),
+                "headers": metrics.get("chain.headers"),
+                "reorgs": metrics.get("chain.reorgs"),
+            },
+            "peers": peers,
+            "verify": verify,
+            "events": events.counts(),
+        }
+
+    def _verify_failure(self, where: str, error) -> None:
+        """Count + record one verify-path failure (extract/engine/decode)."""
+        metrics.inc("node.verify_errors")
+        events.emit("verify.failure", where=where, error=str(error)[:300])
 
     async def _chain_events(self, sub) -> None:
         """Chain events -> PeerMgr best height + user bus
@@ -473,7 +591,7 @@ class Node:
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
-                    metrics.inc("node.verify_errors")
+                    self._verify_failure("engine", e)
                     for ti, (peer, _, _) in enumerate(batch):
                         self.cfg.pub.publish(
                             TxVerdict(peer, items.txid(ti), False, (),
@@ -526,7 +644,7 @@ class Node:
                     # kill the peer); with lazy blocks it surfaces here —
                     # report it and kill the peer, never crash the router.
                     self._verify_pending -= 1
-                    metrics.inc("node.verify_errors")
+                    self._verify_failure("block-decode", e)
                     self.cfg.pub.publish(
                         TxVerdict(peer, b"", False, (), ExtractStats(),
                                   error=f"block decode: {e}")
@@ -560,7 +678,7 @@ class Node:
         bch = self.cfg.net.bch
 
         def _publish_extract_error(e: Exception) -> None:
-            metrics.inc("node.verify_errors")
+            self._verify_failure("extract", e)
             txids: list[bytes] = []
             try:
                 src = txs if txs is not None else block.txs
@@ -617,7 +735,7 @@ class Node:
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
-                    metrics.inc("node.verify_errors")
+                    self._verify_failure("engine", e)
                     for ti in range(items.n_txs):
                         self.cfg.pub.publish(
                             TxVerdict(peer, items.txid(ti), False, (),
@@ -689,7 +807,7 @@ class Node:
                         prevout_scripts=scripts or None,
                     )
                 except Exception as e:
-                    metrics.inc("node.verify_errors")
+                    self._verify_failure("extract", e)
                     try:
                         txid = tx.txid
                     except Exception:
@@ -719,7 +837,7 @@ class Node:
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
-                    metrics.inc("node.verify_errors")
+                    self._verify_failure("engine", e)
                     self.cfg.pub.publish(
                         TxVerdict(peer, tx.txid, False, (), stats,
                                   error=f"engine: {e}")

@@ -22,6 +22,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol, Union
 
 from .actors import Mailbox, Publisher
+from .compat import timeout as _timeout
+from .metrics import metrics
 from .params import Network
 from .util import hash_to_hex
 from .wire import (
@@ -282,6 +284,13 @@ async def _inbound_loop(cfg: PeerConfig, peer: Peer, conn: Connection) -> None:
             msg = decode_message(cfg.net, header, payload)
         except DecodeError as e:
             raise CannotDecodePayload(f"{header.command}: {e}") from e
+        if not metrics.disabled:  # hot loop: one flag read when off
+            metrics.inc_batch((  # one lock for all three
+                ("peer.msgs_in", 1.0, None),
+                ("peer.bytes_in", HEADER_SIZE + header.length, None),
+                ("peer.msgs", 1.0,
+                 {"peer": cfg.label, "cmd": header.command}),
+            ))
         if log.isEnabledFor(logging.DEBUG):  # hot loop: skip formatting cost
             log.debug(
                 "[Peer] %s: received %s (%d bytes)",
@@ -299,7 +308,13 @@ async def _outbound_loop(cfg: PeerConfig, inbox: Mailbox, conn: Connection) -> N
         item = await inbox.receive()
         if isinstance(item, _KillPeer):
             raise item.error
-        await conn.write(encode_message(cfg.net, item.message))
+        data = encode_message(cfg.net, item.message)
+        if not metrics.disabled:
+            metrics.inc_batch((
+                ("peer.msgs_out", 1.0, None),
+                ("peer.bytes_out", len(data), None),
+            ))
+        await conn.write(data)
 
 
 async def run_peer(cfg: PeerConfig, peer: Peer, inbox: Mailbox) -> None:
@@ -364,7 +379,7 @@ async def get_data(
         acc: list[Union[Tx, Block]] = []
         remaining = list(invs)
         try:
-            async with asyncio.timeout(seconds):
+            async with _timeout(seconds):
                 while remaining:
                     msg = await inbox.receive_match(select)
                     iv = remaining[0]
@@ -451,7 +466,7 @@ async def ping_peer(seconds: float, p: Peer) -> bool:
             return None
 
         try:
-            async with asyncio.timeout(seconds):
+            async with _timeout(seconds):
                 return await inbox.receive_match(select)
         except TimeoutError:
             return False

@@ -21,17 +21,25 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, Union
 
 from .actors import LinkedTasks, Mailbox, Publisher, Supervisor
+from .events import events
+from .metrics import metrics
 from .params import NODE_NETWORK, PROTOCOL_VERSION, Network
 from .peer import (
+    CannotDecodePayload,
+    DecodeHeaderError,
+    DuplicateVersion,
     Peer,
     PeerConfig,
     PeerConnected,
     PeerDisconnected,
     PeerError,
     PeerIsMyself,
+    PeerMisbehaving,
+    PeerSentBadHeaders,
     PeerTimeout,
     PeerTooOld,
     NotNetworkPeer,
+    PayloadTooLarge,
     UnknownPeer,
     WithConnection,
     run_peer,
@@ -51,6 +59,20 @@ __all__ = [
 log = logging.getLogger("tpunode.peermgr")
 
 SockAddr = tuple[str, int]  # (host, port)
+
+# Session-death causes that indicate peer misbehavior (vs. ordinary churn):
+# these emit a ``peer.ban`` event so embedders doing reputation tracking
+# see the protocol violation, not just a disconnect.
+_BAN_ERRORS = (
+    PeerMisbehaving,
+    PeerSentBadHeaders,
+    NotNetworkPeer,
+    DuplicateVersion,
+    PeerIsMyself,
+    CannotDecodePayload,
+    DecodeHeaderError,
+    PayloadTooLarge,
+)
 
 
 @dataclass
@@ -234,10 +256,17 @@ class PeerMgr:
             log.warning(
                 "[PeerMgr] peer %s lacks network service bit; killing", p.label
             )
+            events.emit(
+                "peer.handshake", peer=p.label, ok=False,
+                reason="not-network-peer",
+            )
             p.kill(NotNetworkPeer(p.label))
             return
         if any(o.nonce == v.nonce for o in self._peers):
             log.warning("[PeerMgr] peer %s is myself (nonce match); killing", p.label)
+            events.emit(
+                "peer.handshake", peer=p.label, ok=False, reason="is-myself"
+            )
             p.kill(PeerIsMyself(p.label))
             return
         o = self._find_peer(p)
@@ -271,11 +300,22 @@ class PeerMgr:
 
     def _announce_peer(self, o: OnlinePeer) -> None:
         # reference logConnectedPeers (PeerMgr.hs:285-290)
+        n_online = sum(1 for x in self._peers if x.online)
         log.info(
-            "[PeerMgr] connected to peer %s (%d online)",
-            o.peer.label,
-            sum(1 for x in self._peers if x.online),
+            "[PeerMgr] connected to peer %s (%d online)", o.peer.label, n_online
         )
+        dial = time.monotonic() - o.connected
+        metrics.observe("peermgr.dial_seconds", dial)
+        metrics.set_gauge("peermgr.peers_online", n_online)
+        v = o.version
+        events.emit(
+            "peer.handshake", peer=o.peer.label, ok=True,
+            version=v.version if v else None,
+            user_agent=v.user_agent.decode("latin-1") if v else None,
+            height=v.start_height if v else None,
+            dial_seconds=round(dial, 6),
+        )
+        events.emit("peer.connect", peer=o.peer.label, online=n_online)
         self.cfg.pub.publish(PeerConnected(o.peer))
 
     def _on_addrs(self, addrs: list[NetworkAddress]) -> None:
@@ -296,8 +336,11 @@ class PeerMgr:
         if nonce != expected:
             return
         o.ping = None
+        rtt = time.monotonic() - sent
+        metrics.observe("peer.rtt", rtt)
+        metrics.observe("peer.rtt", rtt, labels={"peer": o.peer.label})
         # newest 11 samples (reference keeps `take 11 $ diff : pings`)
-        o.pings = ([time.monotonic() - sent] + o.pings)[:11]
+        o.pings = ([rtt] + o.pings)[:11]
 
     def _check_peer(self, p: Peer) -> None:
         """Health check: lifetime eviction + tickle/ping staleness
@@ -338,9 +381,31 @@ class PeerMgr:
             f": {exc}" if exc else "",
             sum(1 for x in self._peers if x.online) - (1 if o.online else 0),
         )
+        metrics.inc("peermgr.disconnects")
+        if not o.online:
+            # died before completing the handshake: a failed dial
+            metrics.inc("peermgr.connect_failures")
+        events.emit(
+            "peer.disconnect", peer=o.peer.label, online=o.online,
+            error=repr(exc) if exc else None,
+        )
+        if isinstance(exc, _BAN_ERRORS):
+            metrics.inc("peermgr.bans")
+            events.emit(
+                "peer.ban", peer=o.peer.label,
+                reason=type(exc).__name__, error=str(exc),
+            )
         if o.online:
             self.cfg.pub.publish(PeerDisconnected(o.peer))
         self._peers.remove(o)
+        # evict the dead peer's labeled series (peer.msgs{peer=},
+        # peer.rtt{peer=}): churn through thousands of addresses must not
+        # grow the registry without bound
+        metrics.drop_label("peer", o.peer.label)
+        metrics.set_gauge("peermgr.peers", len(self._peers))
+        metrics.set_gauge(
+            "peermgr.peers_online", sum(1 for x in self._peers if x.online)
+        )
 
     # -- address book & connecting ------------------------------------------
 
@@ -379,6 +444,7 @@ class PeerMgr:
             return
         label = f"[{sa[0]}]:{sa[1]}" if ":" in sa[0] else f"{sa[0]}:{sa[1]}"
         log.debug("[PeerMgr] connecting to %s", label)
+        metrics.inc("peermgr.connect_attempts")
         nonce = random.getrandbits(64)
         inbox: Mailbox = Mailbox(name=f"peer-{label}")
         pc = PeerConfig(
@@ -411,6 +477,7 @@ class PeerMgr:
                 tickled=now,
             )
         )
+        metrics.set_gauge("peermgr.peers", len(self._peers))
 
     async def _launch_peer(self, pc: PeerConfig, p: Peer, inbox: Mailbox) -> None:
         """Child body: the session linked with its jittered check timer
@@ -467,6 +534,10 @@ class PeerMgr:
         return sorted(
             (o for o in self._peers if o.online), key=OnlinePeer.median_ping
         )
+
+    def fleet(self) -> list[OnlinePeer]:
+        """Every tracked peer, online or mid-handshake (telemetry view)."""
+        return list(self._peers)
 
     def get_online_peer(self, p: Peer) -> Optional[OnlinePeer]:
         return self._find_peer(p)

@@ -4,15 +4,21 @@ The reference has no tracing at all (SURVEY.md §5 "Tracing / profiling:
 absent"); this module supplies what the TPU build needs to report the
 BASELINE metrics honestly:
 
-* :func:`span` — a context manager that times a region into the metrics
-  registry (``span.<name>.seconds`` / ``.count``) and, when JAX is
-  importable, also emits a ``jax.profiler.TraceAnnotation`` so the region
-  shows up named on the TensorBoard/perfetto timeline of a device trace.
+* :class:`span` — a context manager that times a region into the metrics
+  registry: durations land in the ``span.<name>`` histogram (p50/p90/p99
+  via ``metrics.histogram("span.<name>").quantile``) plus the legacy
+  ``span.<name>.seconds`` / ``.count`` counters.  While a
+  :func:`profile_to` capture is active it also emits a
+  ``jax.profiler.TraceAnnotation`` so the region shows up named on the
+  TensorBoard/perfetto timeline of the device trace.
 * :func:`profile_to` — wraps ``jax.profiler.trace``: capture a full device
   profile into a directory (``TPUNODE_PROFILE=<dir>`` in bench.py).
 
-Spans are deliberately cheap (two ``perf_counter`` calls and a dict update)
-so they can wrap the per-batch hot path.
+Spans are deliberately cheap — a slotted context-manager class, two
+``perf_counter`` calls and one locked registry update, with the profiler
+annotation skipped outside an active capture — so they can wrap the
+per-batch hot path (< 5µs per entry, pinned by tests/test_bench.py).
+``TPUNODE_NO_METRICS=1`` (metrics.disabled) skips the timing entirely.
 """
 
 from __future__ import annotations
@@ -32,33 +38,65 @@ try:
 except Exception:  # jax absent: spans still time into metrics
     _jax_profiler = None
 
-
-def _annotation(name: str):
-    if _jax_profiler is None:
-        return contextlib.nullcontext()
-    try:
-        return _jax_profiler.TraceAnnotation(name)
-    except Exception:  # profiler unavailable on this backend
-        return contextlib.nullcontext()
+# True only inside a profile_to() capture: spans skip the per-entry
+# TraceAnnotation construction otherwise (it costs ~2µs — measurable
+# against the <5µs span budget, and useless without an active trace).
+_profiling = False
 
 
-@contextlib.contextmanager
-def span(name: str) -> Iterator[None]:
-    """Time a region into metrics (and the device profile timeline)."""
-    t0 = time.perf_counter()
-    with _annotation(name):
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            metrics.inc(f"span.{name}.seconds", dt)
-            metrics.inc(f"span.{name}.count")
+# name -> ("span.<name>", "span.<name>.seconds", "span.<name>.count"):
+# precomputed so the hot path allocates no strings per span entry.
+_span_names: dict[str, tuple[str, str, str]] = {}
+
+
+def _names(name: str) -> tuple[str, str, str]:
+    keys = _span_names.get(name)
+    if keys is None:
+        keys = _span_names[name] = (
+            f"span.{name}",
+            f"span.{name}.seconds",
+            f"span.{name}.count",
+        )
+    return keys
+
+
+class span:
+    """``with span("verify.dispatch"): ...`` — see module docstring."""
+
+    __slots__ = ("_name", "_ann", "_t0")
+
+    def __init__(self, name: str):
+        self._name = name
+        self._ann = None
+
+    def __enter__(self) -> "span":
+        if _profiling and _jax_profiler is not None:
+            try:
+                ann = _jax_profiler.TraceAnnotation(self._name)
+                ann.__enter__()
+                self._ann = ann
+            except Exception:  # profiler unavailable on this backend
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dt = time.perf_counter() - self._t0
+        if not metrics.disabled:
+            keys = _names(self._name)
+            metrics.time_span(keys[0], keys[1], keys[2], dt)
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+            self._ann = None
+        return False
 
 
 @contextlib.contextmanager
 def profile_to(directory: Optional[str]) -> Iterator[None]:
     """Capture a JAX device profile into ``directory`` (no-op when None or
-    the profiler is unavailable)."""
+    the profiler is unavailable).  Spans entered during the capture are
+    annotated onto the device timeline."""
+    global _profiling
     if not directory:
         yield
         return
@@ -69,5 +107,9 @@ def profile_to(directory: Optional[str]) -> Iterator[None]:
     except Exception:
         yield
         return
-    with cm:
-        yield
+    _profiling = True
+    try:
+        with cm:
+            yield
+    finally:
+        _profiling = False

@@ -34,6 +34,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
+from ..events import events
 from ..metrics import metrics
 from ..trace import span
 from .ecdsa_cpu import Point, verify_batch_cpu
@@ -271,6 +272,10 @@ class VerifyEngine:
                     e,
                     self.cfg.batch_size,
                 )
+                events.emit(
+                    "verify.device", state="ready", kind=e.kind,
+                    degraded_batch=self.cfg.batch_size, error=str(e),
+                )
             except Exception as e:  # noqa: BLE001 — any failure disables tpu
                 self._device_error = f"{type(e).__name__}: {e}"
                 self._device_state = "failed"
@@ -278,11 +283,18 @@ class VerifyEngine:
                     "[Engine] device warmup failed, using cpu engine: %s",
                     self._device_error,
                 )
+                events.emit(
+                    "verify.device", state="failed", error=self._device_error
+                )
             else:
                 self._device_kind = kind
                 self._device_state = "ready"
                 dt = time.monotonic() - self._warmup_started
                 log.info("[Engine] device ready (%s) after %.1fs", kind, dt)
+                events.emit(
+                    "verify.device", state="ready", kind=kind,
+                    warmup_seconds=round(dt, 3),
+                )
             finally:
                 self._warmup_done.set()
 
@@ -291,6 +303,35 @@ class VerifyEngine:
     @property
     def device_state(self) -> str:
         return self._device_state
+
+    def queue_depth(self) -> dict[str, int]:
+        """Current backlog: queued submissions and total items in them."""
+        q = tuple(self._queue)
+        return {
+            "batches": len(q),
+            "items": sum(len(p) for p, _ in q),
+        }
+
+    def stats(self) -> dict:
+        """Telemetry snapshot for Node.stats()/health()."""
+        out = {
+            "backend": self.cfg.backend,
+            "device_state": self._device_state,
+            "device_kind": self._device_kind or None,
+            "device_error": self._device_error,
+            "device_batch": self._device_batch,
+            "backlog": self.queue_depth(),
+            "batches": metrics.get("verify.batches"),
+            "items": metrics.get("verify.items"),
+            "errors": metrics.get("verify.dispatch_errors"),
+        }
+        occ = metrics.histogram("verify.occupancy")
+        if occ is not None:
+            out["occupancy"] = occ.summary()
+        disp = metrics.histogram("span.verify.dispatch")
+        if disp is not None:
+            out["dispatch_seconds"] = disp.summary()
+        return out
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -381,7 +422,7 @@ class VerifyEngine:
                 metrics.set_gauge("verify.batch_occupancy", total / target)
                 try:
                     results = await asyncio.to_thread(
-                        self._dispatch_multi, payloads
+                        self._dispatch_multi, payloads, target
                     )
                 except Exception as e:  # engine errors fail the waiters
                     log.error("[Engine] batch of %d failed: %s", total, e)
@@ -430,32 +471,60 @@ class VerifyEngine:
             log.info("[Engine] device warmup still running; batches on cpu")
         return "cpu" if self._cpu is not None else "oracle"
 
-    def _dispatch_multi(self, payloads: list) -> list[bool]:
+    # Linear occupancy buckets (0.05 steps): the default log-scaled bounds
+    # are duration-shaped and would quantize [0, 1] far too coarsely.
+    OCCUPANCY_BUCKETS = tuple(i / 20 for i in range(1, 21))
+
+    def _dispatch_multi(
+        self, payloads: list, target: Optional[int] = None
+    ) -> list[bool]:
         """Verify a coalesced batch of payloads (tuple lists and/or raw
-        batches) on one backend; results are in payload order."""
+        batches) on one backend; results are in payload order.  ``target``
+        is the fill goal the queue lingered for (None on the synchronous
+        paths) — it sizes the occupancy observation."""
         with span("verify.dispatch"):
             total = sum(len(p) for p in payloads)
+            occupancy = total / target if target else None
+            if occupancy is not None:
+                metrics.observe(
+                    "verify.occupancy",
+                    min(1.0, occupancy),
+                    buckets=self.OCCUPANCY_BUCKETS,
+                )
             backend = self._pick(total)
             t0 = time.perf_counter()
-            if backend == "tpu":
-                out = self._run_tpu(payloads)  # counts tpu/cpu items per chunk
-            elif backend == "cpu" and self._cpu is not None:
-                out = self._cpu.verify_raw(
-                    concat_raw([as_raw_batch(p) for p in payloads]),
-                    nthreads=self.cfg.cpu_threads,
-                )
-                metrics.inc("verify.cpu_items", total)
-            else:
-                out = []
-                for p in payloads:
-                    out.extend(
-                        verify_batch_cpu(
-                            p if isinstance(p, list) else as_raw_batch(p).to_tuples()
-                        )
+            try:
+                if backend == "tpu":
+                    out = self._run_tpu(payloads)  # counts tpu/cpu items per chunk
+                elif backend == "cpu" and self._cpu is not None:
+                    out = self._cpu.verify_raw(
+                        concat_raw([as_raw_batch(p) for p in payloads]),
+                        nthreads=self.cfg.cpu_threads,
                     )
-                metrics.inc("verify.oracle_items", total)
+                    metrics.inc("verify.cpu_items", total)
+                else:
+                    out = []
+                    for p in payloads:
+                        out.extend(
+                            verify_batch_cpu(
+                                p if isinstance(p, list) else as_raw_batch(p).to_tuples()
+                            )
+                        )
+                    metrics.inc("verify.oracle_items", total)
+            except Exception as e:
+                metrics.inc("verify.dispatch_errors")
+                events.emit(
+                    "verify.failure", where="dispatch", backend=backend,
+                    size=total, error=f"{type(e).__name__}: {e}"[:300],
+                )
+                raise
             dt = time.perf_counter() - t0
             metrics.inc("verify.seconds", dt)
+            events.emit(
+                "verify.dispatch", backend=backend, size=total,
+                occupancy=round(occupancy, 4) if occupancy is not None else None,
+                seconds=round(dt, 6),
+            )
             return out
 
     def _run_tpu(self, payloads: list) -> list[bool]:

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..trace import span
 from . import field as F
 from .curve import B3, INFINITY, make_point, pt_add, pt_double
 from .ecdsa_cpu import CURVE_N, CURVE_P, GENERATOR, Point
@@ -745,7 +746,12 @@ def _pallas_usable(batch: int) -> bool:
 
 
 def _dispatch_prep(prep: PreparedBatch) -> tuple[jnp.ndarray, int]:
-    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    # host->device transfer and kernel enqueue are separate spans so the
+    # telemetry section can tell a slow tunnel from a slow program (both
+    # are async under JAX dispatch: these time the enqueue, the blocking
+    # tail shows up in verify.readback)
+    with span("verify.transfer"):
+        args = tuple(jnp.asarray(a) for a in prep.device_args)
     if _pallas_usable(args[8].shape[-1]):
         from .pallas_kernel import verify_blocked
 
@@ -755,14 +761,16 @@ def _dispatch_prep(prep: PreparedBatch) -> tuple[jnp.ndarray, int]:
         # program below gets the same effect at runtime via lax.cond.
         schnorr_free = prep.schnorr_free
         try:
-            return (
-                verify_blocked(*args, schnorr_free=schnorr_free),
-                prep.count,
-            )
+            with span("verify.kernel"):
+                return (
+                    verify_blocked(*args, schnorr_free=schnorr_free),
+                    prep.count,
+                )
         except Exception as e:  # noqa: BLE001 — only Mosaic errors handled
             if not mark_pallas_broken_if_mosaic(e, where="at compile"):
                 raise
-    return verify_device(*args), prep.count
+    with span("verify.kernel"):
+        return verify_device(*args), prep.count
 
 
 def dispatch_batch_tpu(
@@ -774,18 +782,23 @@ def dispatch_batch_tpu(
     asynchronous, so the caller can prep the next chunk while this one
     computes — the overlap that keeps the device saturated during IBD
     (SURVEY.md §7 hard part 5).  Collect with :func:`collect_verdicts`."""
-    return _dispatch_prep(prepare_batch(items, pad_to=pad_to))
+    with span("verify.prepare"):
+        prep = prepare_batch(items, pad_to=pad_to)
+    return _dispatch_prep(prep)
 
 
 def dispatch_batch_tpu_raw(raw, pad_to: Optional[int] = None) -> tuple[jnp.ndarray, int]:
     """:func:`dispatch_batch_tpu` over a packed RawBatch (native-extract
     fast path): same async dispatch, no Python-int round trip."""
-    return _dispatch_prep(prepare_batch_raw(raw, pad_to=pad_to))
+    with span("verify.prepare"):
+        prep = prepare_batch_raw(raw, pad_to=pad_to)
+    return _dispatch_prep(prep)
 
 
 def collect_verdicts(out: jnp.ndarray, count: int) -> list[bool]:
     """Block on a :func:`dispatch_batch_tpu` result and return verdicts."""
-    return [bool(b) for b in np.asarray(out)[:count]]
+    with span("verify.readback"):
+        return [bool(b) for b in np.asarray(out)[:count]]
 
 
 def verify_batch_tpu(
