@@ -247,17 +247,22 @@ def _worker_bench() -> None:
 
         from tpunode.metrics import metrics
         from tpunode.trace import profile_to, span
+        from tpunode.tracectx import start_trace, tracer
         from tpunode.verify.engine import VerifyEngine
 
         times = []
         with profile_to(os.environ.get("TPUNODE_PROFILE")):
             for _ in range(iters):
-                t0 = time.perf_counter()
-                # spanned like the engine's dispatch so the telemetry
-                # section reports the same distribution the node would
-                with span("verify.dispatch"):
-                    device_fn(*args, **kw).block_until_ready()
-                times.append(time.perf_counter() - t0)
+                # each timed step is one causal trace: the slowest land in
+                # the artifact's slowest_traces section, so a straggler
+                # step is attributable (device vs readback) after the fact
+                with start_trace("bench.step", batch=batch):
+                    t0 = time.perf_counter()
+                    # spanned like the engine's dispatch so the telemetry
+                    # section reports the same distribution the node would
+                    with span("verify.dispatch"):
+                        device_fn(*args, **kw).block_until_ready()
+                    times.append(time.perf_counter() - t0)
                 metrics.observe(
                     "verify.occupancy",
                     1.0,  # the bench pads with real (tiled) items
@@ -276,6 +281,7 @@ def _worker_bench() -> None:
                     "compile_s": round(compile_s, 1),
                     "init_s": round(init_s, 1),
                     "telemetry": metrics.telemetry(),
+                    "slowest_traces": tracer.slowest(3),
                 }
             )
         )
@@ -610,6 +616,15 @@ def _main_locked() -> None:
         tel = _metrics.telemetry()
         tel["source"] = "driver-local"
     out["telemetry"] = tel
+    # Slowest causal traces (tracectx): measured in the worker alongside
+    # the telemetry section; the fallback paths report the driver's own
+    # (normally empty) ring so the key is always present.
+    st = res.get("slowest_traces")
+    if not isinstance(st, list):
+        from tpunode.tracectx import tracer as _tracer
+
+        st = _tracer.slowest(3)
+    out["slowest_traces"] = st
     print(json.dumps(out))
     if res.get("fatal"):
         sys.exit(1)  # kernel correctness failure must not look like success

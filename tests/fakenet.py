@@ -67,8 +67,15 @@ class _QueueReader:
         return out
 
 
-def mock_peer_react(net: Network, blocks: list[Block], msg) -> list:
-    """Scripted protocol brain (reference ``mockPeerReact`` NodeSpec.hs:135-147)."""
+def mock_peer_react(
+    net: Network, blocks: list[Block], msg, getdata_blocks: list[Block] = ()
+) -> list:
+    """Scripted protocol brain (reference ``mockPeerReact`` NodeSpec.hs:135-147).
+
+    ``getdata_blocks`` are served on ``getdata`` only — never announced in
+    ``headers`` — so a test can deliver a block with arbitrary txs (e.g.
+    signed fixtures for the verify pipeline) without breaking the canned
+    header chain's validation."""
     if isinstance(msg, MsgPing):
         return [MsgPong(msg.nonce)]
     if isinstance(msg, MsgVersion):
@@ -77,7 +84,7 @@ def mock_peer_react(net: Network, blocks: list[Block], msg) -> list:
         return [MsgHeaders(tuple((b.header, len(b.txs)) for b in blocks))]
     if isinstance(msg, MsgGetData):
         out = []
-        by_hash = {b.header.hash: b for b in blocks}
+        by_hash = {b.header.hash: b for b in [*blocks, *getdata_blocks]}
         for iv in msg.invs:
             if iv.type in (InvType.BLOCK, InvType.WITNESS_BLOCK):
                 b = by_hash.get(iv.hash)
@@ -93,6 +100,7 @@ async def _fake_remote(
     to_node: asyncio.Queue,
     from_node: asyncio.Queue,
     send_version_first: bool = True,
+    getdata_blocks: list[Block] = (),
 ) -> None:
     """The remote endpoint: speaks real wire bytes over the pipe."""
     if send_version_first:
@@ -117,13 +125,18 @@ async def _fake_remote(
             header = decode_message_header(net, raw_header)
             payload = await reader.read_exact(header.length) if header.length else b""
             msg = decode_message(net, header, payload)
-            for reply in mock_peer_react(net, blocks, msg):
+            for reply in mock_peer_react(net, blocks, msg, getdata_blocks):
                 to_node.put_nowait(encode_message(net, reply))
     except EOFError:
         pass
 
 
-def dummy_peer_connect(net: Network, blocks: list[Block], send_version_first: bool = True):
+def dummy_peer_connect(
+    net: Network,
+    blocks: list[Block],
+    send_version_first: bool = True,
+    getdata_blocks: list[Block] = (),
+):
     """Transport factory injected as ``NodeConfig.connect``
     (reference ``dummyPeerConnect`` NodeSpec.hs:94-133)."""
 
@@ -132,7 +145,10 @@ def dummy_peer_connect(net: Network, blocks: list[Block], send_version_first: bo
         to_node: asyncio.Queue = asyncio.Queue()
         from_node: asyncio.Queue = asyncio.Queue()
         task = asyncio.get_running_loop().create_task(
-            _fake_remote(net, blocks, to_node, from_node, send_version_first)
+            _fake_remote(
+                net, blocks, to_node, from_node, send_version_first,
+                getdata_blocks,
+            )
         )
         try:
             yield QueueConnection(to_node, from_node)

@@ -1043,6 +1043,54 @@ def test_bench_telemetry_passthrough(monkeypatch):
     assert line["telemetry"]["spans"]["verify.dispatch"]["p99"] == 0.16
 
 
+def test_bench_slowest_traces_passthrough_and_always_present(monkeypatch):
+    """ISSUE 2: the artifact line carries the worker's slowest causal
+    traces; fallback paths still emit the key (driver-local, normally
+    empty) so the shape is stable."""
+    bench = _load_bench()
+    traces = [
+        {
+            "trace_id": "abc-1",
+            "name": "bench.step",
+            "start_ts": 1.0,
+            "duration": 0.16,
+            "spans": [
+                {"id": 1, "parent": None, "name": "bench.step",
+                 "t": 0.0, "dur": 0.16},
+                {"id": 2, "parent": 1, "name": "verify.dispatch",
+                 "t": 0.001, "dur": 0.155},
+            ],
+        }
+    ]
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 3.0}),
+            (_batch(32768), {"ok": True, "rate": 200000.0, "device": "tpu:v5e",
+                             "kernel": "pallas", "batch": 32768,
+                             "slowest_traces": traces}),
+        ],
+    )
+    assert rc == 0
+    assert line["slowest_traces"] == traces
+    assert line["slowest_traces"][0]["spans"][1]["name"] == "verify.dispatch"
+
+    # fallback path: key present, list-shaped
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": False, "error": "timed out after 120s"}),
+            (_batch(4096), {"ok": False, "error": "timed out after 150s"}),
+            (_is_fallback, {"ok": True, "rate": 460.0, "device": "cpu:cpu",
+                            "kernel": "xla", "batch": 2048}),
+        ],
+    )
+    assert rc == 0
+    assert isinstance(line["slowest_traces"], list)
+
+
 def test_bench_telemetry_always_present(monkeypatch):
     """Fallback paths still carry a telemetry section (driver-local,
     stable shape) so the BENCH JSON is self-describing every round."""

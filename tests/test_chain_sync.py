@@ -134,8 +134,7 @@ async def test_is_synced_rearms_on_continuation():
             await asyncio.sleep(0.2)
             assert chain.is_synced()
             # event stayed one-shot: drain whatever is queued
-            while not sub._queue.empty():
-                events.append(sub._queue.get_nowait())
+            events.extend(sub.drain_nowait())
             assert not any(isinstance(e, ChainSynced) for e in events)
 
 

@@ -1,0 +1,159 @@
+"""Debug HTTP server tests: endpoint round-trips over a real local socket
+(zero-dependency server, zero-dependency client)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from tpunode.debugsrv import DebugServer
+from tpunode.events import EventLog
+from tpunode.metrics import Metrics
+from tpunode.tracectx import Tracer
+
+
+async def _get(port: int, target: str) -> tuple[int, dict[str, str], bytes]:
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(
+        f"GET {target} HTTP/1.1\r\nHost: localhost\r\n\r\n".encode()
+    )
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").split("\r\n")
+    status = int(lines[0].split()[1])
+    headers = {}
+    for line in lines[1:]:
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers, body
+
+
+@pytest.mark.asyncio
+async def test_endpoints_round_trip():
+    reg = Metrics(disabled=False)
+    reg.inc("peer.msgs_in", 7)
+    reg.set_gauge("peermgr.peers", 2)
+    log = EventLog()
+    log.emit("watchdog.stall", kind="event_loop", lag_seconds=1.0)
+    log.emit("peer.connect", peer="a:1")
+    col = Tracer(enabled=True)
+    tr = col.start("block", peer="a:1")
+    tr.end(tr.begin("verify.dispatch"))
+    col.finish(tr)
+
+    async with DebugServer(
+        port=0,
+        health=lambda: {"ok": True, "height": 15},
+        stats=lambda: {"uptime_seconds": 1.0},
+        registry=reg,
+        log_=log,
+        tracer_=col,
+    ) as srv:
+        assert srv.port and srv.port > 0
+
+        status, headers, body = await _get(srv.port, "/metrics")
+        assert status == 200
+        assert headers["content-type"].startswith("text/plain")
+        assert int(headers["content-length"]) == len(body)
+        text = body.decode()
+        assert "tpunode_peer_msgs_in 7.0" in text
+        assert "tpunode_peermgr_peers 2.0" in text
+
+        status, headers, body = await _get(srv.port, "/health")
+        assert status == 200
+        assert headers["content-type"].startswith("application/json")
+        assert json.loads(body) == {"ok": True, "height": 15}
+
+        status, _, body = await _get(srv.port, "/stats")
+        assert status == 200 and json.loads(body)["uptime_seconds"] == 1.0
+
+        status, _, body = await _get(
+            srv.port, "/events?n=5&type=watchdog.stall"
+        )
+        assert status == 200
+        got = json.loads(body)
+        assert [e["type"] for e in got["events"]] == ["watchdog.stall"]
+        assert got["counts"]["peer.connect"] == 1
+
+        status, _, body = await _get(srv.port, "/traces?n=4")
+        assert status == 200
+        got = json.loads(body)
+        assert got["recent"][0]["trace_id"] == tr.trace_id
+        span_names = {s["name"] for s in got["recent"][0]["spans"]}
+        assert {"block", "verify.dispatch"} <= span_names
+        assert got["slowest"][0]["trace_id"] == tr.trace_id
+
+        status, _, body = await _get(srv.port, "/nope")
+        assert status == 404
+        assert "/metrics" in json.loads(body)["endpoints"]
+
+    # server closed: connecting now fails
+    with pytest.raises(OSError):
+        await asyncio.open_connection("127.0.0.1", srv.port)
+
+
+@pytest.mark.asyncio
+async def test_non_get_rejected_and_garbage_ignored():
+    async with DebugServer(port=0, registry=Metrics(disabled=False)) as srv:
+        reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+        writer.write(b"POST /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        raw = await reader.read()
+        assert b"405" in raw.split(b"\r\n", 1)[0]
+        writer.close()
+
+        # a garbage request must not kill the server
+        reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+        writer.write(b"\r\n")
+        await writer.drain()
+        await reader.read()
+        writer.close()
+
+        status, _, _ = await _get(srv.port, "/health")
+        assert status == 200
+
+
+@pytest.mark.asyncio
+async def test_node_debug_port_wiring():
+    """NodeConfig.debug_port=0 binds an ephemeral localhost port serving
+    the node's own health/stats; default (None) serves nothing."""
+    from tests.fakenet import dummy_peer_connect
+    from tests.fixtures import all_blocks
+    from tpunode import BCH_REGTEST, Node, NodeConfig, Publisher
+    from tpunode.store import MemoryKV
+
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=BCH_REGTEST,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:17486"],
+        connect=lambda sa: dummy_peer_connect(BCH_REGTEST, all_blocks()),
+        debug_port=0,
+    )
+    async with pub.subscription():
+        async with Node(cfg) as node:
+            assert node.debug_server is not None and node.debug_server.port
+            async with asyncio.timeout(15):
+                status, _, body = await _get(node.debug_server.port, "/health")
+                assert status == 200
+                health = json.loads(body)
+                assert health["ok"] is True
+                status, _, body = await _get(
+                    node.debug_server.port, "/metrics"
+                )
+                assert status == 200 and b"tpunode_" in body
+
+    cfg2 = NodeConfig(
+        net=BCH_REGTEST,
+        store=MemoryKV(),
+        pub=Publisher(),
+        peers=[],
+        connect=lambda sa: dummy_peer_connect(BCH_REGTEST, all_blocks()),
+    )
+    async with Node(cfg2) as node2:
+        assert node2.debug_server is None

@@ -102,6 +102,59 @@ def test_subscribe_observer():
     log.emit("peer.connect", peer="c")
 
 
+def test_broken_subscriber_counted_and_auto_unsubscribed():
+    """ISSUE 2 satellite: a raised callback is counted in
+    events.subscriber_errors and the subscriber is dropped after
+    MAX_SUBSCRIBER_FAILURES consecutive failures — emitters never pay
+    for it again."""
+    from tpunode.events import metrics as ev_metrics
+
+    log = EventLog()
+    calls = []
+
+    def boom(ev):
+        calls.append(ev)
+        raise RuntimeError("observer bug")
+
+    before = ev_metrics.get("events.subscriber_errors")
+    log.subscribe(boom)
+    for i in range(EventLog.MAX_SUBSCRIBER_FAILURES + 5):
+        log.emit("chain.headers", count=i)
+    # dropped exactly at the limit: later emits never reach it
+    assert len(calls) == EventLog.MAX_SUBSCRIBER_FAILURES
+    assert (
+        ev_metrics.get("events.subscriber_errors") - before
+        == EventLog.MAX_SUBSCRIBER_FAILURES
+    )
+    # healthy subscribers registered alongside keep working throughout
+    seen = []
+    log.subscribe(seen.append)
+    log.emit("chain.headers", count=99)
+    assert seen[-1]["count"] == 99
+
+
+def test_flaky_subscriber_survives_on_success():
+    """One success re-arms the failure budget: only CONSECUTIVE failures
+    unsubscribe."""
+    log = EventLog()
+    calls = []
+
+    def flaky(ev):
+        calls.append(ev)
+        if ev.get("bad"):
+            raise RuntimeError("sometimes")
+
+    log.subscribe(flaky)
+    for _ in range(EventLog.MAX_SUBSCRIBER_FAILURES - 1):
+        log.emit("verify.failure", bad=True)
+    log.emit("verify.failure", bad=False)  # success: budget re-armed
+    for _ in range(EventLog.MAX_SUBSCRIBER_FAILURES - 1):
+        log.emit("verify.failure", bad=True)
+    log.emit("verify.failure", bad=False)
+    # never dropped: every emit reached it
+    assert len(calls) == 2 * EventLog.MAX_SUBSCRIBER_FAILURES
+
+
 def test_stats_reporter_windowed_rates(monkeypatch):
     import sys
 
@@ -130,6 +183,30 @@ def test_stats_reporter_windowed_rates(monkeypatch):
     ev = rep.tick()
     assert ev["rates"]["chain.headers"] == pytest.approx(0.0)
     assert log.counts()["stats"] == 3
+
+
+def test_stats_reporter_labeled_aggregates(monkeypatch):
+    """ISSUE 2 satellite: labeled counter families are no longer silently
+    dropped — the stats event carries bounded-cardinality sums by the
+    configured label key (peer.msgs by cmd), never the raw per-peer
+    series."""
+    import sys
+
+    reg = Metrics(disabled=False)
+    monkeypatch.setattr(sys.modules["tpunode.events"], "metrics", reg)
+    reg.inc("peer.msgs", 3, labels={"peer": "a:1", "cmd": "ping"})
+    reg.inc("peer.msgs", 2, labels={"peer": "b:2", "cmd": "ping"})
+    reg.inc("peer.msgs", 7, labels={"peer": "b:2", "cmd": "headers"})
+    log = EventLog()
+    ev = StatsReporter(interval=10.0, log=log).tick()
+    assert ev["labeled"]["peer.msgs"] == {"ping": 5.0, "headers": 7.0}
+    # the peer dimension never reaches the persisted event
+    assert not any("{" in k for k in ev["counters"])
+    assert "a:1" not in json.dumps(ev)
+
+    # the aggregation map is injectable; empty map -> empty section
+    ev2 = StatsReporter(interval=10.0, log=log, label_agg={}).tick()
+    assert ev2["labeled"] == {}
 
 
 def test_stats_reporter_extra_hook_and_errors():

@@ -269,6 +269,25 @@ def test_render_prometheus_no_duplicate_sample_names():
     assert "tpunode_span_verify_dispatch_count" in non_bucket  # histogram's
 
 
+def test_render_prometheus_label_value_escaping():
+    """Exposition-format 0.0.4 label escaping (ISSUE 2 satellite):
+    backslash, double-quote and newline in label values — peer addresses
+    and error strings are attacker-influenced and a raw newline would
+    forge exposition lines."""
+    m = Metrics(disabled=False)
+    m.inc(
+        "verify.failures",
+        labels={"error": 'bad "quote" \\ back\nslash'},
+    )
+    text = m.render_prometheus()
+    assert 'error="bad \\"quote\\" \\\\ back\\nslash"' in text
+    # no raw newline inside any sample line: every line still parses
+    for line in text.strip().split("\n"):
+        if line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), line
+
+
 def test_render_prometheus_full_precision_counters():
     m = Metrics(disabled=False)
     m.inc("peer.bytes_in", 123456789)
@@ -328,10 +347,12 @@ def _iter_source_files():
 
 
 def test_telemetry_core_is_jax_free():
-    """metrics.py and events.py must never import jax (even lazily-at-top):
-    the telemetry core is used by the jax-free bench parent process and
-    must run anywhere (the CI sweep runs it under JAX_PLATFORMS=cpu)."""
-    for mod in ("metrics.py", "events.py"):
+    """metrics.py, events.py, tracectx.py, watchdog.py and debugsrv.py
+    must never import jax (even lazily-at-top): the telemetry core is
+    used by the jax-free bench parent process and must run anywhere (the
+    CI sweep runs it under JAX_PLATFORMS=cpu)."""
+    for mod in ("metrics.py", "events.py", "tracectx.py", "watchdog.py",
+                "debugsrv.py"):
         with open(os.path.join(REPO, "tpunode", mod), encoding="utf-8") as f:
             src = f.read()
         assert "import jax" not in src, f"{mod} imports jax"
@@ -351,3 +372,33 @@ def test_metric_names_follow_schema():
                 bad.append(f"{os.path.relpath(path, REPO)}: {mo.group(1)!r}")
     assert seen > 20, "lint regex stopped matching call sites"
     assert not bad, "metric names violating ^[a-z]+(\\.[a-z_]+)+$: " + "; ".join(bad)
+
+
+# literal first-arg event types at .emit(...) call sites (EventLog.emit is
+# the only emit() in the package)
+_EVENT_RE = re.compile(r"""\.emit\(\s*["']([^"']+)["']""")
+# "stats" predates the schema and is pinned by consumers (test_telemetry,
+# OBSERVABILITY.md); grandfathered rather than silently renamed.
+_EVENT_TYPE_ALLOW = {"stats"}
+
+
+def test_event_types_follow_schema():
+    """ISSUE 2 satellite: every literal ``events.emit(type, ...)`` event
+    type matches ``^[a-z]+(\\.[a-z_]+)+$`` — so ``watchdog.stall`` and
+    future types stay grep-consistent with the metric-name schema."""
+    bad = []
+    seen = 0
+    for path in _iter_source_files():
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for mo in _EVENT_RE.finditer(src):
+            seen += 1
+            t = mo.group(1)
+            if t in _EVENT_TYPE_ALLOW:
+                continue
+            if not NAME_RE.match(t):
+                bad.append(f"{os.path.relpath(path, REPO)}: {t!r}")
+    assert seen > 10, "event lint regex stopped matching call sites"
+    assert not bad, (
+        "event types violating ^[a-z]+(\\.[a-z_]+)+$: " + "; ".join(bad)
+    )

@@ -171,6 +171,74 @@ async def test_handshake_event_carries_peer_metadata():
 
 
 @pytest.mark.asyncio
+async def test_label_series_bounded_under_peer_churn():
+    """ISSUE 2 satellite: churning many fakenet peers through connect/
+    disconnect leaves NO labeled series behind — Metrics.drop_label keeps
+    the registry bounded and the Prometheus exposition shrinks back."""
+    from tpunode import PeerDisconnected
+
+    blocks = all_blocks()
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=Namespaced(MemoryKV(), b"node:"),
+        pub=pub,
+        peers=[],  # churn is driven explicitly below
+        connect=lambda sa: dummy_peer_connect(NET, blocks),
+        stats_interval=0,
+    )
+    labels: list[str] = []
+    async with pub.subscription() as evs:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(30):
+                # the manager discards mailbox messages until the chain's
+                # initial best height arrives; connect only after startup
+                await node.peer_mgr._started.wait()
+                for i in range(8):
+                    node.peer_mgr.connect((f"10.99.0.{i}", 8000 + i))
+                    p = (
+                        await evs.receive_match(
+                            lambda e: e
+                            if isinstance(e, PeerConnected)
+                            else None
+                        )
+                    ).peer
+                    labels.append(p.label)
+                    # wire-loop labeled series exist while the peer lives
+                    await _poll(
+                        lambda: any(
+                            dict(lk).get("peer") == p.label
+                            for lk in metrics.series("peer.msgs")
+                        ),
+                        what=f"labeled series for {p.label}",
+                    )
+                    p.kill(PeerError("churn"))
+                    await evs.receive_match(
+                        lambda e: e
+                        if isinstance(e, PeerDisconnected) and e.peer is p
+                        else None
+                    )
+                    # eviction happened inside the same dispatch: no series
+                    # for the dead peer survives the disconnect
+                    assert not any(
+                        dict(lk).get("peer") == p.label
+                        for lk in metrics.series("peer.msgs")
+                    ), p.label
+
+    assert len(set(labels)) == 8
+    # registry bounded: zero churned series remain, in any family
+    snap = metrics.snapshot()
+    leaked = [
+        k for k in snap if any(f'peer="{lbl}"' in k for lbl in labels)
+    ]
+    assert not leaked, leaked
+    # and the exposition output shrank accordingly
+    text = metrics.render_prometheus()
+    for lbl in labels:
+        assert f'peer="{lbl}"' not in text
+
+
+@pytest.mark.asyncio
 async def test_stats_event_includes_node_context():
     events.reset()
     async with telemetry_node(stats_interval=0.05) as (node, evs):

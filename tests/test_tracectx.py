@@ -1,0 +1,367 @@
+"""Causal tracing tests: span trees, context propagation (mailbox hops,
+thread pool), Chrome export, slowest-ring retention — and the fakenet
+pipeline integration test driving one block from wire bytes to verdicts
+under a single trace id (ISSUE 2 acceptance)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from tests.fakenet import dummy_peer_connect
+from tests.fixtures import all_blocks
+from tpunode import (
+    BCH_REGTEST,
+    Mailbox,
+    Node,
+    NodeConfig,
+    PeerConnected,
+    Publisher,
+    TxVerdict,
+    get_blocks,
+)
+from tpunode.store import MemoryKV
+from tpunode.tracectx import (
+    _ACTIVE,
+    Tracer,
+    activate,
+    current,
+    finish_active,
+    start_trace,
+    tracer,
+)
+from tpunode.trace import span
+from tpunode.verify.engine import VerifyConfig
+from tpunode.wire import Block, BlockHeader
+
+NET = BCH_REGTEST
+
+
+# --- unit: trace tree --------------------------------------------------------
+
+
+def test_trace_tree_parent_links_and_ids():
+    col = Tracer(enabled=True)
+    tr = col.start("block", peer="a:1")
+    a = tr.begin("peer.decode")
+    b = tr.begin("node.extract", parent=a.id)
+    tr.end(b)
+    tr.end(a)
+    col.finish(tr)
+    d = tr.as_dict()
+    assert d["name"] == "block"
+    assert d["trace_id"] == tr.trace_id
+    spans = d["spans"]
+    roots = [s for s in spans if s["parent"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "block"
+    ids = {s["id"] for s in spans}
+    assert len(ids) == len(spans)  # unique span ids
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["peer.decode"]["parent"] == roots[0]["id"]
+    assert by_name["node.extract"]["parent"] == by_name["peer.decode"]["id"]
+    for s in spans:
+        assert s["dur"] is not None and s["dur"] >= 0
+    assert d["duration"] >= by_name["peer.decode"]["dur"]
+
+
+def test_finish_is_idempotent_and_ring_is_bounded():
+    col = Tracer(enabled=True, ring=3)
+    traces = [col.start(f"t{i}") for i in range(6)]
+    for tr in traces:
+        col.finish(tr)
+        col.finish(tr)  # second finish is a no-op
+    assert len(col.slowest()) == 3
+    # slowest-first ordering
+    durs = [t["duration"] for t in col.slowest()]
+    assert durs == sorted(durs, reverse=True)
+    assert len(col.recent_traces(2)) == 2
+    col.reset()
+    assert col.slowest() == [] and col.recent_traces() == []
+
+
+def test_discard_closes_without_retention():
+    from tpunode.metrics import metrics
+
+    col = Tracer(enabled=True)
+    before = metrics.get("trace.discarded")
+    tr = col.start("tx")
+    col.discard(tr)
+    assert tr.finished and tr.root.dur is not None
+    assert col.recent_traces() == [] and col.slowest() == []
+    assert metrics.get("trace.discarded") == before + 1
+    col.discard(tr)  # idempotent, and finish after discard is a no-op
+    col.finish(tr)
+    assert col.recent_traces() == []
+
+
+def test_recent_traces_zero_returns_nothing():
+    col = Tracer(enabled=True)
+    col.finish(col.start("a"))
+    assert col.recent_traces(0) == []
+    assert col.slowest(0) == []
+    assert len(col.recent_traces(1)) == 1
+
+
+def test_span_records_into_active_trace_with_nesting():
+    col = Tracer(enabled=True)
+    with start_trace("unit.root", tracer_=col) as tr:
+        with span("unit.outer"):
+            with span("unit.inner"):
+                pass
+        with span("unit.sibling"):
+            pass
+    by_name = {s.name: s for s in tr.spans}
+    assert by_name["unit.inner"].parent == by_name["unit.outer"].id
+    assert by_name["unit.outer"].parent == tr.root.id
+    assert by_name["unit.sibling"].parent == tr.root.id
+    assert tr.finished and tr.root.dur is not None
+    # context fully restored
+    assert current() is None
+
+
+def test_span_without_trace_records_nothing_extra():
+    tracer.reset()
+    assert current() is None
+    with span("unit.solo"):
+        pass
+    assert tracer.recent_traces() == []
+
+
+def test_disabled_tracer_start_trace_noop():
+    col = Tracer(enabled=False)
+    with start_trace("x", tracer_=col) as tr:
+        assert tr is None
+        assert current() is None
+    assert col.recent_traces() == []
+
+
+def test_chrome_export_shape_and_file(tmp_path):
+    col = Tracer(enabled=True, trace_dir=str(tmp_path))
+    tr = col.start("block", peer="p:1", bytes=123)
+    rec = tr.begin("verify.kernel")
+    tr.end(rec)
+    col.finish(tr)
+    files = list(tmp_path.glob("*.json"))
+    assert len(files) == 1 and tr.trace_id in files[0].name
+    data = json.loads(files[0].read_text())
+    assert isinstance(data["traceEvents"], list) and len(data["traceEvents"]) == 2
+    for ev in data["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], float) and isinstance(ev["dur"], float)
+        assert ev["args"]["trace_id"] == tr.trace_id
+        assert "name" in ev and "pid" in ev and "tid" in ev
+    kernel = [e for e in data["traceEvents"] if e["name"] == "verify.kernel"]
+    assert kernel and kernel[0]["args"]["parent"] == tr.root.id
+
+
+def test_export_to_unwritable_dir_degrades(tmp_path):
+    f = tmp_path / "a-file"
+    f.write_text("x")
+    col = Tracer(enabled=True, trace_dir=str(f / "nope"))
+    col.finish(col.start("t"))  # must not raise
+    assert col.trace_dir is None  # export disabled after the failure
+    assert len(col.recent_traces()) == 1  # retention unaffected
+
+
+def test_trace_begin_end_thread_safe():
+    col = Tracer(enabled=True)
+    tr = col.start("mt")
+
+    def work(i):
+        for _ in range(200):
+            tr.end(tr.begin(f"t.w{i}"))
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    col.finish(tr)
+    assert len(tr.spans) == 1 + 4 * 200
+    assert len({s.id for s in tr.spans}) == len(tr.spans)
+
+
+# --- unit: context propagation ----------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_mailbox_propagates_trace_context():
+    col = Tracer(enabled=True)
+    mb: Mailbox = Mailbox(name="unit")
+    tr = col.start("hop")
+    tok = _ACTIVE.set((tr, tr.root.id))
+    mb.send("traced")
+    _ACTIVE.reset(tok)
+    mb.send("plain")
+
+    got = []
+
+    async def consumer():
+        a = await mb.receive()
+        got.append((a, current()))
+        b = await mb.receive()
+        got.append((b, current()))
+
+    await asyncio.get_running_loop().create_task(consumer())
+    assert got[0][0] == "traced" and got[0][1] == (tr, tr.root.id)
+    # the untraced message cleared the receiver's stale context
+    assert got[1] == ("plain", None)
+
+
+@pytest.mark.asyncio
+async def test_receive_match_and_drain_unwrap():
+    col = Tracer(enabled=True)
+    mb: Mailbox = Mailbox(name="unit")
+    tr = col.start("hop")
+    with activate((tr, tr.root.id)):
+        mb.send(1)
+        mb.send(2)
+    out = await mb.receive_match(lambda x: x if x == 2 else None)
+    assert out == 2 and current() == (tr, tr.root.id)
+    finish_active(col)
+    assert current() is None
+    mb.send(3)
+    assert mb.drain_nowait() == [3]
+    assert mb.qsize() == 0
+
+
+@pytest.mark.asyncio
+async def test_to_thread_carries_trace_context():
+    col = Tracer(enabled=True)
+    with start_trace("threaded", tracer_=col) as tr:
+
+        def in_thread():
+            with span("unit.thread_work"):
+                pass
+            return current()
+
+        act = await asyncio.to_thread(in_thread)
+        assert act == (tr, tr.root.id)
+    assert any(s.name == "unit.thread_work" for s in tr.spans)
+
+
+def test_mailbox_oldest_age_tracking():
+    async def run():
+        mb: Mailbox = Mailbox(name="age", maxsize=2)
+        assert mb.oldest_age() == 0.0
+        mb.send("a")
+        await asyncio.sleep(0.05)
+        age = mb.oldest_age()
+        assert age >= 0.04
+        mb.send("b")
+        mb.send("c")  # evicts "a"; timestamps stay aligned
+        assert mb.qsize() == 2 and mb.dropped == 1
+        assert await mb.receive() == "b"
+        assert await mb.receive() == "c"
+        assert mb.oldest_age() == 0.0
+
+    asyncio.run(run())
+
+
+# --- integration: one block through the whole pipeline ----------------------
+
+
+@pytest.mark.asyncio
+async def test_block_pipeline_single_trace_tree(tmp_path, monkeypatch):
+    """One block fetched over the fakenet wire yields ONE finished trace
+    containing peer, ingest and verify-phase spans with a consistent
+    trace id and parent links, and exports as valid Chrome JSON."""
+    from benchmarks.txgen import gen_signed_txs
+
+    tracer.reset()
+    monkeypatch.setattr(tracer, "trace_dir", str(tmp_path))
+
+    txs = gen_signed_txs(3, inputs_per_tx=1, seed=0x7ACE)
+    hdr = BlockHeader(1, b"\x00" * 32, b"\x00" * 32, 0, 0x207FFFFF, 0)
+    block = Block(hdr, tuple(txs))
+
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:17486"],
+        connect=lambda sa: dummy_peer_connect(
+            NET, all_blocks(), getdata_blocks=[block]
+        ),
+        verify=VerifyConfig(backend="oracle", max_wait=0.0),
+    )
+    async with pub.subscription() as evs:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(20):
+                peer = (
+                    await evs.receive_match(
+                        lambda e: e if isinstance(e, PeerConnected) else None
+                    )
+                ).peer
+                got = await get_blocks(NET, 10, peer, [block.header.hash])
+                assert got is not None and len(got) == 1
+                seen = set()
+                while len(seen) < len(txs):
+                    ev = await evs.receive()
+                    if isinstance(ev, TxVerdict):
+                        assert ev.valid, ev
+                        seen.add(ev.txid)
+
+    block_traces = [
+        t for t in tracer.recent_traces() if t["name"] == "block"
+    ]
+    assert len(block_traces) == 1, block_traces
+    t = block_traces[0]
+    names = {s["name"] for s in t["spans"]}
+    # peer stage, ingest stage, verify stage — one tree
+    assert {"block", "peer.payload", "peer.decode", "node.extract",
+            "verify.queue", "verify.dispatch"} <= names, names
+    ids = {s["id"] for s in t["spans"]}
+    roots = [s for s in t["spans"] if s["parent"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "block"
+    for s in t["spans"]:
+        if s["parent"] is not None:
+            assert s["parent"] in ids, s
+        assert s["dur"] is not None
+    assert t["duration"] > 0
+
+    # the slowest-ring retained it too (BENCH slowest_traces source)
+    assert any(
+        s["trace_id"] == t["trace_id"] for s in tracer.slowest(name="block")
+    )
+
+    # Chrome trace-event export loads as valid JSON with complete events
+    files = [p for p in tmp_path.glob("block-*.json")]
+    assert files, list(tmp_path.iterdir())
+    data = json.loads(files[0].read_text())
+    evs_ = data["traceEvents"]
+    assert evs_ and all(e["ph"] == "X" for e in evs_)
+    assert {e["name"] for e in evs_} >= {"block", "verify.dispatch"}
+
+
+@pytest.mark.asyncio
+async def test_headers_trace_finished_at_import():
+    """Header batches trace too: wire decode -> mailbox hop -> chain
+    import, finished by the chain actor."""
+    tracer.reset()
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:17486"],
+        connect=lambda sa: dummy_peer_connect(NET, all_blocks()),
+    )
+    async with pub.subscription() as evs:
+        async with Node(cfg) as node:
+            async with asyncio.timeout(20):
+                while True:
+                    traces = [
+                        t for t in tracer.recent_traces()
+                        if t["name"] == "headers"
+                    ]
+                    if traces:
+                        break
+                    await asyncio.sleep(0.01)
+    names = {s["name"] for s in traces[0]["spans"]}
+    assert "chain.import_headers" in names, names
+    assert "peer.decode" in names

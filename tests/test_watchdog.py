@@ -1,0 +1,152 @@
+"""Stall-watchdog tests: event-loop lag, mailbox head age, verify
+dispatch in-flight time — one ``watchdog.stall`` event per episode."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from tpunode.actors import Mailbox
+from tpunode.events import EventLog
+from tpunode.metrics import metrics
+from tpunode.watchdog import Watchdog, WatchdogConfig
+
+
+def test_check_healthy_emits_nothing():
+    log = EventLog()
+    wd = Watchdog(WatchdogConfig(), mailboxes=[Mailbox(name="m")], log_=log)
+    assert wd.check(lag=0.0) == []
+    assert log.counts() == {}
+    assert metrics.get("watchdog.loop_lag_seconds") == 0.0
+
+
+def test_loop_lag_stall_once_per_episode():
+    log = EventLog()
+    wd = Watchdog(WatchdogConfig(lag_threshold=0.5), log_=log)
+    first = wd.check(lag=1.2)
+    assert len(first) == 1
+    ev = first[0]
+    assert ev["type"] == "watchdog.stall"
+    assert ev["kind"] == "event_loop"
+    assert ev["lag_seconds"] == pytest.approx(1.2)
+    # still stalled: no duplicate event
+    assert wd.check(lag=1.5) == []
+    # recovered, then stalled again: a fresh episode re-emits
+    assert wd.check(lag=0.0) == []
+    assert len(wd.check(lag=2.0)) == 1
+    assert log.counts()["watchdog.stall"] == 2
+
+
+@pytest.mark.asyncio
+async def test_mailbox_age_stall():
+    log = EventLog()
+    mb: Mailbox = Mailbox(name="chain")
+    wd = Watchdog(
+        WatchdogConfig(mailbox_age_threshold=0.05),
+        mailboxes=[mb],
+        log_=log,
+    )
+    assert wd.check() == []  # empty mailbox: healthy
+    mb.send("stuck")
+    await asyncio.sleep(0.1)
+    out = wd.check()
+    assert len(out) == 1
+    assert out[0]["kind"] == "mailbox" and out[0]["mailbox"] == "chain"
+    assert out[0]["age_seconds"] >= 0.05 and out[0]["depth"] == 1
+    assert wd.check() == []  # same episode
+    await mb.receive()
+    assert wd.check() == []  # cleared
+    mb.send("stuck-again")
+    await asyncio.sleep(0.1)
+    assert len(wd.check()) == 1  # new episode
+
+
+def test_engine_dispatch_stall():
+    class FakeEngine:
+        inflight = 0.0
+
+        def dispatch_inflight_seconds(self):
+            return self.inflight
+
+    log = EventLog()
+    eng = FakeEngine()
+    wd = Watchdog(
+        WatchdogConfig(dispatch_stall_threshold=30.0), engine=eng, log_=log
+    )
+    assert wd.check() == []
+    eng.inflight = 95.0  # the r05 mode: jax wedged in the worker thread
+    out = wd.check()
+    assert len(out) == 1
+    assert out[0]["kind"] == "verify_dispatch"
+    assert out[0]["age_seconds"] == pytest.approx(95.0)
+    eng.inflight = 0.0
+    assert wd.check() == []
+
+
+@pytest.mark.asyncio
+async def test_run_loop_emits_stall_when_loop_blocked():
+    """Artificially block the event loop (ISSUE 2 acceptance): the
+    watchdog's next wakeup observes the lag and emits watchdog.stall."""
+    log = EventLog()
+    wd = Watchdog(
+        WatchdogConfig(interval=0.05, lag_threshold=0.15), log_=log
+    )
+    task = asyncio.get_running_loop().create_task(wd.run())
+    try:
+        await asyncio.sleep(0.12)  # let the loop establish a baseline
+        time.sleep(0.4)  # synchronous block: nothing can run
+        deadline = time.monotonic() + 5.0
+        while not log.counts().get("watchdog.stall"):
+            assert time.monotonic() < deadline, "no stall event emitted"
+            await asyncio.sleep(0.02)
+    finally:
+        task.cancel()
+    ev = log.tail(10, type="watchdog.stall")[0]
+    assert ev["kind"] == "event_loop"
+    assert ev["lag_seconds"] >= 0.15
+    assert metrics.get("watchdog.loop_lag_seconds") >= 0.0
+    h = metrics.histogram("watchdog.loop_lag")
+    assert h is not None and h.count >= 1
+
+
+@pytest.mark.asyncio
+async def test_node_links_watchdog_and_engine_hook():
+    """The node wires chain+peermgr mailboxes and the verify engine into
+    its watchdog (NodeConfig.watchdog_interval; 0 disables)."""
+    from tests.fakenet import dummy_peer_connect
+    from tests.fixtures import all_blocks
+    from tpunode import BCH_REGTEST, Node, NodeConfig, Publisher
+    from tpunode.store import MemoryKV
+    from tpunode.verify.engine import VerifyConfig
+
+    pub = Publisher(name="node-events")
+    cfg = NodeConfig(
+        net=BCH_REGTEST,
+        store=MemoryKV(),
+        pub=pub,
+        peers=["[::1]:17486"],
+        connect=lambda sa: dummy_peer_connect(BCH_REGTEST, all_blocks()),
+        verify=VerifyConfig(backend="oracle", max_wait=0.0),
+        watchdog_interval=0.05,
+    )
+    async with pub.subscription():
+        async with Node(cfg) as node:
+            wd = node._watchdog
+            assert wd is not None
+            assert node.chain.mailbox in wd.mailboxes
+            assert node.peer_mgr.mailbox in wd.mailboxes
+            assert wd.engine is node.verify_engine
+            assert node.verify_engine.dispatch_inflight_seconds() == 0.0
+
+    cfg2 = NodeConfig(
+        net=BCH_REGTEST,
+        store=MemoryKV(),
+        pub=Publisher(),
+        peers=[],
+        connect=lambda sa: dummy_peer_connect(BCH_REGTEST, all_blocks()),
+        watchdog_interval=0.0,
+    )
+    async with Node(cfg2) as node2:
+        assert node2._watchdog is None

@@ -16,8 +16,11 @@ src/Haskoin/Node.hs:10-19).
 """
 
 from .actors import LinkedTasks, Mailbox, Publisher, Supervisor
+from .debugsrv import DebugServer
 from .events import EventLog, StatsReporter, events
 from .metrics import Histogram, Metrics, metrics
+from .tracectx import Trace, Tracer, start_trace, tracer
+from .watchdog import Watchdog, WatchdogConfig
 from .chain import (
     Chain,
     ChainBestBlock,

@@ -28,9 +28,12 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import time
+from collections import deque
 
 from .compat import timeout as _timeout
 from .metrics import metrics
+from .tracectx import _ACTIVE as _active_trace
 from typing import (
     AsyncIterator,
     Awaitable,
@@ -52,6 +55,18 @@ T = TypeVar("T")
 U = TypeVar("U")
 
 
+class _Traced:
+    """Queue envelope carrying a message's trace position (tracectx): the
+    sender's active ``(trace, span_id)`` rides along so the receiving
+    actor's processing lands in the same per-item trace."""
+
+    __slots__ = ("item", "act")
+
+    def __init__(self, item, act):
+        self.item = item
+        self.act = act
+
+
 class Mailbox(Generic[T]):
     """Typed actor queue (NQE ``Inbox``/``Mailbox``).
 
@@ -68,24 +83,49 @@ class Mailbox(Generic[T]):
     def __init__(self, name: str = "", maxsize: Optional[int] = None):
         if maxsize is not None and maxsize < 1:
             raise ValueError(f"maxsize must be >= 1 or None, got {maxsize}")
-        self._queue: asyncio.Queue[T] = asyncio.Queue()
+        self._queue: asyncio.Queue = asyncio.Queue()
+        # enqueue monotonic timestamps, parallel to _queue: the watchdog's
+        # oldest-message-age signal (a growing head age localizes a stuck
+        # consumer even when qsize alone looks plausible)
+        self._times: deque[float] = deque()
         self.name = name
         self.maxsize = maxsize
         self.dropped = 0
 
     def send(self, item: T) -> None:
-        """Enqueue without blocking (NQE ``send``); see drop-oldest above."""
+        """Enqueue without blocking (NQE ``send``); see drop-oldest above.
+        Captures the sender's active trace position (tracectx) so causal
+        traces flow across actor hops."""
+        act = _active_trace.get()
+        if act is not None:
+            item = _Traced(item, act)  # type: ignore[assignment]
         if self.maxsize is not None and self._queue.qsize() >= self.maxsize:
             try:
                 self._queue.get_nowait()
+                if self._times:
+                    self._times.popleft()
             except asyncio.QueueEmpty:
                 pass
             self.dropped += 1
             metrics.inc("bus.dropped")
         self._queue.put_nowait(item)
+        self._times.append(time.monotonic())
+
+    def _unwrap(self, item) -> T:
+        """Pop-side of the trace envelope: re-activate the carried trace
+        position for the receiving task (or clear a stale one)."""
+        if type(item) is _Traced:
+            _active_trace.set(item.act)
+            return item.item
+        if _active_trace.get() is not None:
+            _active_trace.set(None)
+        return item
 
     async def receive(self) -> T:
-        return await self._queue.get()
+        item = await self._queue.get()
+        if self._times:
+            self._times.popleft()
+        return self._unwrap(item)
 
     async def receive_match(self, select: Callable[[T], Optional[U]]) -> U:
         """Await the first message for which ``select`` returns non-None;
@@ -93,12 +133,34 @@ class Mailbox(Generic[T]):
         event subscriptions, e.g. NodeSpec.hs:202-205)."""
         while True:
             item = await self._queue.get()
-            out = select(item)
+            if self._times:
+                self._times.popleft()
+            out = select(self._unwrap(item))
             if out is not None:
                 return out
 
+    def drain_nowait(self) -> list[T]:
+        """Pop every queued message without waiting (test/shutdown helper;
+        unwraps trace envelopes like ``receive``)."""
+        out: list[T] = []
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                return out
+            if self._times:
+                self._times.popleft()
+            out.append(self._unwrap(item))
+
     def qsize(self) -> int:
         return self._queue.qsize()
+
+    def oldest_age(self, now: Optional[float] = None) -> float:
+        """Seconds the head message has been waiting (0.0 when empty) —
+        the watchdog's per-mailbox stall signal."""
+        if not self._times:
+            return 0.0
+        return (time.monotonic() if now is None else now) - self._times[0]
 
     def __repr__(self) -> str:
         return f"<Mailbox {self.name or hex(id(self))} n={self._queue.qsize()}>"

@@ -37,6 +37,7 @@ from .events import events
 from .metrics import metrics
 from .store import KVStore, put_op
 from .trace import span
+from .tracectx import finish_active as _finish_active_trace
 from .wire import BlockHeader, MsgGetHeaders, MsgSendHeaders
 
 __all__ = [
@@ -224,6 +225,9 @@ class Chain:
             msg = await self.mailbox.receive()
             if isinstance(msg, _Headers):
                 self._process_headers(msg.peer, msg.headers)
+                # a headers message's pipeline trace (started in the peer
+                # wire loop, carried here by the mailbox) ends at import
+                _finish_active_trace()
             elif isinstance(msg, _PeerConnected):
                 self._add_peer(msg.peer)
                 self._sync_new_peer()

@@ -1,0 +1,208 @@
+"""Zero-dependency debug HTTP server: live node introspection.
+
+A minimal asyncio HTTP/1.1 server (stdlib only — no framework) exposing
+the telemetry that already exists in-process:
+
+* ``GET /metrics``  — Prometheus text exposition (``render_prometheus``)
+* ``GET /health``   — the embedder-supplied health snapshot as JSON
+* ``GET /stats``    — the full stats snapshot as JSON (when supplied)
+* ``GET /events?n=100&type=watchdog.stall`` — recent structured events
+* ``GET /traces?n=8`` — recent + slowest finished trace trees (tracectx)
+
+Off by default: enable with ``NodeConfig.debug_port`` (0 binds an
+ephemeral port — read it back from ``DebugServer.port``).  Binds
+``127.0.0.1`` only; this is an operator/debug surface, not a public API.
+Every response closes the connection (``Connection: close``) — curl-able,
+scrape-able, nothing more.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import logging
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlsplit
+
+from .events import EventLog, events
+from .metrics import Metrics, metrics
+from .tracectx import Tracer, tracer
+
+__all__ = ["DebugServer"]
+
+log = logging.getLogger("tpunode.debugsrv")
+
+_MAX_REQUEST_LINE = 8192
+_HEADER_TIMEOUT = 5.0
+
+
+class DebugServer:
+    """Serve the debug endpoints until the scope closes::
+
+        async with DebugServer(port=0, health=node.health) as srv:
+            ...  # GET http://127.0.0.1:{srv.port}/metrics
+    """
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        health: Optional[Callable[[], dict]] = None,
+        stats: Optional[Callable[[], dict]] = None,
+        registry: Optional[Metrics] = None,
+        log_: Optional[EventLog] = None,
+        tracer_: Optional[Tracer] = None,
+    ):
+        self._want_port = port
+        self.host = host
+        self.health = health
+        self.stats = stats
+        self.registry = registry if registry is not None else metrics
+        self.log = log_ if log_ is not None else events
+        self.tracer = tracer_ if tracer_ is not None else tracer
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.port: Optional[int] = None  # actual bound port once started
+
+    async def start(self) -> "DebugServer":
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self._want_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("[DebugSrv] listening on %s:%d", self.host, self.port)
+        return self
+
+    async def aclose(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            with contextlib.suppress(Exception):
+                await self._server.wait_closed()
+            self._server = None
+
+    async def __aenter__(self) -> "DebugServer":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.aclose()
+
+    # -- request handling -----------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            line = await asyncio.wait_for(
+                reader.readline(), timeout=_HEADER_TIMEOUT
+            )
+            if not line or len(line) > _MAX_REQUEST_LINE:
+                return
+            parts = line.decode("latin-1").split()
+            if len(parts) < 2:
+                return
+            method, target = parts[0], parts[1]
+            # drain request headers (ignored; no bodies on GET)
+            while True:
+                hdr = await asyncio.wait_for(
+                    reader.readline(), timeout=_HEADER_TIMEOUT
+                )
+                if hdr in (b"\r\n", b"\n", b""):
+                    break
+            if method != "GET":
+                self._respond(writer, 405, {"error": "method not allowed"})
+            else:
+                self._route(writer, target)
+            with contextlib.suppress(Exception):
+                await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        except Exception:  # a handler bug must not kill the server
+            log.exception("[DebugSrv] request failed")
+            with contextlib.suppress(Exception):
+                self._respond(writer, 500, {"error": "internal error"})
+        finally:
+            with contextlib.suppress(Exception):
+                writer.close()
+
+    def _route(self, writer: asyncio.StreamWriter, target: str) -> None:
+        url = urlsplit(target)
+        path = url.path
+        params = parse_qs(url.query)
+
+        def qint(name: str, default: int, cap: int = 4096) -> int:
+            try:
+                return max(0, min(cap, int(params[name][0])))
+            except (KeyError, ValueError, IndexError):
+                return default
+
+        if path == "/metrics":
+            self._respond_text(writer, 200, self.registry.render_prometheus())
+        elif path == "/health":
+            body = self.health() if self.health is not None else {"ok": True}
+            self._respond(writer, 200, body)
+        elif path == "/stats" and self.stats is not None:
+            self._respond(writer, 200, self.stats())
+        elif path == "/events":
+            typ = params.get("type", [None])[0]
+            self._respond(
+                writer,
+                200,
+                {
+                    "events": self.log.tail(qint("n", 100), type=typ),
+                    "counts": self.log.counts(),
+                },
+            )
+        elif path == "/traces":
+            n = qint("n", 16, cap=256)
+            self._respond(
+                writer,
+                200,
+                {
+                    "recent": self.tracer.recent_traces(n),
+                    "slowest": self.tracer.slowest(n),
+                },
+            )
+        else:
+            self._respond(
+                writer,
+                404,
+                {
+                    "error": f"no such endpoint: {path}",
+                    "endpoints": [
+                        "/metrics", "/health", "/stats",
+                        "/events?n=&type=", "/traces?n=",
+                    ],
+                },
+            )
+
+    _STATUS = {
+        200: "OK",
+        404: "Not Found",
+        405: "Method Not Allowed",
+        500: "Internal Server Error",
+    }
+
+    def _respond_text(
+        self,
+        writer: asyncio.StreamWriter,
+        status: int,
+        body: str,
+        ctype: str = "text/plain; version=0.0.4; charset=utf-8",
+    ) -> None:
+        data = body.encode("utf-8")
+        head = (
+            f"HTTP/1.1 {status} {self._STATUS.get(status, '?')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(data)}\r\n"
+            "Connection: close\r\n\r\n"
+        )
+        writer.write(head.encode("latin-1") + data)
+
+    def _respond(
+        self, writer: asyncio.StreamWriter, status: int, body: dict
+    ) -> None:
+        self._respond_text(
+            writer,
+            status,
+            json.dumps(body, default=str),
+            ctype="application/json; charset=utf-8",
+        )

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import io
 import json
+import logging
 import os
 import threading
 import time
@@ -35,9 +36,27 @@ from .metrics import metrics
 
 __all__ = ["EventLog", "events", "StatsReporter"]
 
+log = logging.getLogger("tpunode.events")
+
+
+class _Observer:
+    """One subscriber: its callback plus a consecutive-failure count (the
+    auto-unsubscribe bookkeeping — see ``EventLog.emit``)."""
+
+    __slots__ = ("cb", "failures")
+
+    def __init__(self, cb: Callable[[dict], None]):
+        self.cb = cb
+        self.failures = 0
+
 
 class EventLog:
     """Ring buffer of typed events with an optional JSONL file sink."""
+
+    # Consecutive callback failures before a subscriber is dropped: a
+    # persistently-broken observer must not keep burning the emitters'
+    # hot path (each failure pays exception handling + a counter).
+    MAX_SUBSCRIBER_FAILURES = 10
 
     def __init__(self, maxlen: int = 4096, path: Optional[str] = None):
         self._lock = threading.Lock()
@@ -50,7 +69,7 @@ class EventLog:
         self._file: Optional[io.TextIOBase] = None
         self._path = path if path is not None else os.environ.get("TPUNODE_EVENTS")
         # observers get every event dict (node republishes to its bus)
-        self._observers: list[Callable[[dict], None]] = []
+        self._observers: list[_Observer] = []
 
     def emit(self, type: str, **fields) -> dict:
         """Record one event; returns the event dict (with ``ts`` set)."""
@@ -79,11 +98,24 @@ class EventLog:
                 with self._lock:
                     self._file = None
                     self._path = None
-        for cb in observers:
+        for ob in observers:
+            # a raised callback must not propagate into the emitter's hot
+            # path: count it, and drop the subscriber after enough
+            # CONSECUTIVE failures (one success re-arms the budget)
             try:
-                cb(ev)
-            except Exception:
-                pass  # a broken observer must not break emitters
+                ob.cb(ev)
+                ob.failures = 0
+            except Exception as e:
+                metrics.inc("events.subscriber_errors")
+                ob.failures += 1
+                if ob.failures >= self.MAX_SUBSCRIBER_FAILURES:
+                    with self._lock:
+                        if ob in self._observers:
+                            self._observers.remove(ob)
+                    log.warning(
+                        "event subscriber %r dropped after %d consecutive "
+                        "failures (last: %r)", ob.cb, ob.failures, e,
+                    )
         return ev
 
     def tail(self, n: int = 100, type: Optional[str] = None) -> list[dict]:
@@ -100,14 +132,19 @@ class EventLog:
             return dict(self._counts)
 
     def subscribe(self, cb: Callable[[dict], None]) -> Callable[[], None]:
-        """Register an observer; returns an unsubscribe callable."""
+        """Register an observer; returns an unsubscribe callable.
+
+        Observer exceptions never reach emitters: they are counted in the
+        ``events.subscriber_errors`` metric, and a subscriber that fails
+        :data:`MAX_SUBSCRIBER_FAILURES` times in a row is dropped."""
+        ob = _Observer(cb)
         with self._lock:
-            self._observers.append(cb)
+            self._observers.append(ob)
 
         def unsubscribe() -> None:
             with self._lock:
-                if cb in self._observers:
-                    self._observers.remove(cb)
+                if ob in self._observers:
+                    self._observers.remove(ob)
 
         return unsubscribe
 
@@ -141,6 +178,13 @@ _RATED = (
     "peer.bytes_out",
 )
 
+# Labeled families summarized into every stats event as bounded-cardinality
+# aggregates: family name -> the label key to sum by.  The raw per-peer
+# series stay out of the persisted event (unbounded cardinality — they
+# belong to Node.stats()/render_prometheus() pulls); summing ``peer.msgs``
+# by ``cmd`` keeps the message-mix signal without the peer dimension.
+_LABEL_AGG: dict[str, str] = {"peer.msgs": "cmd"}
+
 
 class StatsReporter:
     """Periodic registry snapshot -> windowed rates -> ``stats`` events.
@@ -159,10 +203,12 @@ class StatsReporter:
         interval: float = 30.0,
         log: Optional[EventLog] = None,
         extra: Optional[Callable[[], dict]] = None,
+        label_agg: Optional[dict[str, str]] = None,
     ):
         self.interval = interval
         self.log = log if log is not None else events
         self.extra = extra  # node hook: chain height, fleet size, backlog
+        self.label_agg = _LABEL_AGG if label_agg is None else label_agg
         self._last: Optional[tuple[float, dict[str, float]]] = None
 
     def tick(self) -> dict:
@@ -183,7 +229,18 @@ class StatsReporter:
                 if cur or prev.get(name):
                     rates[name] = round((cur - prev.get(name, 0.0)) / dt, 3)
         self._last = (now, snap)
-        fields: dict = {"rates": rates, "counters": snap}
+        # labeled-series aggregates (see _LABEL_AGG): bounded by the label
+        # key's value space (e.g. wire commands), never by peer count
+        labeled: dict[str, dict[str, float]] = {}
+        for family, key in self.label_agg.items():
+            agg: dict[str, float] = {}
+            for lk, v in metrics.series(family).items():
+                value = dict(lk).get(key)
+                if value is not None:
+                    agg[value] = agg.get(value, 0.0) + v
+            if agg:
+                labeled[family] = agg
+        fields: dict = {"rates": rates, "counters": snap, "labeled": labeled}
         if self.extra is not None:
             try:
                 fields.update(self.extra())

@@ -381,7 +381,15 @@ class Metrics:
             )
 
         def esc(v: str) -> str:
-            return v.replace("\\", "\\\\").replace('"', '\\"')
+            # exposition-format 0.0.4 label-value escaping: backslash,
+            # double-quote AND newline (peer addresses and error strings
+            # are attacker-influenced; a raw newline would let one forge
+            # arbitrary exposition lines)
+            return (
+                v.replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
 
         def plabels(lk: _LabelKey, extra: str = "") -> str:
             parts = [f'{k}="{esc(v)}"' for k, v in lk]

@@ -23,8 +23,19 @@ from typing import Callable, Optional
 
 from .actors import LinkedTasks, Publisher, Supervisor
 from .chain import Chain, ChainBestBlock, ChainConfig, ChainEvent
+from .debugsrv import DebugServer
 from .events import StatsReporter, events
 from .metrics import metrics, percentiles
+from .trace import span
+from .tracectx import (
+    activate as _activate_trace,
+    clear_active as _clear_active_trace,
+    current as _trace_current,
+    discard_active as _discard_active_trace,
+    finish_active as _finish_active_trace,
+    tracer,
+)
+from .watchdog import Watchdog, WatchdogConfig
 from .txverify import (
     ExtractStats,
     combine_verdicts,
@@ -147,6 +158,14 @@ class NodeConfig:
     # telemetry: seconds between StatsReporter snapshots (windowed rates +
     # ``stats`` events on the structured event log); 0 disables the loop
     stats_interval: float = 30.0
+    # stall watchdog cadence (event-loop lag, actor-mailbox head age,
+    # verify dispatch in-flight time -> ``watchdog.stall`` events);
+    # 0 disables the loop.  Thresholds live in tpunode/watchdog.py.
+    watchdog_interval: float = 1.0
+    # debug HTTP server (tpunode/debugsrv.py: /metrics /health /stats
+    # /events /traces on 127.0.0.1).  None = off (the default); 0 binds an
+    # ephemeral port, readable from node.debug_server.port.
+    debug_port: Optional[int] = None
     # prevout oracle for BIP143 (P2WPKH / BCH FORKID) and BIP341 (taproot)
     # sighashes: (prevout txid, vout) -> satoshi amount, or
     # (amount, scriptPubKey), or None if unknown.  The tuple form enables
@@ -232,6 +251,8 @@ class Node:
         self._shed_flush: Optional[asyncio.Task] = None
         self._started_at: Optional[float] = None
         self._stats_reporter: Optional[StatsReporter] = None
+        self._watchdog: Optional[Watchdog] = None
+        self.debug_server: Optional[DebugServer] = None
 
     @staticmethod
     def _verify_task_died(task, exc) -> None:
@@ -276,6 +297,20 @@ class Node:
                 interval=self.cfg.stats_interval, extra=self._stats_extra
             )
             self._tasks.link(self._stats_reporter.run(), name="stats-reporter")
+        if self.cfg.watchdog_interval > 0:
+            self._watchdog = Watchdog(
+                WatchdogConfig(interval=self.cfg.watchdog_interval),
+                mailboxes=[self.chain.mailbox, self.peer_mgr.mailbox],
+                engine=self.verify_engine,
+            )
+            self._tasks.link(self._watchdog.run(), name="watchdog")
+        if self.cfg.debug_port is not None:
+            self.debug_server = DebugServer(
+                port=self.cfg.debug_port,
+                health=self.health,
+                stats=self.stats,
+            )
+            await self._stack.enter_async_context(self.debug_server)
         log.info(
             "[Node] started on %s (%d static peers, discover=%s, verify=%s)",
             self.cfg.net.name,
@@ -530,8 +565,12 @@ class Node:
         if len(self._tx_accum) >= self.MAX_TX_ACCUM:
             metrics.inc("node.verify_dropped")
             self._publish_shed(peer, 1)
+            # the shed decision ends this message's pipeline: close its
+            # trace unretained (a flood of shed stubs must not evict the
+            # traces that matter from the rings)
+            _discard_active_trace()
             return
-        self._tx_accum.append((peer, tx, raw))
+        self._tx_accum.append((peer, tx, raw, _trace_current()))
         if self._tx_drain is None or self._tx_drain.done():
             self._tx_drain = self._verify_tasks.add_child(
                 self._drain_tx_accum(), name="verify-tx-drain"
@@ -548,6 +587,11 @@ class Node:
         from .txextract import ParsedTxRegion
 
         bch = self.cfg.net.bch
+        # The drain task inherited the FIRST accumulated message's trace
+        # context at creation and outlives it by many batches: clear it so
+        # batch-level spans attach to the current batch's own trace below,
+        # never to a finished (already retained/exported) one.
+        _clear_active_trace()
         # Bounded drain batches: one giant extract+verify would add seconds
         # of verdict latency under flood; ~2k txs keeps the engine fed in
         # device-batch-sized bites while verdicts keep flowing.
@@ -555,57 +599,83 @@ class Node:
         while self._tx_accum:
             batch = self._tx_accum[:DRAIN_BATCH]
             del self._tx_accum[:DRAIN_BATCH]
-            concat = b"".join(r for _, _, r in batch)
-            try:
-                region = await asyncio.to_thread(
-                    ParsedTxRegion, concat, len(batch)
-                )
+            concat = b"".join(r for _, _, r, _ in batch)
+            # batch-level spans (extract, engine wait, commit) land in the
+            # first traced submitter's tree — that trace is part of THIS
+            # batch and still open (best-effort for the coalesced rest)
+            act0 = next((a for _, _, _, a in batch if a is not None), None)
+            with _activate_trace(act0):
                 try:
-                    ext, ext_scripts = self._resolve_ext_rows(region, bch)
-                    items = await asyncio.to_thread(
-                        region.extract,
-                        bch=bch,
-                        intra_amounts=False,
-                        ext_amounts=ext,
-                        ext_scripts=ext_scripts,
-                    )
-                finally:
-                    region.close()
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                # isolate the offender: each tx goes through the single-tx
-                # native path on its own (error verdicts + peer kill there)
-                for peer, tx, raw in batch:
-                    await self._verify_txs_native(
-                        peer, raw, 1, txs=[tx], tracked=False
-                    )
-                continue
-            metrics.inc("node.verify_txs", len(batch))
-            metrics.inc("node.verify_inputs", int(items.tx_n_inputs.sum()))
-            verdicts: list[bool] = []
-            if items.count:
-                try:
-                    assert self.verify_engine is not None
-                    verdicts = await self.verify_engine.verify_raw(items)
+                    with span("node.extract"):
+                        region = await asyncio.to_thread(
+                            ParsedTxRegion, concat, len(batch)
+                        )
+                        try:
+                            ext, ext_scripts = self._resolve_ext_rows(
+                                region, bch
+                            )
+                            items = await asyncio.to_thread(
+                                region.extract,
+                                bch=bch,
+                                intra_amounts=False,
+                                ext_amounts=ext,
+                                ext_scripts=ext_scripts,
+                            )
+                        finally:
+                            region.close()
                 except asyncio.CancelledError:
                     raise
-                except Exception as e:
-                    self._verify_failure("engine", e)
-                    for ti, (peer, _, _) in enumerate(batch):
-                        self.cfg.pub.publish(
-                            TxVerdict(peer, items.txid(ti), False, (),
-                                      items.stats(ti), error=f"engine: {e}")
-                        )
+                except Exception:
+                    # isolate the offender: each tx goes through the
+                    # single-tx native path on its own (error verdicts +
+                    # peer kill there; finishes each tx's trace too)
+                    for peer, tx, raw, act in batch:
+                        with _activate_trace(act):
+                            await self._verify_txs_native(
+                                peer, raw, 1, txs=[tx], tracked=False
+                            )
                     continue
-            per_sig = items.combine(verdicts)
-            sig_slices = items.sig_slices()
-            for ti, (peer, _, _) in enumerate(batch):
-                vs = tuple(per_sig[sig_slices[ti]])
-                self.cfg.pub.publish(
-                    TxVerdict(peer, items.txid(ti), all(vs), vs,
-                              items.stats(ti))
+                metrics.inc("node.verify_txs", len(batch))
+                metrics.inc(
+                    "node.verify_inputs", int(items.tx_n_inputs.sum())
                 )
+                verdicts: list[bool] = []
+                if items.count:
+                    try:
+                        assert self.verify_engine is not None
+                        verdicts = await self.verify_engine.verify_raw(items)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        self._verify_failure("engine", e)
+                        for ti, (peer, _, _, _) in enumerate(batch):
+                            self.cfg.pub.publish(
+                                TxVerdict(peer, items.txid(ti), False, (),
+                                          items.stats(ti),
+                                          error=f"engine: {e}")
+                            )
+                        self._finish_batch_traces(batch)
+                        continue
+                with span("node.commit"):
+                    per_sig = items.combine(verdicts)
+                    sig_slices = items.sig_slices()
+                    for ti, (peer, _, _, _) in enumerate(batch):
+                        vs = tuple(per_sig[sig_slices[ti]])
+                        self.cfg.pub.publish(
+                            TxVerdict(peer, items.txid(ti), all(vs), vs,
+                                      items.stats(ti))
+                        )
+            # traces end AFTER the batch spans close, so a finished trace
+            # is never mutated (retention/export reads it immediately)
+            self._finish_batch_traces(batch)
+
+    @staticmethod
+    def _finish_batch_traces(batch) -> None:
+        """Finish every accumulated message's trace at its verdict (the
+        per-message traces are distinct; finish is idempotent anyway)."""
+        for _, _, _, act in batch:
+            if act is not None:
+                tracer.finish(act[0])
 
     def _submit_verify(
         self,
@@ -628,6 +698,7 @@ class Node:
         if self._verify_pending >= self.MAX_VERIFY_PENDING:
             metrics.inc("node.verify_dropped", n_txs)
             self._publish_shed(peer, n_txs)
+            _discard_active_trace()  # shed: pipeline ends here, unretained
             return
         self._verify_pending += 1
         if block is not None:
@@ -650,6 +721,7 @@ class Node:
                                   error=f"block decode: {e}")
                     )
                     peer.kill(CannotDecodePayload(f"block: {e}"))
+                    _finish_active_trace()  # verdict published: trace ends
                     return
             coro = self._verify_txs(peer, txs)
         self._verify_tasks.add_child(coro, name="verify-txs")
@@ -699,33 +771,36 @@ class Node:
             # ONE native parse feeds both the prevout listing and the
             # extraction (ParsedTxRegion; the amount-oracle path used to
             # parse the region twice more).
-            try:
-                region = await asyncio.to_thread(ParsedTxRegion, raw, n_txs)
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:
-                _publish_extract_error(e)
-                return
-            # Out-of-block prevout rows via the embedder's oracle,
-            # flattened per input in parse order.  The native side consults
-            # its intra-block map FIRST, so resolving every wants-marked
-            # input here matches the Python path's block_outs ->
-            # prevout_lookup precedence (an in-block hit shadows whatever
-            # the oracle would have said).
-            ext, ext_scripts = self._resolve_ext_rows(region, bch)
-            try:
-                items = await asyncio.to_thread(
-                    region.extract,
-                    bch=bch,
-                    intra_amounts=n_txs > 1,
-                    ext_amounts=ext,
-                    ext_scripts=ext_scripts,
-                )
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:
-                _publish_extract_error(e)
-                return
+            with span("node.extract"):
+                try:
+                    region = await asyncio.to_thread(
+                        ParsedTxRegion, raw, n_txs
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    _publish_extract_error(e)
+                    return
+                # Out-of-block prevout rows via the embedder's oracle,
+                # flattened per input in parse order.  The native side
+                # consults its intra-block map FIRST, so resolving every
+                # wants-marked input here matches the Python path's
+                # block_outs -> prevout_lookup precedence (an in-block hit
+                # shadows whatever the oracle would have said).
+                ext, ext_scripts = self._resolve_ext_rows(region, bch)
+                try:
+                    items = await asyncio.to_thread(
+                        region.extract,
+                        bch=bch,
+                        intra_amounts=n_txs > 1,
+                        ext_amounts=ext,
+                        ext_scripts=ext_scripts,
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    _publish_extract_error(e)
+                    return
             metrics.inc("node.verify_txs", items.n_txs)
             metrics.inc("node.verify_inputs", int(items.tx_n_inputs.sum()))
             verdicts: list[bool] = []
@@ -743,17 +818,21 @@ class Node:
                         )
                     return
             # candidate verdicts -> per-signature verdicts (consensus walk)
-            per_sig = items.combine(verdicts)
-            for ti, sl in enumerate(items.sig_slices()):
-                vs = tuple(per_sig[sl])
-                self.cfg.pub.publish(
-                    TxVerdict(peer, items.txid(ti), all(vs), vs, items.stats(ti))
-                )
+            with span("node.commit"):
+                per_sig = items.combine(verdicts)
+                for ti, sl in enumerate(items.sig_slices()):
+                    vs = tuple(per_sig[sl])
+                    self.cfg.pub.publish(
+                        TxVerdict(peer, items.txid(ti), all(vs), vs,
+                                  items.stats(ti))
+                    )
         finally:
             if region is not None:
                 region.close()
             if tracked:
                 self._verify_pending -= 1
+            # the item's pipeline trace (if any) ends with its verdicts
+            _finish_active_trace()
 
     async def _verify_txs(self, peer, txs: list[Tx]) -> None:
         """Verify every tx of one message.  All txs' signatures are submitted
@@ -768,69 +847,76 @@ class Node:
         block_outs = intra_block_prevouts(txs) if len(txs) > 1 else {}
         per_tx: list[tuple[Tx, ExtractStats, list, Optional[asyncio.Task]]] = []
         try:
-            for tx in txs:
-                try:
-                    # everything touching tx attributes goes inside the
-                    # guard: a malformed LazyTx (wire.LazyTx) raises on
-                    # first attribute access, which must become an error
-                    # verdict + peer kill, never a dead ingest task
-                    amounts: dict[int, int] = {}
-                    scripts: dict[int, bytes] = {}
-                    for idx, txin in enumerate(tx.inputs):
-                        key = (txin.prevout.txid, txin.prevout.index)
-                        # Precedence mirrors the native resolve(): the
-                        # intra-block map is consulted for EVERY input (a
-                        # dict hit is free, and classification must see
-                        # in-block P2TR scripts identically on both
-                        # paths); the external oracle only for inputs the
-                        # tx-level witness gate marks (review r5 parity
-                        # finding).
-                        hit = block_outs.get(key)
-                        if hit is not None:
-                            amt, script = hit
-                        elif self.cfg.prevout_lookup is not None and (
-                            wants_amount(tx, idx, self.cfg.net.bch)
-                        ):
-                            amt, script = _prevout_info(
-                                self.cfg.prevout_lookup(*key)
-                            )
-                        else:
-                            amt = script = None
-                        if amt is not None:
-                            amounts[idx] = amt
-                        if script is not None:
-                            scripts[idx] = script
-                    items, stats = extract_sig_items(
-                        tx,
-                        prevout_amounts=amounts or None,
-                        bch=self.cfg.net.bch,
-                        prevout_scripts=scripts or None,
-                    )
-                except Exception as e:
-                    self._verify_failure("extract", e)
+            with span("node.extract"):
+                for tx in txs:
                     try:
-                        txid = tx.txid
-                    except Exception:
-                        txid = b""  # unparseable lazy tx: aggregate verdict
-                        peer.kill(CannotDecodePayload(f"tx: {e}"))
-                    self.cfg.pub.publish(
-                        TxVerdict(peer, txid, False, (), ExtractStats(),
-                                  error=f"extract: {e}")
-                    )
-                    continue
-                metrics.inc("node.verify_txs")
-                metrics.inc("node.verify_inputs", stats.total_inputs)
-                task = None
-                if items:
-                    task = asyncio.ensure_future(
-                        self.verify_engine.verify(
-                            [i.verify_item for i in items]
+                        # everything touching tx attributes goes inside the
+                        # guard: a malformed LazyTx (wire.LazyTx) raises on
+                        # first attribute access, which must become an error
+                        # verdict + peer kill, never a dead ingest task
+                        amounts: dict[int, int] = {}
+                        scripts: dict[int, bytes] = {}
+                        for idx, txin in enumerate(tx.inputs):
+                            key = (txin.prevout.txid, txin.prevout.index)
+                            # Precedence mirrors the native resolve(): the
+                            # intra-block map is consulted for EVERY input (a
+                            # dict hit is free, and classification must see
+                            # in-block P2TR scripts identically on both
+                            # paths); the external oracle only for inputs the
+                            # tx-level witness gate marks (review r5 parity
+                            # finding).
+                            hit = block_outs.get(key)
+                            if hit is not None:
+                                amt, script = hit
+                            elif self.cfg.prevout_lookup is not None and (
+                                wants_amount(tx, idx, self.cfg.net.bch)
+                            ):
+                                amt, script = _prevout_info(
+                                    self.cfg.prevout_lookup(*key)
+                                )
+                            else:
+                                amt = script = None
+                            if amt is not None:
+                                amounts[idx] = amt
+                            if script is not None:
+                                scripts[idx] = script
+                        items, stats = extract_sig_items(
+                            tx,
+                            prevout_amounts=amounts or None,
+                            bch=self.cfg.net.bch,
+                            prevout_scripts=scripts or None,
                         )
-                    )
-                per_tx.append((tx, stats, items, task))
+                    except Exception as e:
+                        self._verify_failure("extract", e)
+                        try:
+                            txid = tx.txid
+                        except Exception:
+                            txid = b""  # unparseable lazy tx: aggregate
+                            peer.kill(CannotDecodePayload(f"tx: {e}"))
+                        self.cfg.pub.publish(
+                            TxVerdict(peer, txid, False, (), ExtractStats(),
+                                      error=f"extract: {e}")
+                        )
+                        continue
+                    metrics.inc("node.verify_txs")
+                    metrics.inc("node.verify_inputs", stats.total_inputs)
+                    task = None
+                    if items:
+                        task = asyncio.ensure_future(
+                            self.verify_engine.verify(
+                                [i.verify_item for i in items]
+                            )
+                        )
+                    per_tx.append((tx, stats, items, task))
+            # Awaiting the engine happens OUTSIDE any commit span — the
+            # wait is already attributed by the verify.queue spans, and
+            # folding it into node.commit would make that histogram mean
+            # something different on this path than on the native one.
             for tx, stats, items, task in per_tx:
                 if task is None:
-                    self.cfg.pub.publish(TxVerdict(peer, tx.txid, True, (), stats))
+                    self.cfg.pub.publish(
+                        TxVerdict(peer, tx.txid, True, (), stats)
+                    )
                     continue
                 try:
                     verdicts = await task
@@ -844,15 +930,19 @@ class Node:
                     )
                     continue
                 # candidate verdicts -> per-signature (consensus walk)
-                per_sig = tuple(combine_verdicts(items, verdicts))
-                self.cfg.pub.publish(
-                    TxVerdict(peer, tx.txid, all(per_sig), per_sig, stats)
-                )
+                with span("node.commit"):
+                    per_sig = tuple(combine_verdicts(items, verdicts))
+                    self.cfg.pub.publish(
+                        TxVerdict(peer, tx.txid, all(per_sig), per_sig,
+                                  stats)
+                    )
         finally:
             self._verify_pending -= 1
             for _, _, _, task in per_tx:
                 if task is not None and not task.done():
                     task.cancel()
+            # the message's pipeline trace (if any) ends with its verdicts
+            _finish_active_trace()
 
 
 class _TCPConnection:

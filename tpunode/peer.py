@@ -25,6 +25,8 @@ from .actors import Mailbox, Publisher
 from .compat import timeout as _timeout
 from .metrics import metrics
 from .params import Network
+from .trace import span
+from .tracectx import _ACTIVE as _active_trace, tracer
 from .util import hash_to_hex
 from .wire import (
     Block,
@@ -267,6 +269,11 @@ class ConnectionReader:
         return out
 
 
+# Message commands that open a per-item pipeline trace (tracectx): the
+# payloads whose lifecycle spans actor hops and the verify engine.
+_TRACED_COMMANDS = ("block", "tx", "headers")
+
+
 async def _inbound_loop(cfg: PeerConfig, peer: Peer, conn: Connection) -> None:
     """Frame, decode and publish every message from the peer
     (the hot loop; reference ``inPeerConduit`` Peer.hs:247-279)."""
@@ -279,26 +286,57 @@ async def _inbound_loop(cfg: PeerConfig, peer: Peer, conn: Connection) -> None:
             raise DecodeHeaderError(str(e)) from e
         if header.length > MAX_PAYLOAD:
             raise PayloadTooLarge(f"{header.command}: {header.length}")
-        payload = await reader.read_exact(header.length) if header.length else b""
-        try:
-            msg = decode_message(cfg.net, header, payload)
-        except DecodeError as e:
-            raise CannotDecodePayload(f"{header.command}: {e}") from e
-        if not metrics.disabled:  # hot loop: one flag read when off
-            metrics.inc_batch((  # one lock for all three
-                ("peer.msgs_in", 1.0, None),
-                ("peer.bytes_in", HEADER_SIZE + header.length, None),
-                ("peer.msgs", 1.0,
-                 {"peer": cfg.label, "cmd": header.command}),
-            ))
-        if log.isEnabledFor(logging.DEBUG):  # hot loop: skip formatting cost
-            log.debug(
-                "[Peer] %s: received %s (%d bytes)",
-                cfg.label,
-                header.command,
-                header.length,
+        # Block/tx/headers messages start a causal trace here — the first
+        # point the item exists — so payload delivery, decode, actor hops
+        # and verify phases all land in one tree.  Other commands keep the
+        # untraced hot path (one `enabled` read, no allocation).
+        tok = None
+        if tracer.enabled and header.command in _TRACED_COMMANDS:
+            tr = tracer.start(
+                header.command, peer=cfg.label, bytes=header.length
             )
-        cfg.pub.publish(PeerMessage(peer, msg))
+            tok = _active_trace.set((tr, tr.root.id))
+        try:
+            if tok is not None:
+                with span("peer.payload"):
+                    payload = (
+                        await reader.read_exact(header.length)
+                        if header.length
+                        else b""
+                    )
+                try:
+                    with span("peer.decode"):
+                        msg = decode_message(cfg.net, header, payload)
+                except DecodeError as e:
+                    raise CannotDecodePayload(f"{header.command}: {e}") from e
+            else:
+                payload = (
+                    await reader.read_exact(header.length)
+                    if header.length
+                    else b""
+                )
+                try:
+                    msg = decode_message(cfg.net, header, payload)
+                except DecodeError as e:
+                    raise CannotDecodePayload(f"{header.command}: {e}") from e
+            if not metrics.disabled:  # hot loop: one flag read when off
+                metrics.inc_batch((  # one lock for all three
+                    ("peer.msgs_in", 1.0, None),
+                    ("peer.bytes_in", HEADER_SIZE + header.length, None),
+                    ("peer.msgs", 1.0,
+                     {"peer": cfg.label, "cmd": header.command}),
+                ))
+            if log.isEnabledFor(logging.DEBUG):  # hot loop: skip format cost
+                log.debug(
+                    "[Peer] %s: received %s (%d bytes)",
+                    cfg.label,
+                    header.command,
+                    header.length,
+                )
+            cfg.pub.publish(PeerMessage(peer, msg))
+        finally:
+            if tok is not None:
+                _active_trace.reset(tok)
 
 
 async def _outbound_loop(cfg: PeerConfig, inbox: Mailbox, conn: Connection) -> None:

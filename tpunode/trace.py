@@ -14,11 +14,17 @@ BASELINE metrics honestly:
 * :func:`profile_to` — wraps ``jax.profiler.trace``: capture a full device
   profile into a directory (``TPUNODE_PROFILE=<dir>`` in bench.py).
 
+When a request-scoped trace is active (tpunode/tracectx.py — one
+per-block/tx pipeline trace), every span additionally lands as a child
+node in that trace's tree, so the same instrumented regions feed both the
+aggregate histograms and the causal per-item view.
+
 Spans are deliberately cheap — a slotted context-manager class, two
-``perf_counter`` calls and one locked registry update, with the profiler
-annotation skipped outside an active capture — so they can wrap the
-per-batch hot path (< 5µs per entry, pinned by tests/test_bench.py).
-``TPUNODE_NO_METRICS=1`` (metrics.disabled) skips the timing entirely.
+``perf_counter`` calls, one ContextVar read and one locked registry
+update, with the profiler annotation skipped outside an active capture —
+so they can wrap the per-batch hot path (< 5µs per entry with no active
+trace, pinned by tests/test_bench.py).  ``TPUNODE_NO_METRICS=1``
+(metrics.disabled) skips the metric timing entirely.
 """
 
 from __future__ import annotations
@@ -28,6 +34,7 @@ import time
 from typing import Iterator, Optional
 
 from .metrics import metrics
+from .tracectx import _ACTIVE as _active_trace
 
 __all__ = ["span", "profile_to"]
 
@@ -63,13 +70,23 @@ def _names(name: str) -> tuple[str, str, str]:
 class span:
     """``with span("verify.dispatch"): ...`` — see module docstring."""
 
-    __slots__ = ("_name", "_ann", "_t0")
+    __slots__ = ("_name", "_ann", "_t0", "_rec", "_tok")
 
     def __init__(self, name: str):
         self._name = name
         self._ann = None
 
     def __enter__(self) -> "span":
+        # Active per-item trace (tracectx): record this region as a child
+        # span and make it the parent of any nested spans.  One ContextVar
+        # read on the no-trace fast path.
+        act = _active_trace.get()
+        if act is None:
+            self._rec = None
+        else:
+            tr, parent = act
+            self._rec = tr.begin(self._name, parent)
+            self._tok = _active_trace.set((tr, self._rec.id))
         if _profiling and _jax_profiler is not None:
             try:
                 ann = _jax_profiler.TraceAnnotation(self._name)
@@ -85,6 +102,11 @@ class span:
         if not metrics.disabled:
             keys = _names(self._name)
             metrics.time_span(keys[0], keys[1], keys[2], dt)
+        rec = self._rec
+        if rec is not None:
+            rec.dur = dt
+            _active_trace.reset(self._tok)
+            self._rec = None
         if self._ann is not None:
             self._ann.__exit__(exc_type, exc, tb)
             self._ann = None

@@ -37,6 +37,7 @@ from typing import Callable, Optional, Sequence
 from ..events import events
 from ..metrics import metrics
 from ..trace import span
+from ..tracectx import activate as _activate_trace, current as _trace_current
 from .ecdsa_cpu import Point, verify_batch_cpu
 from .raw import as_raw_batch, concat_raw
 
@@ -218,9 +219,15 @@ class VerifyEngine:
 
     def __init__(self, cfg: Optional[VerifyConfig] = None):
         self.cfg = cfg or VerifyConfig()
-        self._queue: collections.deque[tuple[list[VerifyItem], asyncio.Future]] = (
-            collections.deque()
-        )
+        # (payload, future, trace position | None) — the trace rides the
+        # queue so dispatch phases land in the submitting item's trace
+        self._queue: collections.deque[
+            tuple[list[VerifyItem], asyncio.Future, Optional[tuple]]
+        ] = collections.deque()
+        # monotonic start of the dispatch currently in the worker thread
+        # (None when idle): the watchdog's dispatch-stall signal — a wedged
+        # device backend pins this while the event loop stays healthy
+        self._dispatch_started: Optional[float] = None
         self._kick: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._cpu = None
@@ -309,8 +316,14 @@ class VerifyEngine:
         q = tuple(self._queue)
         return {
             "batches": len(q),
-            "items": sum(len(p) for p, _ in q),
+            "items": sum(len(p) for p, _, _ in q),
         }
+
+    def dispatch_inflight_seconds(self) -> float:
+        """How long the current dispatch has been in the worker thread
+        (0.0 when idle) — polled by the stall watchdog."""
+        t0 = self._dispatch_started
+        return 0.0 if t0 is None else time.monotonic() - t0
 
     def stats(self) -> dict:
         """Telemetry snapshot for Node.stats()/health()."""
@@ -321,6 +334,9 @@ class VerifyEngine:
             "device_error": self._device_error,
             "device_batch": self._device_batch,
             "backlog": self.queue_depth(),
+            "dispatch_inflight_seconds": round(
+                self.dispatch_inflight_seconds(), 3
+            ),
             "batches": metrics.get("verify.batches"),
             "items": metrics.get("verify.items"),
             "errors": metrics.get("verify.dispatch_errors"),
@@ -348,7 +364,7 @@ class VerifyEngine:
             with contextlib.suppress(asyncio.CancelledError):
                 await self._task
         # fail any stragglers
-        for _, fut in self._queue:
+        for _, fut, _ in self._queue:
             if not fut.done():
                 fut.cancel()
         self._queue.clear()
@@ -369,7 +385,14 @@ class VerifyEngine:
         if not len(payload):
             return []
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.append((payload, fut))
+        act = _trace_current()
+        if act is not None:
+            # queue-wait + dispatch as one span in the submitter's trace:
+            # closed when the batch future resolves, however it resolves
+            tr = act[0]
+            rec = tr.begin("verify.queue", act[1], items=len(payload))
+            fut.add_done_callback(lambda _f, tr=tr, rec=rec: tr.end(rec))
+        self._queue.append((payload, fut, act))
         assert self._kick is not None, "engine not started"
         self._kick.set()
         return await fut
@@ -400,7 +423,7 @@ class VerifyEngine:
             # burned ≤500 wakes/s per linger window): sleep until either a
             # new enqueue kicks, or the linger deadline passes.
             deadline = time.monotonic() + self.cfg.max_wait
-            while sum(len(i) for i, _ in self._queue) < target:
+            while sum(len(i) for i, _, _ in self._queue) < target:
                 remain = deadline - time.monotonic()
                 if remain <= 0:
                     break
@@ -410,28 +433,37 @@ class VerifyEngine:
                     break
                 self._kick.clear()
             while self._queue:
-                batch: list[tuple[object, asyncio.Future]] = []
+                batch: list[
+                    tuple[object, asyncio.Future, Optional[tuple]]
+                ] = []
                 total = 0
                 while self._queue and total < target:
-                    payload, fut = self._queue.popleft()
-                    batch.append((payload, fut))
+                    payload, fut, act = self._queue.popleft()
+                    batch.append((payload, fut, act))
                     total += len(payload)
-                payloads = [p for p, _ in batch]
+                payloads = [p for p, _, _ in batch]
+                # a coalesced batch can span several traces; the dispatch
+                # phases are recorded into the first traced submitter's
+                # tree (exact for the one-block-per-batch common case)
+                act0 = next((a for _, _, a in batch if a is not None), None)
                 metrics.inc("verify.batches")
                 metrics.inc("verify.items", total)
                 metrics.set_gauge("verify.batch_occupancy", total / target)
+                self._dispatch_started = time.monotonic()
                 try:
                     results = await asyncio.to_thread(
-                        self._dispatch_multi, payloads, target
+                        self._dispatch_traced, payloads, target, act0
                     )
                 except Exception as e:  # engine errors fail the waiters
                     log.error("[Engine] batch of %d failed: %s", total, e)
-                    for _, fut in batch:
+                    for _, fut, _ in batch:
                         if not fut.done():
                             fut.set_exception(e)
                     continue
+                finally:
+                    self._dispatch_started = None
                 pos = 0
-                for payload, fut in batch:
+                for payload, fut, _ in batch:
                     if not fut.done():
                         fut.set_result(results[pos : pos + len(payload)])
                     pos += len(payload)
@@ -439,6 +471,16 @@ class VerifyEngine:
     def _dispatch(self, payload) -> list[bool]:
         """Pick an execution engine and run one payload (worker thread)."""
         return self._dispatch_multi([payload])
+
+    def _dispatch_traced(
+        self, payloads: list, target: Optional[int], act: Optional[tuple]
+    ) -> list[bool]:
+        """Worker-thread entry: re-activate the submitting item's trace
+        (contextvars do not cross ``to_thread`` from the queue loop — the
+        loop's own context has no trace) so the dispatch/prepare/transfer/
+        kernel/readback spans land in the item's pipeline tree."""
+        with _activate_trace(act):
+            return self._dispatch_multi(payloads, target)
 
     def _pick(self, n: int) -> str:
         """Resolve the backend for one batch.  Never blocks except for the
