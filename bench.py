@@ -93,6 +93,18 @@ def _progress(msg: str) -> None:
     print(f"[bench-worker] {msg}", file=sys.stderr, flush=True)
 
 
+def _sanitizer_counts(event_counts: dict, metrics) -> dict:
+    """asyncsan/watchdog regression signals for the BENCH JSON (ISSUE 3
+    satellite): leaked supervised tasks and watchdog stall episodes seen
+    by this process.  A nonzero trajectory across rounds flags a
+    concurrency regression the throughput number alone would hide."""
+    return {
+        "task_leak": int(event_counts.get("asyncsan.task_leak", 0)),
+        "watchdog_stall": int(event_counts.get("watchdog.stall", 0)),
+        "task_leaks_metric": metrics.get("asyncsan.task_leaks"),
+    }
+
+
 def _worker_probe() -> None:
     """Tiny backend probe: init + platform + one trivial op.  Prints one
     JSON line; may block forever on a dead tunnel (parent watchdog)."""
@@ -245,6 +257,7 @@ def _worker_bench() -> None:
             )
             return
 
+        from tpunode.events import events as _events
         from tpunode.metrics import metrics
         from tpunode.trace import profile_to, span
         from tpunode.tracectx import start_trace, tracer
@@ -282,6 +295,9 @@ def _worker_bench() -> None:
                     "init_s": round(init_s, 1),
                     "telemetry": metrics.telemetry(),
                     "slowest_traces": tracer.slowest(3),
+                    "sanitizers": _sanitizer_counts(
+                        _events.counts(), metrics
+                    ),
                 }
             )
         )
@@ -625,6 +641,17 @@ def _main_locked() -> None:
 
         st = _tracer.slowest(3)
     out["slowest_traces"] = st
+    # asyncsan sanitizer counts (task leaks, watchdog stalls): from the
+    # worker when it ran, else this process's registries — always present
+    # so the round-over-round trajectory catches concurrency regressions.
+    san = res.get("sanitizers")
+    if not isinstance(san, dict):
+        from tpunode.events import events as _events2
+        from tpunode.metrics import metrics as _metrics2
+
+        san = _sanitizer_counts(_events2.counts(), _metrics2)
+        san["source"] = "driver-local"
+    out["sanitizers"] = san
     print(json.dumps(out))
     if res.get("fatal"):
         sys.exit(1)  # kernel correctness failure must not look like success

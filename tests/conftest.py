@@ -60,6 +60,19 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(func(**kwargs))
+        from tpunode import asyncsan
+
+        if asyncsan.enabled():
+            # TPUNODE_ASYNCSAN=1: every coroutine test runs under asyncio
+            # debug mode with the tight slow-callback threshold, so a
+            # blocking call inside the suite logs itself with its source
+            # location (ANALYSIS.md, runtime sanitizers)
+            async def _sanitized():
+                asyncsan.install()
+                await func(**kwargs)
+
+            asyncio.run(_sanitized())
+        else:
+            asyncio.run(func(**kwargs))
         return True
     return None

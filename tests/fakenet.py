@@ -160,6 +160,20 @@ def dummy_peer_connect(
     return factory
 
 
+async def poll_until(predicate, timeout: float = 10.0, what: str = "condition"):
+    """Await a predicate with a deadline (shared fakenet test helper —
+    used by the telemetry and asyncsan integration suites)."""
+
+    async def loop():
+        while not predicate():
+            await asyncio.sleep(0.01)
+
+    try:
+        await asyncio.wait_for(loop(), timeout=timeout)
+    except asyncio.TimeoutError:
+        raise AssertionError(f"timed out waiting for {what}")
+
+
 def silent_peer_connect():
     """A transport whose remote never says anything (for timeout tests)."""
 

@@ -1,0 +1,328 @@
+"""asyncsan static-analysis tests (ISSUE 3 tentpole).
+
+Two contracts pinned here:
+
+1. **The tree is clean**: the full analyzer over ``tpunode/`` + ``bench.py``
+   reports ZERO findings — every rule shipped either holds across the
+   codebase or carries an explicit suppression at its deliberate call
+   site.  This is the lint gate: a new blocking call, dropped task
+   handle, raw spawn or schema-violating name fails tier-1.
+2. **Every rule fires**: a deliberately-seeded fixture per rule produces
+   exactly one finding of exactly that rule, and the same fixture with a
+   ``# asyncsan: disable=<rule>`` pragma on the flagged line lints clean.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpunode.analysis import RULES, Analyzer, analyze_source
+from tpunode.analysis.__main__ import default_paths, main as cli_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- the zero-findings gate --------------------------------------------------
+
+
+def test_tree_is_clean():
+    """ISSUE 3 acceptance: the analyzer over the real tree finds nothing."""
+    findings = Analyzer().check_paths(
+        [os.path.join(REPO, "tpunode"), os.path.join(REPO, "bench.py")]
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_default_paths_cover_package_and_bench():
+    paths = default_paths()
+    assert paths[0].endswith("tpunode")
+    assert paths[1].endswith("bench.py")
+
+
+# --- per-rule fixtures -------------------------------------------------------
+
+# rule id -> source producing EXACTLY one finding of EXACTLY that rule.
+FIXTURES = {
+    "blocking-call": """\
+import asyncio
+import time
+
+async def main():
+    time.sleep(1)
+""",
+    "dropped-task": """\
+import asyncio
+from tpunode.actors import spawn_supervised
+
+async def main(work):
+    spawn_supervised(work())
+""",
+    "raw-spawn": """\
+import asyncio
+
+async def main(work):
+    t = asyncio.create_task(work())
+    await t
+""",
+    "lock-across-await": """\
+import asyncio
+import threading
+
+_lock = threading.Lock()
+
+async def main():
+    with _lock:
+        await asyncio.sleep(0)
+""",
+    "unawaited-coro": """\
+async def work():
+    return 1
+
+async def main():
+    work()
+""",
+    "cancel-swallow": """\
+import asyncio
+
+async def main(q):
+    try:
+        await q.get()
+    except asyncio.CancelledError:
+        pass
+""",
+    "thread-loop-affinity": """\
+import threading
+
+def pump(fut):
+    fut.set_result(True)
+
+def start(fut):
+    threading.Thread(target=pump, args=(fut,)).start()
+""",
+    "metric-name": """\
+from tpunode.metrics import metrics
+
+def record():
+    metrics.inc("badName")
+""",
+    "event-name": """\
+from tpunode.events import events
+
+def record():
+    events.emit("stats")
+""",
+}
+
+
+def test_every_shipped_rule_has_a_fixture():
+    assert set(FIXTURES) == set(RULES), (
+        "rule set and fixture set diverged; add a fixture (and a fix or "
+        "suppression policy) for every new rule"
+    )
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_fires_exactly_once(rule_id):
+    findings = analyze_source(FIXTURES[rule_id], path=f"<{rule_id}>")
+    assert [f.rule for f in findings] == [rule_id], findings
+    f = findings[0]
+    assert f.line >= 1 and f.message
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_rule_suppressed_on_flagged_line(rule_id):
+    """The per-line pragma silences exactly the finding on its line."""
+    src = FIXTURES[rule_id]
+    line = analyze_source(src)[0].line
+    lines = src.splitlines()
+    lines[line - 1] += f"  # asyncsan: disable={rule_id}"
+    assert analyze_source("\n".join(lines)) == []
+
+
+def test_suppress_all_pragma():
+    src = FIXTURES["blocking-call"]
+    line = analyze_source(src)[0].line
+    lines = src.splitlines()
+    lines[line - 1] += "  # asyncsan: disable=all"
+    assert analyze_source("\n".join(lines)) == []
+
+
+def test_suppression_is_rule_specific():
+    """A pragma for a DIFFERENT rule does not silence the finding."""
+    src = FIXTURES["blocking-call"]
+    line = analyze_source(src)[0].line
+    lines = src.splitlines()
+    lines[line - 1] += "  # asyncsan: disable=raw-spawn"
+    assert [f.rule for f in analyze_source("\n".join(lines))] == [
+        "blocking-call"
+    ]
+
+
+# --- rule-specific edges -----------------------------------------------------
+
+
+def test_blocking_call_resolves_import_aliases():
+    src = "from time import sleep as snooze\nasync def f():\n    snooze(1)\n"
+    assert [f.rule for f in analyze_source(src)] == ["blocking-call"]
+
+
+def test_blocking_call_ignores_sync_and_threaded_scopes():
+    src = """\
+import asyncio
+import time
+
+def sync_path():
+    time.sleep(1)
+
+async def ok():
+    await asyncio.to_thread(time.sleep, 1)
+    f = lambda: time.sleep(1)
+    return f
+"""
+    assert analyze_source(src) == []
+
+
+def test_blocking_call_awaited_wait_is_fine():
+    src = """\
+import asyncio
+
+async def f(ev, kick, remain):
+    await ev.wait()
+    await asyncio.wait_for(kick.wait(), timeout=remain)
+    await asyncio.wait_for(asyncio.shield(ev.wait()), 5)
+"""
+    assert analyze_source(src) == []
+
+
+def test_blocking_call_non_asyncio_wrapper_does_not_launder():
+    """asyncio combinators pass awaitedness through to their arguments;
+    an arbitrary wrapper does not — a blocker nested inside one still
+    flags."""
+    src = """\
+async def f(g, h, p):
+    await g(h(open(p)))
+"""
+    assert [f.rule for f in analyze_source(src)] == ["blocking-call"]
+
+
+def test_unawaited_coro_deep_receiver_not_flagged():
+    # `self._writer.write(...)`: an unrelated object sharing a method
+    # name with a local async def must not be flagged
+    src = """\
+class C:
+    async def write(self, data):
+        pass
+
+    def push(self, data):
+        self._writer.write(data)
+"""
+    assert analyze_source(src) == []
+
+
+def test_cancel_swallow_reraise_is_fine():
+    src = """\
+import asyncio
+
+async def f(q):
+    try:
+        await q.get()
+    except asyncio.CancelledError:
+        raise
+"""
+    assert analyze_source(src) == []
+
+
+def test_metric_name_covers_qualified_span_form():
+    """`trace.span("...")` (module-qualified) is linted like bare
+    `span("...")` — parity with the old regex lint's substring match."""
+    src = """\
+from tpunode import trace
+
+def f():
+    with trace.span("BadName"):
+        pass
+"""
+    assert [f.rule for f in analyze_source(src)] == ["metric-name"]
+
+
+def test_metric_name_covers_inc_batch_tuples():
+    """The old regex lint in test_metrics never saw inc_batch literals."""
+    src = """\
+from tpunode.metrics import metrics
+
+def f():
+    metrics.inc_batch((("BadName", 1.0, None),))
+"""
+    assert [f.rule for f in analyze_source(src)] == ["metric-name"]
+
+
+def test_event_name_has_no_grandfather():
+    """ISSUE 3 satellite: the bare "stats" type (formerly grandfathered
+    by test_metrics) now violates the schema; its replacement passes."""
+    bad = "def f(log):\n    log.emit('stats')\n"
+    good = "def f(log):\n    log.emit('node.stats')\n"
+    assert [f.rule for f in analyze_source(bad)] == ["event-name"]
+    assert analyze_source(good) == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    out = analyze_source("def broken(:\n")
+    assert [f.rule for f in out] == ["syntax-error"]
+
+
+def test_rule_subset_selection():
+    src = FIXTURES["blocking-call"] + FIXTURES["unawaited-coro"]
+    only = Analyzer(select=["unawaited-coro"]).check_source(src)
+    assert {f.rule for f in only} == {"unawaited-coro"}
+    with pytest.raises(ValueError):
+        Analyzer(select=["no-such-rule"])
+
+
+def test_registry_catalog_complete():
+    for r in RULES.values():
+        assert r.id and r.summary and callable(r.check)
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def test_cli_inprocess_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(FIXTURES["blocking-call"], encoding="utf-8")
+    assert cli_main([str(bad)]) == 1
+    text = capsys.readouterr().out
+    assert "blocking-call" in text and "bad.py" in text
+
+    assert cli_main(["--json", str(bad)]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["findings"][0]["rule"] == "blocking-call"
+    assert data["findings"][0]["line"] == 5
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n", encoding="utf-8")
+    assert cli_main([str(good)]) == 0
+
+    assert cli_main(["--list-rules"]) == 0
+    assert "raw-spawn" in capsys.readouterr().out
+    assert cli_main(["--rules", "bogus", str(good)]) == 2
+    assert cli_main([str(tmp_path / "missing.py")]) == 2
+
+
+def test_cli_subprocess_tree_is_clean():
+    """ISSUE 3 acceptance, verbatim: ``python -m tpunode.analysis
+    tpunode/`` exits 0 with zero findings on the final tree."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tpunode.analysis", "--json", "tpunode"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["findings"] == []

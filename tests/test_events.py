@@ -182,7 +182,7 @@ def test_stats_reporter_windowed_rates(monkeypatch):
     t[0] += 10.0
     ev = rep.tick()
     assert ev["rates"]["chain.headers"] == pytest.approx(0.0)
-    assert log.counts()["stats"] == 3
+    assert log.counts()["node.stats"] == 3
 
 
 def test_stats_reporter_labeled_aggregates(monkeypatch):
@@ -226,11 +226,11 @@ async def test_stats_reporter_run_loop():
     task = asyncio.get_running_loop().create_task(rep.run())
 
     async def wait_two():
-        while log.counts().get("stats", 0) < 2:
+        while log.counts().get("node.stats", 0) < 2:
             await asyncio.sleep(0.01)
 
     try:
         await asyncio.wait_for(wait_two(), timeout=5)
     finally:
         task.cancel()
-    assert log.counts()["stats"] >= 2
+    assert log.counts()["node.stats"] >= 2

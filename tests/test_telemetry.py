@@ -9,7 +9,7 @@ import contextlib
 
 import pytest
 
-from tests.fakenet import dummy_peer_connect
+from tests.fakenet import dummy_peer_connect, poll_until as _poll
 from tests.fixtures import all_blocks
 from tpunode import (
     BCH_REGTEST,
@@ -54,17 +54,6 @@ async def telemetry_node(timeout: float = 0.4, stats_interval: float = 0.05):
             yield node, evs
 
 
-async def _poll(predicate, timeout: float = 10.0, what: str = "condition"):
-    async def loop():
-        while not predicate():
-            await asyncio.sleep(0.01)
-
-    try:
-        await asyncio.wait_for(loop(), timeout=timeout)
-    except asyncio.TimeoutError:
-        raise AssertionError(f"timed out waiting for {what}")
-
-
 @pytest.mark.asyncio
 async def test_session_emits_events_rtt_and_stats():
     """One fakenet session produces ≥3 distinct structured event types,
@@ -101,7 +90,8 @@ async def test_session_emits_events_rtt_and_stats():
         )
         # the StatsReporter emitted at least one stats event
         await _poll(
-            lambda: events.counts().get("stats", 0) >= 1, what="stats event"
+            lambda: events.counts().get("node.stats", 0) >= 1,
+            what="node.stats event"
         )
 
         # snapshot API: chain height, per-peer RTT quantiles, verify error
@@ -146,7 +136,7 @@ async def test_session_emits_events_rtt_and_stats():
     distinct = [t for t, n in counts.items() if n > 0]
     assert len(distinct) >= 3, f"want >=3 distinct event types, got {counts}"
     for expected in ("peer.handshake", "peer.connect", "chain.headers",
-                     "stats", "peer.disconnect"):
+                     "node.stats", "peer.disconnect"):
         assert counts.get(expected, 0) >= 1, (expected, counts)
 
 
@@ -244,10 +234,10 @@ async def test_stats_event_includes_node_context():
     async with telemetry_node(stats_interval=0.05) as (node, evs):
         await _poll(
             lambda: any(
-                "height" in e for e in events.tail(50, type="stats")
+                "height" in e for e in events.tail(50, type="node.stats")
             ),
             what="stats event with node context",
         )
-        ev = events.tail(50, type="stats")[-1]
+        ev = events.tail(50, type="node.stats")[-1]
         assert "peers" in ev and "peers_online" in ev
         assert "rates" in ev and "counters" in ev

@@ -19,6 +19,12 @@ the asyncio-native equivalent:
 * :class:`LinkedTasks` — the ``withAsync``+``link`` pattern: background loops
   whose failure must take the whole enclosing scope down
   (reference: Node.hs:191-192, Chain.hs:296, PeerMgr.hs:234).
+* :class:`TaskRegistry` / :func:`spawn_supervised` — the asyncsan
+  task-supervision registry: EVERY task tpunode spawns goes through here
+  (the ``raw-spawn`` lint in tpunode/analysis enforces it), so an
+  orphaned task — pending, with no live open owner — is reported at node
+  shutdown as an ``asyncsan.task_leak`` event with its spawn site,
+  instead of being garbage-collected mid-flight in silence.
 
 Everything runs on one event loop; like the reference's STM-guarded actors,
 state transitions are race-free because they never yield mid-update.
@@ -28,10 +34,14 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import os
+import sys
 import time
+import weakref
 from collections import deque
 
 from .compat import timeout as _timeout
+from .events import events
 from .metrics import metrics
 from .tracectx import _ACTIVE as _active_trace
 from typing import (
@@ -48,11 +58,129 @@ __all__ = [
     "Publisher",
     "Supervisor",
     "LinkedTasks",
+    "TaskRegistry",
+    "task_registry",
+    "spawn_supervised",
     "receive_match",
 ]
 
 T = TypeVar("T")
 U = TypeVar("U")
+
+
+class _TaskRecord:
+    """Registry bookkeeping for one spawned task: display name, spawn
+    site (file:line outside actors.py), and a weakref to the owning
+    supervisor-ish object (None = caller promised to await/cancel)."""
+
+    __slots__ = ("name", "where", "owner")
+
+    def __init__(self, name: str, where: str, owner: Optional[object]):
+        self.name = name
+        self.where = where
+        self.owner = weakref.ref(owner) if owner is not None else None
+
+
+class TaskRegistry:
+    """Process-wide supervision registry (asyncsan runtime sanitizer).
+
+    Every task spawned through :func:`spawn_supervised` is tracked until
+    it completes.  :meth:`report_leaks` — called at node shutdown —
+    emits one ``asyncsan.task_leak`` event (+ ``asyncsan.task_leaks``
+    metric) per task that is still pending with no live, open owner:
+    exactly the fire-and-forget orphans whose dropped handle the static
+    ``dropped-task`` rule catches at lint time when the spawn is literal,
+    and only this registry can catch when it is not.
+
+    An *owner* scopes the leak check: a task whose owner is alive and not
+    closing (``_closing`` false — the Supervisor/LinkedTasks convention)
+    is supervised, not leaked, even while another node in the same
+    process shuts down.  All mutation happens on the event-loop thread.
+    """
+
+    def __init__(self):
+        self._records: dict[asyncio.Task, _TaskRecord] = {}
+
+    def spawn(
+        self,
+        coro: Awaitable,
+        name: str = "",
+        owner: Optional[object] = None,
+    ) -> asyncio.Task:
+        task = asyncio.ensure_future(coro)  # asyncsan: disable=raw-spawn
+        if name:
+            task.set_name(name)
+        self._records[task] = _TaskRecord(
+            name or task.get_name(), _spawn_site(), owner
+        )
+        task.add_done_callback(self._task_done)
+        return task
+
+    def _task_done(self, task: asyncio.Task) -> None:
+        self._records.pop(task, None)
+
+    def live(self) -> "list[asyncio.Task]":
+        """Tracked tasks still pending (telemetry/debug view)."""
+        return [t for t in self._records if not t.done()]
+
+    def report_leaks(self, log_=None) -> "list[dict]":
+        """Emit one ``asyncsan.task_leak`` event per orphaned pending
+        task; returns the events.  Each leak is reported exactly once:
+        its record is dropped from the registry on report (the task
+        itself stays alive — cancelling it is the caller's call)."""
+        sink = log_ if log_ is not None else events
+        out: list[dict] = []
+        for task, rec in list(self._records.items()):
+            if task.done():
+                continue
+            if rec.owner is not None:
+                owner = rec.owner()
+                if owner is not None and not getattr(owner, "_closing", False):
+                    continue  # supervised by a live, open owner
+            del self._records[task]
+            task.remove_done_callback(self._task_done)
+            metrics.inc("asyncsan.task_leaks")
+            out.append(
+                sink.emit(
+                    "asyncsan.task_leak", task=rec.name, where=rec.where,
+                )
+            )
+        return out
+
+
+# This module's own filename, for skipping registry-internal frames in
+# _spawn_site (code objects compiled from this module carry exactly this
+# string, so no per-spawn abspath work is needed).
+_HERE = __file__
+
+
+def _spawn_site() -> str:
+    """file:line of the first caller frame outside this module — the
+    attribution that makes a task-leak report actionable."""
+    fr = sys._getframe(1)
+    while fr is not None and fr.f_code.co_filename == _HERE:
+        fr = fr.f_back
+    if fr is None:
+        return "?"
+    return f"{os.path.basename(fr.f_code.co_filename)}:{fr.f_lineno}"
+
+
+#: The process-wide registry (tests may construct private ones).
+task_registry = TaskRegistry()
+
+
+def spawn_supervised(
+    coro: Awaitable, name: str = "", owner: Optional[object] = None
+) -> asyncio.Task:
+    """Spawn a task through the supervision registry — the only sanctioned
+    way to create a task inside tpunode (lint rule ``raw-spawn``).
+
+    ``owner`` is the supervising object (Supervisor, LinkedTasks, engine,
+    peer handle...) responsible for cancelling/awaiting the task; pass
+    None only when the spawning code itself awaits the handle before its
+    scope exits.  Pending tasks with no live open owner are reported as
+    ``asyncsan.task_leak`` at node shutdown."""
+    return task_registry.spawn(coro, name=name, owner=owner)
 
 
 class _Traced:
@@ -236,9 +364,7 @@ class Supervisor:
         self.name = name
 
     def add_child(self, coro: Awaitable, name: str = "") -> asyncio.Task:
-        task = asyncio.ensure_future(coro)
-        if name:
-            task.set_name(name)
+        task = spawn_supervised(coro, name=name, owner=self)
         self._children.add(task)
         task.add_done_callback(self._child_done)
         return task
@@ -298,9 +424,7 @@ class LinkedTasks:
         self.on_failure = on_failure
 
     def link(self, coro: Awaitable, name: str = "") -> asyncio.Task:
-        task = asyncio.ensure_future(coro)
-        if name:
-            task.set_name(name)
+        task = spawn_supervised(coro, name=name, owner=self)
         self._tasks.add(task)
         task.add_done_callback(self._task_done)
         return task

@@ -1,0 +1,55 @@
+"""asyncsan — AST concurrency lint for the actor/TPU pipeline.
+
+The reference node inherits its concurrency discipline from nqe actor
+mailboxes + STM; this port re-creates it with asyncio tasks, threads and a
+device-dispatch worker — a combination where one blocking call or orphaned
+task silently stalls block relay (the hang class PR 2's watchdog can only
+observe after the fact).  This package prevents those defects at lint
+time:
+
+* :mod:`tpunode.analysis.core` — the engine: a rule registry, per-file AST
+  contexts with import/name resolution, per-line suppression
+  (``# asyncsan: disable=RULE``), and an :class:`Analyzer` front-end.
+* :mod:`tpunode.analysis.rules` — the rule set, targeting this codebase's
+  real hazard classes (blocking calls inside ``async def``, dropped task
+  handles, raw spawns bypassing the supervision registry, locks held
+  across ``await``, unawaited coroutines, ``CancelledError`` swallowing,
+  cross-thread mutation of loop-owned state, metric/event name schema).
+* ``python -m tpunode.analysis [--json] [paths]`` — the CLI
+  (:mod:`tpunode.analysis.__main__`); exit code 1 iff findings.
+
+The paired *runtime* sanitizers (``TPUNODE_ASYNCSAN`` debug mode, the
+task-supervision registry, the blocked-loop attributor) live in
+:mod:`tpunode.asyncsan` — see ANALYSIS.md for the full catalog.
+
+Tier-1 tests (tests/test_analysis.py) run the analyzer over the whole
+``tpunode`` tree and pin ZERO findings, so every rule added here must
+either hold across the codebase or carry an explicit suppression at the
+deliberate call site.
+"""
+
+from __future__ import annotations
+
+from .core import Analyzer, FileContext, Finding, Rule, RULES, rule
+from . import rules as _rules  # noqa: F401  (registers the rule set)
+
+__all__ = [
+    "Analyzer",
+    "FileContext",
+    "Finding",
+    "Rule",
+    "RULES",
+    "rule",
+    "analyze_paths",
+    "analyze_source",
+]
+
+
+def analyze_source(source: str, path: str = "<memory>") -> "list[Finding]":
+    """One-shot convenience: lint a source string with every rule."""
+    return Analyzer().check_source(source, path)
+
+
+def analyze_paths(paths) -> "list[Finding]":
+    """One-shot convenience: lint files/directories with every rule."""
+    return Analyzer().check_paths(paths)

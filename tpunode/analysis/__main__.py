@@ -1,0 +1,97 @@
+"""asyncsan CLI: ``python -m tpunode.analysis [--json] [paths...]``.
+
+With no paths, lints the ``tpunode`` package plus the repo-root
+``bench.py`` (the same closure the tier-1 test pins at zero findings).
+Exit status: 0 = clean, 1 = findings, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import Analyzer, RULES
+
+
+def default_paths() -> list[str]:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [pkg]
+    bench = os.path.join(os.path.dirname(pkg), "bench.py")
+    if os.path.isfile(bench):
+        paths.append(bench)
+    return paths
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpunode.analysis",
+        description="asyncsan: AST concurrency lint for the actor/TPU "
+        "pipeline (rule catalog in ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the tpunode package "
+        "and bench.py)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable output (one JSON object)",
+    )
+    parser.add_argument(
+        "--rules", metavar="ID[,ID...]",
+        help="comma-separated rule subset (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.id}: {r.summary}")
+        return 0
+
+    try:
+        select = (
+            [s.strip() for s in args.rules.split(",") if s.strip()]
+            if args.rules
+            else None
+        )
+        analyzer = Analyzer(select=select)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    paths = args.paths or default_paths()
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+    findings = analyzer.check_paths(paths)
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "paths": paths,
+                    "rules": [r.id for r in analyzer.rules],
+                    "findings": [f.to_dict() for f in findings],
+                }
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. `... | head`: not an analyzer failure
+        sys.exit(0)

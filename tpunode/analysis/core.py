@@ -1,0 +1,282 @@
+"""asyncsan engine: findings, rule registry, file contexts, suppression.
+
+Rules are plain functions registered with the :func:`rule` decorator; each
+receives one :class:`FileContext` per analyzed file and reports through
+:meth:`FileContext.report`, which applies per-line suppression
+(``# asyncsan: disable=RULE[,RULE2]`` or ``disable=all`` on the finding's
+first line) before a :class:`Finding` is recorded.  The context carries
+the shared per-file indexes every rule needs — an import-alias resolver
+(``resolve`` maps ``t.sleep`` back to ``time.sleep`` under
+``import time as t``), the set of locally-defined ``async def`` names,
+and a scope-aware walker that yields calls made while inside an
+``async def`` body (nested *sync* defs and lambdas are excluded: code in
+them does not run on the awaiting scope's event-loop turn).
+
+Everything here is stdlib-only (ast/tokenize): the analyzer must run in
+CI boxes and pre-commit hooks without jax or the node's runtime deps.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator, Optional
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULES",
+    "rule",
+    "FileContext",
+    "Analyzer",
+]
+
+# One suppression pragma per line: ``# asyncsan: disable=rule-a,rule-b``
+# (or ``all``).  The pragma applies to findings whose *first* line is the
+# pragma's line — for a multi-line statement, put it on the opening line.
+_PRAGMA_RE = re.compile(r"#\s*asyncsan:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+# ``<layer>.<name>`` schema shared by metric, span and event-type
+# literals (OBSERVABILITY.md); formerly enforced by two ad-hoc regex
+# lints in tests/test_metrics.py, now by the metric-name/event-name rules.
+NAME_SCHEMA_RE = re.compile(r"^[a-z]+(\.[a-z_]+)+$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+@dataclass(frozen=True)
+class Rule:
+    """A registered rule: id (the suppression token), summary, checker."""
+
+    id: str
+    summary: str
+    check: Callable[["FileContext"], None]
+
+
+# Registry: rule id -> Rule.  Populated by the @rule decorator at import
+# of tpunode.analysis.rules; tests may register extra rules (ids must be
+# unique — re-registration is a programming error, not a merge).
+RULES: dict[str, Rule] = {}
+
+_RULE_ID_RE = re.compile(r"^[a-z][a-z0-9\-]*$")
+
+
+def rule(id: str, summary: str) -> Callable:
+    """Decorator registering a rule function in :data:`RULES`."""
+    if not _RULE_ID_RE.match(id):
+        raise ValueError(f"rule id must be kebab-case, got {id!r}")
+
+    def deco(fn: Callable[["FileContext"], None]) -> Callable:
+        if id in RULES:
+            raise ValueError(f"duplicate rule id {id!r}")
+        RULES[id] = Rule(id=id, summary=summary, check=fn)
+        return fn
+
+    return deco
+
+
+def _suppressions(lines: list[str]) -> dict[int, set[str]]:
+    """Map 1-based line number -> set of suppressed rule ids ('all' ok)."""
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(line)
+        if m is None:
+            continue
+        ids = {t.strip() for t in m.group(1).split(",") if t.strip()}
+        if ids:
+            out[i] = ids
+    return out
+
+
+class FileContext:
+    """Everything a rule needs to analyze one file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.findings: list[Finding] = []
+        self._suppress = _suppressions(self.lines)
+        self._aliases: Optional[dict[str, str]] = None
+        self._async_defs: Optional[set[str]] = None
+
+    # -- reporting -----------------------------------------------------------
+
+    def report(self, rule_id: str, node: ast.AST, message: str) -> None:
+        """Record a finding unless the line carries a suppression pragma."""
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        sup = self._suppress.get(line)
+        if sup is not None and ("all" in sup or rule_id in sup):
+            return
+        self.findings.append(
+            Finding(rule=rule_id, path=self.path, line=line, col=col,
+                    message=message)
+        )
+
+    # -- shared indexes ------------------------------------------------------
+
+    @property
+    def aliases(self) -> dict[str, str]:
+        """Local name -> imported qualified name (``t`` -> ``time``,
+        ``snooze`` -> ``time.sleep``, ``urlopen`` ->
+        ``urllib.request.urlopen``)."""
+        if self._aliases is None:
+            amap: dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        amap[a.asname or a.name.split(".")[0]] = (
+                            a.name if a.asname else a.name.split(".")[0]
+                        )
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for a in node.names:
+                        amap[a.asname or a.name] = f"{node.module}.{a.name}"
+            self._aliases = amap
+        return self._aliases
+
+    @property
+    def async_defs(self) -> set[str]:
+        """Names of every ``async def`` in this file (incl. methods)."""
+        if self._async_defs is None:
+            self._async_defs = {
+                n.name
+                for n in ast.walk(self.tree)
+                if isinstance(n, ast.AsyncFunctionDef)
+            }
+        return self._async_defs
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with import aliases
+        unfolded, or None for dynamic expressions (calls, subscripts)."""
+        parts: list[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        head = self.aliases.get(cur.id, cur.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+    def async_scope_calls(self) -> Iterator[tuple[ast.Call, bool]]:
+        """Yield ``(call, awaited)`` for every call made while running on
+        an ``async def``'s event-loop turn (nested sync defs/lambdas are
+        other scopes and are skipped; nested async defs recurse)."""
+
+        def awaited(call: ast.Call) -> Iterator[tuple[ast.Call, bool]]:
+            # The awaited call itself, plus its direct Call arguments —
+            # (almost always) coroutine construction the wrapper consumes,
+            # ``await wait_for(e.wait())`` — count as awaited.  asyncio
+            # combinators pass awaitedness one level further, so
+            # ``await wait_for(shield(e.wait()), 5)`` is clean too; a
+            # non-asyncio wrapper does NOT (``await f(g(open(p)))`` keeps
+            # flagging the nested blocker).
+            yield call, True
+            for sub in ast.iter_child_nodes(call):
+                if isinstance(sub, ast.Call):
+                    qual = self.resolve(sub.func) or ""
+                    if qual.startswith("asyncio."):
+                        yield from awaited(sub)
+                    else:
+                        yield sub, True
+                        yield from walk(sub, True)
+                else:
+                    yield from walk(sub, True)
+
+        def walk(node: ast.AST, in_async: bool) -> Iterator[tuple[ast.Call, bool]]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.AsyncFunctionDef):
+                    yield from walk(child, True)
+                elif isinstance(child, (ast.FunctionDef, ast.Lambda)):
+                    yield from walk(child, False)
+                elif isinstance(child, ast.Await):
+                    if in_async and isinstance(child.value, ast.Call):
+                        yield from awaited(child.value)
+                    else:
+                        yield from walk(child, in_async)
+                else:
+                    if in_async and isinstance(child, ast.Call):
+                        yield child, False
+                    yield from walk(child, in_async)
+
+        yield from walk(self.tree, False)
+
+
+class Analyzer:
+    """Front-end: run (a selection of) the registered rules over sources,
+    files or directory trees."""
+
+    def __init__(self, select: Optional[Iterable[str]] = None):
+        ids = list(RULES) if select is None else list(select)
+        unknown = [i for i in ids if i not in RULES]
+        if unknown:
+            raise ValueError(f"unknown rule ids: {unknown}")
+        self.rules = [RULES[i] for i in ids]
+
+    # -- entry points --------------------------------------------------------
+
+    def check_source(self, source: str, path: str = "<memory>") -> list[Finding]:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            return [
+                Finding(
+                    rule="syntax-error", path=path, line=e.lineno or 1,
+                    col=e.offset or 0, message=f"could not parse: {e.msg}",
+                )
+            ]
+        ctx = FileContext(path, source, tree)
+        for r in self.rules:
+            r.check(ctx)
+        ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return ctx.findings
+
+    def check_file(self, path: str) -> list[Finding]:
+        with open(path, encoding="utf-8") as f:
+            return self.check_source(f.read(), path)
+
+    def check_paths(self, paths: Iterable[str]) -> list[Finding]:
+        """Lint every ``.py`` under the given files/directories (sorted
+        walk: deterministic output ordering for CI diffs)."""
+        findings: list[Finding] = []
+        for path in paths:
+            if os.path.isdir(path):
+                for root, dirs, files in os.walk(path):
+                    dirs[:] = sorted(
+                        d for d in dirs
+                        if d != "__pycache__" and not d.startswith(".")
+                    )
+                    for f in sorted(files):
+                        if f.endswith(".py"):
+                            findings.extend(
+                                self.check_file(os.path.join(root, f))
+                            )
+            else:
+                findings.extend(self.check_file(path))
+        return findings

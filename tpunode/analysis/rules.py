@@ -1,0 +1,430 @@
+"""asyncsan rule set: the hazard classes this codebase has actually hit.
+
+Every rule id doubles as its suppression token
+(``# asyncsan: disable=<id>``); ANALYSIS.md is the user-facing catalog.
+The selection is deliberately grounded in this node's architecture —
+actor mailboxes drained by linked loops on ONE event loop, a verify
+engine whose dispatch runs in a worker thread, and a telemetry layer with
+a pinned ``<layer>.<name>`` naming schema.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, NAME_SCHEMA_RE, rule
+
+# --- blocking-call -----------------------------------------------------------
+
+# Qualified call names that block the calling thread.  Inside an
+# ``async def`` these freeze the event loop: every mailbox, timer, peer
+# session and watchdog shares that one thread (actors.py's substrate).
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "os.system",
+    "os.popen",
+    "os.wait",
+    "os.waitpid",
+    "subprocess.run",
+    "subprocess.call",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.Popen",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "socket.gethostbyname",
+    "socket.gethostbyaddr",
+    "urllib.request.urlopen",
+    "requests.get",
+    "requests.post",
+    "requests.put",
+    "requests.head",
+    "requests.delete",
+    "requests.request",
+    "open",
+    "input",
+}
+
+# Methods that block regardless of receiver when NOT awaited:
+# ``fut.result()`` (concurrent.futures) and jax's ``block_until_ready()``
+# synchronize on work that may never finish while the loop is frozen.
+_BLOCKING_METHODS = {"result", "block_until_ready"}
+
+# Methods that block only in their no-positional-arg form — distinguishes
+# ``thread.join()`` / ``event.wait()`` / ``lock.acquire()`` from
+# ``sep.join(parts)`` (always one positional arg).  A NON-awaited bare
+# ``.wait()``/``.acquire()`` inside ``async def`` is either a threading
+# primitive (blocks the loop) or a missed ``await`` on the asyncio one —
+# a hazard either way.
+_BLOCKING_METHODS_NOARG = {"join", "wait", "acquire"}
+
+
+@rule(
+    "blocking-call",
+    "blocking call inside `async def` freezes the event loop "
+    "(wrap in asyncio.to_thread, or use the async equivalent)",
+)
+def _blocking_call(ctx: FileContext) -> None:
+    for call, awaited in ctx.async_scope_calls():
+        if awaited:
+            continue
+        qual = ctx.resolve(call.func)
+        if qual in _BLOCKING_CALLS:
+            ctx.report(
+                "blocking-call", call,
+                f"blocking call {qual}() inside async def",
+            )
+            continue
+        if isinstance(call.func, ast.Attribute):
+            attr = call.func.attr
+            if attr in _BLOCKING_METHODS or (
+                attr in _BLOCKING_METHODS_NOARG and not call.args
+            ):
+                ctx.report(
+                    "blocking-call", call,
+                    f"potentially blocking .{attr}() inside async def "
+                    "(not awaited)",
+                )
+
+
+# --- dropped-task ------------------------------------------------------------
+
+_SPAWN_QUALS = {"asyncio.create_task", "asyncio.ensure_future"}
+_SPAWN_ATTRS = {"create_task", "ensure_future"}
+_SPAWN_NAMES = {"spawn_supervised"}
+
+
+def _is_spawn(ctx: FileContext, call: ast.Call) -> bool:
+    qual = ctx.resolve(call.func)
+    if qual in _SPAWN_QUALS:
+        return True
+    if qual is not None and qual.split(".")[-1] in _SPAWN_NAMES:
+        return True
+    return (
+        isinstance(call.func, ast.Attribute) and call.func.attr in _SPAWN_ATTRS
+    )
+
+
+@rule(
+    "dropped-task",
+    "task handle discarded at spawn: the task can be garbage-collected "
+    "mid-flight and its exception is never observed (keep the handle, or "
+    "hand it to a supervisor)",
+)
+def _dropped_task(ctx: FileContext) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Expr):
+            continue
+        call = node.value
+        if isinstance(call, ast.Call) and _is_spawn(ctx, call):
+            name = ctx.resolve(call.func) or ast.unparse(call.func)
+            ctx.report(
+                "dropped-task", node,
+                f"fire-and-forget {name}(...): task handle dropped",
+            )
+
+
+# --- raw-spawn ---------------------------------------------------------------
+
+
+@rule(
+    "raw-spawn",
+    "direct create_task/ensure_future bypasses the supervision registry "
+    "(use actors.spawn_supervised so leaks are reported at shutdown)",
+)
+def _raw_spawn(ctx: FileContext) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        qual = ctx.resolve(node.func)
+        is_raw = qual in _SPAWN_QUALS or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SPAWN_ATTRS
+        )
+        if is_raw:
+            name = qual or f".{node.func.attr}"  # type: ignore[union-attr]
+            ctx.report(
+                "raw-spawn", node,
+                f"{name}(...) outside the supervision registry: route "
+                "through actors.spawn_supervised",
+            )
+
+
+# --- lock-across-await -------------------------------------------------------
+
+
+def _mentions_lock(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "lock" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "lock" in sub.attr.lower():
+            return True
+    return False
+
+
+@rule(
+    "lock-across-await",
+    "synchronous lock held across `await`: other tasks (and the metrics/"
+    "event emitters on worker threads) deadlock against the frozen holder",
+)
+def _lock_across_await(ctx: FileContext) -> None:
+    # Only sync ``with`` blocks: ``async with asyncio.Lock()`` awaits by
+    # design.  A threading/`_lock`-style guard whose body awaits keeps the
+    # lock held while OTHER code runs on this thread — the cross-thread
+    # emitters then block a worker thread against a loop that may be
+    # awaiting that very worker (the verify-engine dispatch boundary).
+    def walk(node: ast.AST, in_async: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.AsyncFunctionDef):
+                walk(child, True)
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.Lambda)):
+                walk(child, False)
+                continue
+            if (
+                in_async
+                and isinstance(child, ast.With)
+                and any(_mentions_lock(item.context_expr) for item in child.items)
+                and any(isinstance(n, ast.Await) for n in ast.walk(child))
+            ):
+                ctx.report(
+                    "lock-across-await", child,
+                    "sync lock held across await inside async def",
+                )
+            walk(child, in_async)
+
+    walk(ctx.tree, False)
+
+
+# --- unawaited-coro ----------------------------------------------------------
+
+
+@rule(
+    "unawaited-coro",
+    "call to a locally-defined `async def` whose coroutine is discarded: "
+    "the body never runs (RuntimeWarning at GC, silently dropped work)",
+)
+def _unawaited_coro(ctx: FileContext) -> None:
+    names = ctx.async_defs
+    if not names:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Expr):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        func = call.func
+        called = None
+        if isinstance(func, ast.Name) and func.id in names:
+            called = func.id
+        elif (
+            isinstance(func, ast.Attribute)
+            and func.attr in names
+            # only `self.<name>` receivers: a deeper chain (e.g.
+            # `self._writer.write`) usually reaches an unrelated object
+            # that merely shares a method name with a local async def
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            called = func.attr
+        if called is not None:
+            ctx.report(
+                "unawaited-coro", node,
+                f"coroutine {called}(...) is never awaited",
+            )
+
+
+# --- cancel-swallow ----------------------------------------------------------
+
+_CANCEL_NAMES = {
+    "asyncio.CancelledError",
+    "CancelledError",
+    "concurrent.futures.CancelledError",
+    "BaseException",
+}
+
+
+def _catches_cancelled(ctx: FileContext, handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        qual = ctx.resolve(node)
+        if qual in _CANCEL_NAMES or (
+            qual is not None and qual.split(".")[-1] == "CancelledError"
+        ):
+            return True
+    return False
+
+
+@rule(
+    "cancel-swallow",
+    "except clause swallows CancelledError: shutdown cancellation never "
+    "propagates and the task loops forever (re-raise it)",
+)
+def _cancel_swallow(ctx: FileContext) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _catches_cancelled(ctx, node):
+            continue
+        if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+            continue
+        what = "bare except" if node.type is None else (
+            ctx.resolve(node.type)
+            if not isinstance(node.type, ast.Tuple)
+            else "except (...)"
+        )
+        ctx.report(
+            "cancel-swallow", node,
+            f"{what} catches CancelledError without re-raising",
+        )
+
+
+# --- thread-loop-affinity ----------------------------------------------------
+
+# Loop-affine calls: mutating these from a non-loop thread corrupts
+# asyncio internals or races the consumer (asyncio.Queue.put_nowait and
+# Mailbox.send are NOT thread-safe).  The verify-engine dispatch-worker
+# boundary is exactly this seam: results cross back via the future the
+# *loop* resolves, never via direct mutation from the worker.
+_LOOP_AFFINE_ATTRS = {
+    "set_result",
+    "set_exception",
+    "call_soon",
+    "call_later",
+    "call_at",
+    "create_task",
+    "ensure_future",
+    "put_nowait",
+    "send",
+}
+
+
+def _thread_target_names(ctx: FileContext) -> set[str]:
+    """Names of local defs handed to worker threads: Thread(target=f),
+    asyncio.to_thread(f, ...), loop.run_in_executor(None, f, ...)."""
+    targets: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        qual = ctx.resolve(node.func)
+        is_thread = qual == "threading.Thread" or (
+            isinstance(node.func, ast.Attribute) and node.func.attr == "Thread"
+        )
+        if is_thread:
+            for kw in node.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Name):
+                    targets.add(kw.value.id)
+            continue
+        if qual == "asyncio.to_thread" and node.args:
+            if isinstance(node.args[0], ast.Name):
+                targets.add(node.args[0].id)
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "run_in_executor"
+            and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Name)
+        ):
+            targets.add(node.args[1].id)
+    return targets
+
+
+@rule(
+    "thread-loop-affinity",
+    "worker-thread code mutates loop-owned state directly (futures, "
+    "mailboxes, task spawns): marshal through loop.call_soon_threadsafe",
+)
+def _thread_loop_affinity(ctx: FileContext) -> None:
+    targets = _thread_target_names(ctx)
+    if not targets:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.FunctionDef) or node.name not in targets:
+            continue
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _LOOP_AFFINE_ATTRS
+            ):
+                ctx.report(
+                    "thread-loop-affinity", sub,
+                    f".{sub.func.attr}(...) called from thread-target "
+                    f"{node.name}() without call_soon_threadsafe",
+                )
+
+
+# --- metric-name / event-name ------------------------------------------------
+
+_METRIC_ATTRS = {"inc", "observe", "set_gauge"}
+
+
+def _literal(node: ast.AST) -> "str | None":
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@rule(
+    "metric-name",
+    "metric/span name literal violates the `<layer>.<name>` schema "
+    "(^[a-z]+(\\.[a-z_]+)+$, OBSERVABILITY.md)",
+)
+def _metric_name(ctx: FileContext) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        lit = _literal(node.args[0]) if node.args else None
+        hit = None
+        if isinstance(func, ast.Attribute) and func.attr in _METRIC_ATTRS:
+            hit = lit
+        elif isinstance(func, ast.Name) and func.id == "span":
+            hit = lit
+        elif isinstance(func, ast.Attribute) and func.attr == "span":
+            hit = lit  # module-qualified form: trace.span("...")
+        elif isinstance(func, ast.Attribute) and func.attr == "inc_batch":
+            # inc_batch takes ((name, delta, labels), ...): lint every
+            # literal tuple's literal first element (the old regex lint
+            # never saw these)
+            for arg in node.args:
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    for el in arg.elts:
+                        if isinstance(el, (ast.Tuple, ast.List)) and el.elts:
+                            name = _literal(el.elts[0])
+                            if name is not None and not NAME_SCHEMA_RE.match(name):
+                                ctx.report(
+                                    "metric-name", el,
+                                    f"metric name {name!r} violates "
+                                    "<layer>.<name> schema",
+                                )
+            continue
+        if hit is not None and not NAME_SCHEMA_RE.match(hit):
+            ctx.report(
+                "metric-name", node,
+                f"metric name {hit!r} violates <layer>.<name> schema",
+            )
+
+
+@rule(
+    "event-name",
+    "event-type literal at .emit() violates the `<layer>.<name>` schema "
+    "(no grandfathered names)",
+)
+def _event_name(ctx: FileContext) -> None:
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "emit"
+            and node.args
+        ):
+            lit = _literal(node.args[0])
+            if lit is not None and not NAME_SCHEMA_RE.match(lit):
+                ctx.report(
+                    "event-name", node,
+                    f"event type {lit!r} violates <layer>.<name> schema",
+                )

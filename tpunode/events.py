@@ -16,7 +16,7 @@ per-batch path; it is NOT wired into per-message hot loops.
 :class:`StatsReporter` is the periodic telemetry actor: it snapshots the
 metrics registry on an interval, computes *windowed* rates by diffing
 successive snapshots (fixing the since-process-start ``rate()``), and
-emits a ``stats`` event — the node links it like its other loops
+emits a ``node.stats`` event — the node links it like its other loops
 (tpunode/actors.py substrate).
 """
 
@@ -178,7 +178,7 @@ _RATED = (
     "peer.bytes_out",
 )
 
-# Labeled families summarized into every stats event as bounded-cardinality
+# Labeled families summarized into every node.stats event as bounded-cardinality
 # aggregates: family name -> the label key to sum by.  The raw per-peer
 # series stay out of the persisted event (unbounded cardinality — they
 # belong to Node.stats()/render_prometheus() pulls); summing ``peer.msgs``
@@ -187,7 +187,7 @@ _LABEL_AGG: dict[str, str] = {"peer.msgs": "cmd"}
 
 
 class StatsReporter:
-    """Periodic registry snapshot -> windowed rates -> ``stats`` events.
+    """Periodic registry snapshot -> windowed rates -> ``node.stats`` events.
 
     Rates are computed by diffing successive snapshots over the actual
     elapsed interval, so an idle hour does not dilute the current
@@ -246,7 +246,10 @@ class StatsReporter:
                 fields.update(self.extra())
             except Exception as e:
                 fields["extra_error"] = repr(e)
-        return self.log.emit("stats", **fields)
+        # "node.stats" (ISSUE 3 satellite): the event type followed the
+        # <layer>.<name> schema everywhere else; the old grandfathered
+        # bare "stats" name is gone.
+        return self.log.emit("node.stats", **fields)
 
     async def run(self) -> None:
         while True:

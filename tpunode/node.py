@@ -21,7 +21,14 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from .actors import LinkedTasks, Publisher, Supervisor
+from . import asyncsan
+from .actors import (
+    LinkedTasks,
+    Publisher,
+    Supervisor,
+    spawn_supervised,
+    task_registry,
+)
 from .chain import Chain, ChainBestBlock, ChainConfig, ChainEvent
 from .debugsrv import DebugServer
 from .events import StatsReporter, events
@@ -156,7 +163,7 @@ class NodeConfig:
     # the batch verify engine and TxVerdict events reach the user bus
     verify: Optional[VerifyConfig] = None
     # telemetry: seconds between StatsReporter snapshots (windowed rates +
-    # ``stats`` events on the structured event log); 0 disables the loop
+    # ``node.stats`` events on the structured event log); 0 disables the loop
     stats_interval: float = 30.0
     # stall watchdog cadence (event-loop lag, actor-mailbox head age,
     # verify dispatch in-flight time -> ``watchdog.stall`` events);
@@ -252,6 +259,7 @@ class Node:
         self._started_at: Optional[float] = None
         self._stats_reporter: Optional[StatsReporter] = None
         self._watchdog: Optional[Watchdog] = None
+        self._attributor = None  # asyncsan.LoopAttributor when enabled
         self.debug_server: Optional[DebugServer] = None
 
     @staticmethod
@@ -277,6 +285,25 @@ class Node:
         # initial best-block event reaches the peer manager (the startup
         # ordering constraint, reference Node.hs:183-192 + PeerMgr.hs:245-247).
         self._owner = asyncio.current_task()
+        if asyncsan.enabled():
+            # opt-in runtime sanitizers (TPUNODE_ASYNCSAN, ANALYSIS.md):
+            # asyncio debug mode + tight slow-callback reporting, and the
+            # blocked-loop attributor whose captured frames upgrade the
+            # watchdog's event_loop stall events
+            asyncsan.install()
+            self._attributor = asyncsan.LoopAttributor()
+            self._attributor.start()
+        try:
+            return await self._start()
+        except BaseException:
+            # a failed start never reaches __aexit__: don't leak the
+            # attributor's sampler thread + heartbeat chain
+            if self._attributor is not None:
+                self._attributor.stop()
+                self._attributor = None
+            raise
+
+    async def _start(self) -> "Node":
         await self._stack.__aenter__()
         chain_sub = await self._stack.enter_async_context(
             self._chain_pub.subscription()
@@ -302,6 +329,7 @@ class Node:
                 WatchdogConfig(interval=self.cfg.watchdog_interval),
                 mailboxes=[self.chain.mailbox, self.peer_mgr.mailbox],
                 engine=self.verify_engine,
+                attributor=self._attributor,
             )
             self._tasks.link(self._watchdog.run(), name="watchdog")
         if self.cfg.debug_port is not None:
@@ -326,7 +354,17 @@ class Node:
         try:
             await self._tasks.__aexit__(exc_type, exc, tb)
         finally:
-            await self._stack.__aexit__(exc_type, exc, tb)
+            try:
+                await self._stack.__aexit__(exc_type, exc, tb)
+            finally:
+                if self._attributor is not None:
+                    self._attributor.stop()
+                    self._attributor = None
+                # asyncsan task-leak sweep: everything this node owned is
+                # now cancelled+awaited, so any still-pending registered
+                # task with no live open owner is an orphan — report it
+                # (asyncsan.task_leak events) instead of letting GC eat it
+                task_registry.report_leaks()
         # Surface an internal crash instead of the bare CancelledError the
         # embedding scope was aborted with.
         if self._failure is not None and isinstance(exc, asyncio.CancelledError):
@@ -335,7 +373,7 @@ class Node:
     # -- telemetry snapshot API ---------------------------------------------
 
     def _stats_extra(self) -> dict:
-        """Node-level context merged into every ``stats`` event."""
+        """Node-level context merged into every ``node.stats`` event."""
         fleet = self.peer_mgr.fleet()
         extra = {
             "height": self._best_height(),
@@ -902,10 +940,12 @@ class Node:
                     metrics.inc("node.verify_inputs", stats.total_inputs)
                     task = None
                     if items:
-                        task = asyncio.ensure_future(
+                        task = spawn_supervised(
                             self.verify_engine.verify(
                                 [i.verify_item for i in items]
-                            )
+                            ),
+                            name="verify-sigbatch",
+                            owner=self._verify_tasks,
                         )
                     per_tx.append((tx, stats, items, task))
             # Awaiting the engine happens OUTSIDE any commit span — the

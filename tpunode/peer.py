@@ -21,7 +21,7 @@ from contextlib import AbstractAsyncContextManager
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol, Union
 
-from .actors import Mailbox, Publisher
+from .actors import Mailbox, Publisher, spawn_supervised
 from .compat import timeout as _timeout
 from .metrics import metrics
 from .params import Network
@@ -181,7 +181,9 @@ class Peer:
     (reference Peer.hs:170-175).  Identity comparison, like the reference's
     mailbox equality."""
 
-    __slots__ = ("mailbox", "pub", "label", "_busy")
+    # __weakref__: the task-supervision registry holds peers weakly as
+    # the owners of their session's inbound/outbound loop tasks
+    __slots__ = ("mailbox", "pub", "label", "_busy", "__weakref__")
 
     def __init__(self, mailbox: Mailbox, pub: "Publisher[PeerEvent]", label: str):
         self.mailbox = mailbox
@@ -366,9 +368,17 @@ async def run_peer(cfg: PeerConfig, peer: Peer, inbox: Mailbox) -> None:
     """
     log.debug("[Peer] %s: session starting", cfg.label)
     async with cfg.connect() as conn:
-        loop = asyncio.get_running_loop()
-        t_in = loop.create_task(_inbound_loop(cfg, peer, conn), name=f"peer-in-{cfg.label}")
-        t_out = loop.create_task(_outbound_loop(cfg, inbox, conn), name=f"peer-out-{cfg.label}")
+        # owner=peer: both loops are cancelled+awaited in the finally
+        # below, but the registry still scopes them to this session so a
+        # concurrent node's shutdown never misreads them as leaks
+        t_in = spawn_supervised(
+            _inbound_loop(cfg, peer, conn),
+            name=f"peer-in-{cfg.label}", owner=peer,
+        )
+        t_out = spawn_supervised(
+            _outbound_loop(cfg, inbox, conn),
+            name=f"peer-out-{cfg.label}", owner=peer,
+        )
         try:
             done, pending = await asyncio.wait(
                 {t_in, t_out}, return_when=asyncio.FIRST_EXCEPTION

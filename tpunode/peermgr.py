@@ -20,7 +20,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Union
 
-from .actors import LinkedTasks, Mailbox, Publisher, Supervisor
+from .actors import (
+    LinkedTasks,
+    Mailbox,
+    Publisher,
+    Supervisor,
+    spawn_supervised,
+)
 from .events import events
 from .metrics import metrics
 from .params import NODE_NETWORK, PROTOCOL_VERSION, Network
@@ -490,7 +496,12 @@ class PeerMgr:
                 )
                 self.mailbox.send(_CheckPeer(p))
 
-        timer = asyncio.get_running_loop().create_task(check_loop())
+        # ISSUE 3 satellite: the jittered check timer was a bare
+        # create_task handle — registry-supervised now, still
+        # cancelled+awaited on session exit
+        timer = spawn_supervised(
+            check_loop(), name=f"peer-check-{p.label}", owner=self.supervisor
+        )
         try:
             await run_peer(pc, p, inbox)
         finally:

@@ -34,6 +34,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
+from ..actors import spawn_supervised
 from ..events import events
 from ..metrics import metrics
 from ..trace import span
@@ -353,12 +354,16 @@ class VerifyEngine:
 
     async def __aenter__(self) -> "VerifyEngine":
         self._kick = asyncio.Event()
-        self._task = asyncio.get_running_loop().create_task(
-            self._run(), name="verify-engine"
+        self._closing = False  # task-registry owner convention (actors.py)
+        # ISSUE 3 satellite: the queue loop was a bare create_task handle —
+        # registry-supervised now, cancelled+awaited in __aexit__ below
+        self._task = spawn_supervised(
+            self._run(), name="verify-engine", owner=self
         )
         return self
 
     async def __aexit__(self, *exc) -> None:
+        self._closing = True
         if self._task is not None:
             self._task.cancel()
             with contextlib.suppress(asyncio.CancelledError):

@@ -56,11 +56,14 @@ class Watchdog:
         mailboxes: Iterable[Mailbox] = (),
         engine=None,  # anything with dispatch_inflight_seconds() -> float
         log_: Optional[EventLog] = None,
+        attributor=None,  # asyncsan.LoopAttributor (or None): names the
+        # frame that froze the loop, merged into event_loop stall events
     ):
         self.cfg = cfg or WatchdogConfig()
         self.mailboxes = list(mailboxes)
         self.engine = engine
         self.log = log_ if log_ is not None else events
+        self.attributor = attributor
         # stall keys currently in an episode: emit once, re-arm on clear
         self._stalled: set[str] = set()
 
@@ -76,10 +79,23 @@ class Watchdog:
         metrics.set_gauge("watchdog.loop_lag_seconds", lag)
         metrics.observe("watchdog.loop_lag", lag)
         if lag > self.cfg.lag_threshold:
-            emitted += self._stall(
-                "event_loop", kind="event_loop", lag_seconds=round(lag, 4),
+            fields = dict(
+                kind="event_loop", lag_seconds=round(lag, 4),
                 threshold=self.cfg.lag_threshold,
             )
+            # asyncsan attribution: the stack captured DURING the freeze
+            # upgrades "the loop stalled" to "the loop stalled here".
+            # max_age scopes the capture to THIS episode — the freeze just
+            # measured plus a couple of intervals of slack — so a stale
+            # capture from an earlier stall never blames the wrong code.
+            if self.attributor is not None:
+                blocked = self.attributor.last_blocked(
+                    max_age=lag + 2 * self.cfg.interval
+                )
+                if blocked is not None:
+                    fields["blocked_frames"] = blocked["frames"]
+                    fields["blocked_age_seconds"] = blocked["age_seconds"]
+            emitted += self._stall("event_loop", **fields)
         else:
             self._clear("event_loop")
         now = time.monotonic()
