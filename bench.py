@@ -487,10 +487,13 @@ def main() -> None:
 
 def _main_locked() -> None:
     # CPU single-core baseline first: jax-free, can't hang on TPU init.
-    from benchmarks.common import cpu_single_core_bench, make_triples
+    # Median of 5 timed passes with the spread recorded (VERDICT r5 weak
+    # #7: a single pass drifted vs_baseline ±25% with host load).
+    from benchmarks.common import cpu_single_core_stats, make_triples
 
     base = make_triples(UNIQUE)
-    cpu_rate, cpu_engine, _ = cpu_single_core_bench(base[:CPU_SAMPLE])
+    cpu_stats = cpu_single_core_stats(base[:CPU_SAMPLE])
+    cpu_rate, cpu_engine = cpu_stats["rate"], cpu_stats["engine"]
 
     attempts: list[str] = []
     res: dict = {"ok": False, "error": "no attempt ran"}
@@ -602,6 +605,12 @@ def _main_locked() -> None:
         "device": res.get("device", "unavailable"),
         "provenance": provenance,
         "baseline_cpu_single_core": round(cpu_rate, 1),
+        "baseline_cpu_runs": cpu_stats["runs"],
+        "baseline_cpu_spread": {
+            "min": round(cpu_stats["rate_min"], 1),
+            "max": round(cpu_stats["rate_max"], 1),
+            "rel": round(cpu_stats["rate_spread"], 3),
+        },
         "baseline_engine": cpu_engine,
         "attempts": "; ".join(attempts),
     }

@@ -22,9 +22,14 @@ Run (CPU-only, never touches the tunnel):
 ``--pallas`` sends the same pool through the flagship Pallas program in
 interpret mode (numpy semantics of the exact Mosaic program; block 32)
 instead of the XLA program — both device paths validated by one
-harness.  Prints one JSON line: items compared, mismatches (MUST be 0),
-and the per-shape tally.  Replaces the one-off scripts behind PERF.md's
-r5 campaign notes with a committed, re-runnable harness.
+harness.  ``--field-mul=shift_add|dot_general`` and
+``--field-sqr=half|mul`` select the limb-product formulation (ISSUE 4):
+the dot_general/MXU formulation and the dedicated half-product squaring
+must produce ZERO mismatches on the full adversarial pool before they
+are eligible for dispatch.  Prints one JSON line: items compared,
+mismatches (MUST be 0), the formulation, and the per-shape tally.
+Replaces the one-off scripts behind PERF.md's r5 campaign notes with a
+committed, re-runnable harness.
 """
 
 from __future__ import annotations
@@ -125,20 +130,31 @@ def build_pool(n_base: int, rng: random.Random):
     return items, shapes, expects
 
 
-def run_campaign(n_base: int, batch: int, pallas: bool = False) -> dict:
+def run_campaign(
+    n_base: int,
+    batch: int,
+    pallas: bool = False,
+    field_mul: str | None = None,
+    field_sqr: str | None = None,
+) -> dict:
     """Build the pool and compare the chosen device program against the
     C++ verifier AND each shape's required verdict.  Returns the result
-    dict (``mismatches`` MUST be 0)."""
+    dict (``mismatches`` MUST be 0).  ``field_mul``/``field_sqr`` select
+    the limb-product formulation process-wide (None keeps the active
+    mode); every dispatch path retraces per mode."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
+    from tpunode.verify import field as F
     from tpunode.verify.cpu_native import load_native_verifier
     from tpunode.verify.ecdsa_cpu import verify_batch_cpu
     from tpunode.verify.engine import enable_compile_cache
     from tpunode.verify.kernel import verify_batch_tpu
 
     enable_compile_cache()
+    if field_mul is not None or field_sqr is not None:
+        F.set_field_modes(mul=field_mul, sqr=field_sqr)
     if pallas:
         import jax.numpy as jnp
 
@@ -189,6 +205,7 @@ def run_campaign(n_base: int, batch: int, pallas: bool = False) -> dict:
         "mismatches": len(mismatches),
         "mismatch_detail": mismatches[:10],
         "kernel": "pallas-interpret" if pallas else "xla",
+        "field_modes": {"mul": F.mul_mode(), "sqr": F.sqr_mode()},
         "gen_s": round(gen_s, 1),
         "run_s": round(run_s, 1),
         "oracle": "native-cpp" if native is not None else "python",
@@ -199,13 +216,24 @@ def run_campaign(n_base: int, batch: int, pallas: bool = False) -> dict:
 
 def main() -> None:
     pallas = "--pallas" in sys.argv
-    pos = [a for a in sys.argv[1:] if a != "--pallas"]
+    field_mul = field_sqr = None
+    pos = []
+    for a in sys.argv[1:]:
+        if a == "--pallas":
+            continue
+        if a.startswith("--field-mul="):
+            field_mul = a.split("=", 1)[1]
+        elif a.startswith("--field-sqr="):
+            field_sqr = a.split("=", 1)[1]
+        else:
+            pos.append(a)
     n_base = int(pos[0]) if pos else (32 if pallas else 256)
     batch = int(pos[1]) if len(pos) > 1 else (256 if pallas else 2048)
     if pallas and batch % 32:
         sys.exit(f"--pallas batch must be a multiple of the 32-lane "
                  f"interpret block (got {batch})")
-    res = run_campaign(n_base, batch, pallas=pallas)
+    res = run_campaign(n_base, batch, pallas=pallas,
+                       field_mul=field_mul, field_sqr=field_sqr)
     print(json.dumps(res))
     if res["mismatches"]:
         sys.exit(1)

@@ -109,6 +109,22 @@ def _field_mul() -> None:
         assert got == (av[i] * bv[i]) % F.P, (i, got)
 
 
+def _field_mul_dot() -> None:
+    """The ISSUE-4 dot_general formulation inside a pallas kernel: one
+    iota-built (47, 576) scatter contraction (int32 MACs).  Whether
+    Mosaic lowers an integer dot_general at all on this toolchain is
+    exactly what this case answers — the knob's TPU viability verdict
+    (PERF.md roofline section) is blocked on it."""
+    from tpunode.verify import field as F
+
+    prev = F.field_modes()
+    try:
+        F.set_field_modes(mul="dot_general", sqr="half")
+        _field_mul()
+    finally:
+        F.set_field_modes(mul=prev[0], sqr=prev[1])
+
+
 def _table_build() -> None:
     """The r3-era construct: a VMEM scratch table built with pl.ds
     dynamic stores inside a fori_loop (the kernel's Q-table pattern).
@@ -283,6 +299,7 @@ def main() -> None:
         print(json.dumps(res))
         return
     for name, fn in (("trivial", _trivial), ("field_mul", _field_mul),
+                     ("field_mul_dot", _field_mul_dot),
                      ("table_build", _table_build),
                      ("pow_window", _pow_window),
                      ("pow_window_smem", _pow_window_smem),
@@ -302,6 +319,12 @@ def main() -> None:
             # works: only the VMEM digit-read probe fails.
             res["verdict"] = ("repo: VMEM dynamic scalar digit read "
                               "confirmed as cause; SMEM kernel fix works")
+        elif failed == ["field_mul_dot"]:
+            # Not an outage: the default shift_add programs are healthy;
+            # Mosaic just can't lower the experimental int32 dot_general
+            # formulation (the PERF.md MXU-path verdict wants this fact).
+            res["verdict"] = ("healthy; int32 dot_general formulation "
+                              "not lowerable (MXU knob stays off on TPU)")
         elif oks.get("trivial"):
             res["verdict"] = f"repo: failing constructs = {','.join(failed)}"
     print(json.dumps(res))

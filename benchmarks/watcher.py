@@ -153,14 +153,24 @@ def _bench_running() -> bool:
         return False
 
 
-def run_headline(pallas_only: bool = False) -> tuple[dict | None, str]:
+def run_headline(
+    pallas_only: bool = False,
+) -> tuple[dict | None, str, bool]:
     """Device ladder: XLA-first until a headline is banked this round,
-    pallas 32768-first after.  Returns ``(worker_dict, "banked")`` on
-    success, or ``(None, reason)`` with reason one of ``"exhausted"``
-    (device live, every rung failed — worth diagnosing), ``"yielded"``
-    (bench.py took the tunnel) or ``"tunnel-lost"`` (the uptime window
-    closed mid-sweep) — the caller must NOT run more tunnel clients for
-    the last two.  Raises FatalMismatch on a device/oracle verdict
+    pallas 32768-first after.  Returns ``(worker_dict, "banked",
+    pallas_failed)`` on success, or ``(None, reason, pallas_failed)``
+    with reason one of ``"exhausted"`` (device live, every rung failed —
+    worth diagnosing), ``"yielded"`` (bench.py took the tunnel) or
+    ``"tunnel-lost"`` (the uptime window closed mid-sweep) — the caller
+    must NOT run more tunnel clients for the last two.
+
+    ``pallas_failed`` (ADVICE r5 #1) reports whether any pallas rung was
+    attempted AND failed during this sweep — with a Mosaic error or
+    otherwise (e.g. worker OOM, which doesn't set the broken flag).  The
+    caller uses it to skip the same-window pallas-only upgrade when the
+    banking sweep just proved those exact rungs failing: re-running them
+    would burn up to ~540 s of a ~6-9 min uptime window before the
+    config sweep.  Raises FatalMismatch on a device/oracle verdict
     mismatch.
 
     ``pallas_only``: the same-window upgrade attempt after an XLA
@@ -176,10 +186,11 @@ def run_headline(pallas_only: bool = False) -> tuple[dict | None, str]:
         rungs = list(FIRSTBANK_LADDER)
     else:
         rungs = list(LADDER)
+    pallas_failed = False
     while rungs:
         if _bench_running():
             _log("bench.py started mid-sweep — yielding the tunnel")
-            return None, "yielded"
+            return None, "yielded", pallas_failed
         batch, budget, kernel = rungs.pop(0)
         env, label = worker_rung_env(batch, kernel)
         res = _run_json(
@@ -198,9 +209,11 @@ def run_headline(pallas_only: bool = False) -> tuple[dict | None, str]:
                 "compile_s": res.get("compile_s"),
                 "init_s": res.get("init_s"),
             })
-            return res, "banked"
+            return res, "banked", pallas_failed
         err = str(res.get("error", ""))
         _log(f"headline {label}: {err or '?'}")
+        if kernel is None:
+            pallas_failed = True
         if res.get("fatal"):
             # Correctness failure, not an infra flake: record it (which
             # poisons bench.py's watcher fallback for the round) and stop
@@ -214,7 +227,7 @@ def run_headline(pallas_only: bool = False) -> tuple[dict | None, str]:
             # dead tunnel delays the next probe by up to 16 min
             # (observed r5, 03:54-04:16Z).
             _log("tunnel lost mid-sweep — back to probing")
-            return None, "tunnel-lost"
+            return None, "tunnel-lost", pallas_failed
         if kernel is None and (
             "MosaicError" in err or "timed out" in err
         ):
@@ -231,7 +244,7 @@ def run_headline(pallas_only: bool = False) -> tuple[dict | None, str]:
             _log("mosaic compile broken/hanging — skipping to XLA rungs")
             _mosaic_broken = True
             rungs = [r for r in rungs if r[2] == "xla"]
-    return None, "exhausted"
+    return None, "exhausted", pallas_failed
 
 
 def run_config(name: str) -> dict | None:
@@ -290,25 +303,47 @@ def _claim_pidfile(retries: int = 6, wait_s: float = 5.0) -> bool:
     """Register this process as THE watcher; False means another live
     watcher kept the claim.
 
-    A kill-and-relaunch race must not strand the round with no sampler:
-    if another watcher looks alive, wait briefly for it to finish dying
-    before giving up.  Two simultaneous launches both reaching the write
-    are then disambiguated by re-reading after a beat — the loser (the
-    one whose pid is no longer in the file while the winner lives)
-    exits."""
+    The whole check-and-claim is serialized under an exclusive ``flock``
+    on a sidecar lock file (ADVICE r5 #4): concurrent launchers decide
+    stale-vs-live and write their pid one at a time, so the
+    overwrite-then-recheck TOCTOU window — and the narrower
+    read-stale/delete-fresh race a bare ``O_CREAT|O_EXCL`` scheme keeps
+    (POSIX has no atomic compare-and-delete) — cannot occur.  The lock
+    file itself is NEVER deleted: removing it would let a late claimer
+    lock a fresh inode while an earlier one still holds the old, which
+    reopens the double-watcher hole.  A claim whose registered process
+    is dead (or recycled into a non-watcher) is simply overwritten under
+    the lock.  A kill-and-relaunch race must not strand the round with
+    no sampler: while a LIVE watcher holds the claim, wait briefly for
+    it to finish dying before giving up."""
+    import fcntl
+
     for i in range(retries):
-        if not _another_watcher_alive():
-            break
-        if i == retries - 1:
-            return False
+        try:
+            lock_fd = os.open(PID_PATH + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+        except OSError:
+            return True  # unwritable pidfile dir: claim uncontested, proceed
+        try:
+            try:
+                fcntl.flock(lock_fd, fcntl.LOCK_EX)  # held µs: check+write
+            except OSError:
+                pass  # flock-less fs (e.g. ENOLCK): unlocked best-effort,
+                # but NEVER skip the liveness check below — claiming
+                # blind would reopen the double-watcher hole
+            if _another_watcher_alive():
+                if i == retries - 1:
+                    return False
+            else:
+                try:
+                    with open(PID_PATH, "w", encoding="utf-8") as f:
+                        f.write(f"{os.getpid()}\n")
+                except OSError:
+                    pass  # unwritable pidfile: claim uncontested, proceed
+                return True
+        finally:
+            os.close(lock_fd)  # releases the flock
         time.sleep(wait_s)
-    try:
-        with open(PID_PATH, "w", encoding="utf-8") as f:
-            f.write(f"{os.getpid()}\n")
-    except OSError:
-        return True  # unwritable pidfile: claim uncontested, proceed
-    time.sleep(1.0)
-    return not _another_watcher_alive()
+    return False
 
 
 def _release_pidfile() -> None:
@@ -407,14 +442,23 @@ def handle_window(swept: set) -> float:
     only runs when the ladder proved the device live: never after a
     "yielded" sweep (it would contend with the bench we just yielded
     to) or a "tunnel-lost" one (480 s against a dead tunnel)."""
-    head, why = run_headline()
+    head, why, pallas_failed = run_headline()
     if head is not None:
-        if head.get("kernel") == "xla" and not _mosaic_broken:
-            # FIRSTBANK banked the quick XLA number and pallas has not
-            # been seen broken: chase the pallas headline NOW — the
-            # ~6-9 min windows don't survive a 15 min refresh wait.
+        if (
+            head.get("kernel") == "xla"
+            and not _mosaic_broken
+            and not pallas_failed
+        ):
+            # FIRSTBANK banked the quick XLA number, pallas has not been
+            # seen broken AND the banking sweep never reached (and
+            # failed) the pallas rungs itself: chase the pallas headline
+            # NOW — the ~6-9 min windows don't survive a 15 min refresh
+            # wait.  When the sweep DID just fail those rungs (e.g. a
+            # non-Mosaic worker crash, which doesn't set the broken
+            # flag), re-running the identical rungs would burn up to
+            # ~540 s of the window before the configs (ADVICE r5 #1).
             _log("same-window upgrade: pallas ladder attempt")
-            up_head, up_why = run_headline(pallas_only=True)
+            up_head, up_why, _up_pf = run_headline(pallas_only=True)
             if up_head is not None:
                 head = up_head
             elif up_why in ("yielded", "tunnel-lost"):

@@ -48,20 +48,22 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
     # The live repo log (the real watcher may be running during the
     # suite) must not leak into these scripted scenarios.
     monkeypatch.setattr(bench, "_watcher_evidence", lambda: evidence)
-    monkeypatch.setattr(
-        bench,
-        "cpu_single_core_bench",
-        lambda items: (5000.0, "native-cpp", [True] * len(items)),
-        raising=False,
-    )
-    # cpu_single_core_bench / make_triples are imported inside main();
+    def fake_cpu_stats(items, runs=5):
+        return {
+            "rate": 5000.0, "rate_min": 4900.0, "rate_max": 5100.0,
+            "rate_spread": 5100.0 / 4900.0 - 1.0, "runs": runs,
+            "engine": "native-cpp", "verdicts": [True] * len(items),
+        }
+
+    # cpu_single_core_stats / make_triples are imported inside main();
     # patch at the source (make_triples would otherwise pure-Python-sign
     # 512 items per test)
     import benchmarks.common as common
 
+    monkeypatch.setattr(common, "cpu_single_core_stats", fake_cpu_stats)
     monkeypatch.setattr(
         common, "cpu_single_core_bench",
-        lambda items: (5000.0, "native-cpp", [True] * len(items)),
+        lambda items, runs=5: (5000.0, "native-cpp", [True] * len(items)),
     )
     monkeypatch.setattr(common, "make_triples", lambda n, **kw: [(None, 0, 0, 0)] * n)
 
@@ -110,6 +112,15 @@ def test_happy_path_first_ladder_step(monkeypatch):
     assert line["device"] == "tpu:v5e"
     # ladder stopped after the first success: probe + one worker call
     assert len(calls) == 2
+    # VERDICT r5 weak #7: the baseline is a median-of-N with the spread
+    # recorded so a drifting vs_baseline is attributable to host load
+    assert line["baseline_cpu_runs"] >= 1
+    assert (
+        line["baseline_cpu_spread"]["min"]
+        <= line["baseline_cpu_single_core"]
+        <= line["baseline_cpu_spread"]["max"]
+    )
+    assert line["baseline_cpu_spread"]["rel"] >= 0.0
 
 
 def test_degrades_down_the_ladder(monkeypatch):
@@ -375,7 +386,7 @@ def test_watcher_headline_ladder_mosaic_skip(monkeypatch):
                 "kernel": "xla", "batch": batch}
 
     monkeypatch.setattr(W, "_run_json", fake_run)
-    res, why = W.run_headline()
+    res, why, _pf = W.run_headline()
     assert res is not None and res["kernel"] == "xla" and why == "banked"
     # first sweep: one pallas rung, then straight to the XLA rungs
     assert seen == [(32768, None), (16384, "xla"), (8192, "xla")]
@@ -396,7 +407,7 @@ def test_watcher_headline_ladder_mosaic_skip(monkeypatch):
                                    "device": "tpu:v5e", "kernel": "pallas",
                                    "batch": 32768},
     )
-    res, why = W.run_headline()
+    res, why, _pf = W.run_headline()
     assert res["kernel"] == "pallas" and why == "banked"
     assert not W._mosaic_broken
 
@@ -441,7 +452,7 @@ def test_watcher_first_sweep_banks_fast_xla_first(monkeypatch):
                 "kernel": kernel or "pallas", "batch": batch}
 
     monkeypatch.setattr(W, "_run_json", fake_run)
-    res, why = W.run_headline()
+    res, why, _pf = W.run_headline()
     assert res is not None and why == "banked"
     assert seen == [(8192, "xla")]  # banked on the first, fast rung
     assert W._headline_banked
@@ -470,7 +481,7 @@ def test_watcher_sweep_aborts_when_tunnel_lost(monkeypatch):
                 "[bench-worker] initializing backend (jax.devices may block)...)"}
 
     monkeypatch.setattr(W, "_run_json", fake_run)
-    assert W.run_headline() == (None, "tunnel-lost")
+    assert W.run_headline()[:2] == (None, "tunnel-lost")
     assert seen == [32768]  # aborted after the first dead rung
 
 
@@ -497,7 +508,7 @@ def test_watcher_pallas_compile_hang_marks_mosaic_broken(monkeypatch):
                 "kernel": "xla", "batch": batch}
 
     monkeypatch.setattr(W, "_run_json", fake_run)
-    res, why = W.run_headline()
+    res, why, _pf = W.run_headline()
     assert res is not None and res["kernel"] == "xla" and why == "banked"
     assert seen == [(32768, None), (16384, "xla")]
     assert W._mosaic_broken
@@ -516,7 +527,7 @@ def test_watcher_yields_tunnel_to_bench(monkeypatch):
     monkeypatch.setattr(
         W, "_run_json", lambda *a, **k: calls.append(a) or {"ok": True}
     )
-    assert W.run_headline() == (None, "yielded")
+    assert W.run_headline()[:2] == (None, "yielded")
     assert W.run_config("config2") is None
     assert calls == []
 
@@ -709,7 +720,7 @@ def test_watcher_pallas_only_upgrade_rungs(monkeypatch):
         return {"ok": False, "error": "exited 1 (crash)"}
 
     monkeypatch.setattr(W, "_run_json", fake_run)
-    res, why = W.run_headline(pallas_only=True)
+    res, why, _pf = W.run_headline(pallas_only=True)
     assert res is None and why == "exhausted"
     assert seen == [(32768, None), (8192, None), (4096, None)]
     assert all(k is None for _, k in seen)
@@ -721,7 +732,7 @@ def _setup_window(monkeypatch, W, head, why, mosaic=False):
     configs, diags, recs = [], [], []
     monkeypatch.setattr(W, "_mosaic_broken", mosaic)
     monkeypatch.setattr(W, "run_headline",
-                        lambda pallas_only=False: (head, why))
+                        lambda pallas_only=False: (head, why, False))
     monkeypatch.setattr(
         W, "run_config", lambda name: configs.append(name) or {"metric": name}
     )
@@ -757,7 +768,7 @@ def test_handle_window_keeps_probing_until_configs_banked(monkeypatch):
     head = {"kernel": "pallas", "rate": 210000.0}
     monkeypatch.setattr(W, "_mosaic_broken", False)
     monkeypatch.setattr(W, "run_headline",
-                        lambda pallas_only=False: (head, "banked"))
+                        lambda pallas_only=False: (head, "banked", False))
     # config3/config5 fail (window closed mid-sweep)
     monkeypatch.setattr(
         W, "run_config",
@@ -823,8 +834,8 @@ def test_handle_window_upgrade_before_configs(monkeypatch):
     def fake_headline(pallas_only=False):
         order.append(("headline", pallas_only))
         if pallas_only:
-            return {"kernel": "pallas", "rate": 210000.0}, "banked"
-        return {"kernel": "xla", "rate": 41000.0}, "banked"
+            return {"kernel": "pallas", "rate": 210000.0}, "banked", False
+        return {"kernel": "xla", "rate": 41000.0}, "banked", False
 
     monkeypatch.setattr(W, "run_headline", fake_headline)
     monkeypatch.setattr(
@@ -850,8 +861,8 @@ def test_handle_window_tunnel_lost_during_upgrade_skips_configs(monkeypatch):
 
     def fake_headline(pallas_only=False):
         if pallas_only:
-            return None, "tunnel-lost"
-        return {"kernel": "xla", "rate": 41000.0}, "banked"
+            return None, "tunnel-lost", True
+        return {"kernel": "xla", "rate": 41000.0}, "banked", False
 
     monkeypatch.setattr(W, "run_headline", fake_headline)
     monkeypatch.setattr(

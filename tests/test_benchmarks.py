@@ -114,6 +114,7 @@ def test_mosaic_diag_interpret_cases():
             "import json;"
             "print(json.dumps([d._case('trivial', d._trivial),"
             "                  d._case('field_mul', d._field_mul),"
+            "                  d._case('field_mul_dot', d._field_mul_dot),"
             "                  d._case('table_build', d._table_build),"
             "                  d._case('pow_window', d._pow_window),"
             "                  d._case('pow_window_smem',"
@@ -127,4 +128,198 @@ def test_mosaic_diag_interpret_cases():
     )
     assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
     cases = json.loads(out.stdout.strip().splitlines()[-1])
-    assert [c["ok"] for c in cases] == [True] * 5, cases
+    assert [c["ok"] for c in cases] == [True] * 6, cases
+
+
+# ---------- roofline model (ISSUE 4 tentpole) ------------------------------
+
+
+def test_roofline_op_counts_match_rcb_and_structure():
+    """The op model is DERIVED from the live kernel: the per-point-op
+    counts must equal the RCB'16 paper's (12M for complete addition,
+    6M + 2S for doubling) and the per-verify totals must equal the
+    structural assembly recomputed here from kernel.py's constants."""
+    from benchmarks.roofline import field_op_model
+    from tpunode.verify.kernel import WINDOW_BITS, WINDOWS, _EULER_DIGITS
+
+    m = field_op_model()
+    add, dbl = m["pt_add"], m["pt_double"]
+    # RCB Algorithm 7: 12 muls (+ 2 reduced small-constant scalings)
+    assert add["mul"] + add.get("mul_t", 0) == 12
+    assert add["mul_small_red"] == 2
+    # RCB Algorithm 9: 6 muls + 2 squarings (+ 1 reduced scaling)
+    assert dbl["mul"] + dbl.get("mul_t", 0) == 6
+    assert dbl["sqr_t"] == 2
+    assert dbl["mul_small_red"] == 1
+
+    tab = 1 << WINDOW_BITS
+    per_add = sum(add.values())
+    per_dbl = sum(dbl.values())
+    ecdsa = m["per_verify"]["ecdsa"]
+    expect = (
+        WINDOWS * 4 * (per_add + per_dbl)  # MSM: 4 dbl + 4 add per window
+        + (tab - 2) * per_add              # Q table build
+        + tab                              # λ table: β·X per entry
+        + 2                                # m1/m2 projective checks
+        + 3                                # on-curve qy² = qx³ + 7
+    )
+    assert ecdsa["total_mul_like"] == expect
+    # the Schnorr/BIP340 lanes add one pow ladder + one mul each
+    pow_muls = (tab - 2) + len(_EULER_DIGITS) + WINDOW_BITS * len(_EULER_DIGITS)
+    for algo in ("schnorr", "bip340"):
+        assert m["per_verify"][algo]["total_mul_like"] == expect + 1 + pow_muls
+
+
+def test_roofline_full_model_runs():
+    """End-to-end model: sane shapes, positive bounds, utilization < 1,
+    and the dedicated-sqr MAC saving visible (300 < 576)."""
+    from benchmarks.roofline import mac_model, roofline
+
+    macs = mac_model()
+    assert macs["mul"] == 576
+    assert macs["sqr"] == 300  # the dedicated half-product path
+    r = roofline()
+    for algo in ("ecdsa", "schnorr", "bip340"):
+        w = r["per_verify"][algo]
+        assert w["int32_macs"] > 0
+        assert w["vector_int_ops"] > w["int32_macs"]  # carries/folds exist
+        b = r["ideal_sigs_per_s"][algo]
+        assert b["vpu_bound_sigs_s"] > 0 and b["mxu_bound_sigs_s"] > 0
+    for label, u in r["utilization"].items():
+        assert 0.0 < u["vpu_utilization"] < 1.0, label
+        assert 0.0 < u["of_mxu_bound"] < 1.0, label
+
+
+def test_roofline_jaxpr_walk_counts_scans():
+    """The jaxpr walker multiplies scan bodies by their trip count (a
+    wrong multiplier would silently corrupt every derived bound)."""
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.roofline import count_int_ops
+
+    def body(x):
+        def step(c, _):
+            return c * 2 + 1, None
+
+        out, _ = jax.lax.scan(step, x, None, length=7)
+        return out
+
+    x = jnp.ones((4,), jnp.int32)
+    c = count_int_ops(body, x)
+    # per lane... batch = trailing dim 4: 7 muls + 7 adds per element
+    assert c["mul"] == 7.0
+    assert c["add"] == 7.0
+
+
+# ---------- watcher: pidfile claim + pallas upgrade gating -----------------
+
+
+def _load_watcher():
+    import importlib
+
+    import benchmarks.watcher as watcher
+
+    return importlib.reload(watcher)
+
+
+def test_claim_pidfile_atomic(tmp_path, monkeypatch):
+    watcher = _load_watcher()
+    pid_path = str(tmp_path / ".watcher_pid")
+    monkeypatch.setattr(watcher, "PID_PATH", pid_path)
+    # clean claim: registers us under the flock
+    assert watcher._claim_pidfile() is True
+    assert int(open(pid_path).read().split()[0]) == os.getpid()
+    # the flock sidecar exists and must NEVER be deleted (deleting it
+    # would let a late claimer lock a fresh inode while an earlier one
+    # still holds the old file's lock — double watcher)
+    assert os.path.exists(pid_path + ".lock")
+    watcher._release_pidfile()
+    assert not os.path.exists(pid_path)
+    assert os.path.exists(pid_path + ".lock")
+    # stale claim (dead pid): overwritten under the lock
+    with open(pid_path, "w") as f:
+        f.write("999999999\n")
+    assert watcher._claim_pidfile() is True
+    assert int(open(pid_path).read().split()[0]) == os.getpid()
+    # live foreign watcher: the claim must be refused (no overwrite)
+    with open(pid_path, "w") as f:
+        f.write("424242\n")
+    monkeypatch.setattr(watcher, "_another_watcher_alive", lambda: True)
+    assert watcher._claim_pidfile(retries=2, wait_s=0.01) is False
+    assert open(pid_path).read().split()[0] == "424242"  # untouched
+
+
+def test_run_headline_reports_pallas_failed(monkeypatch, tmp_path):
+    watcher = _load_watcher()
+    monkeypatch.setattr(watcher, "RUNS_PATH", str(tmp_path / "runs.jsonl"))
+    monkeypatch.setattr(watcher, "_bench_running", lambda: False)
+    watcher._headline_banked = True  # post-bank LADDER sweep
+
+    calls = []
+
+    def fake_run_json(argv, timeout, env=None):
+        calls.append(env or {})
+        kernel = (env or {}).get("TPUNODE_BENCH_KERNEL")
+        if kernel == "xla":
+            return {"ok": True, "rate": 30000.0, "device": "tpu:v5e",
+                    "kernel": "xla", "batch": 8192}
+        # pallas rungs crash with a NON-Mosaic error (e.g. OOM)
+        return {"ok": False, "error": "worker rc=137, no JSON"}
+
+    monkeypatch.setattr(watcher, "_run_json", fake_run_json)
+    head, why, pallas_failed = watcher.run_headline()
+    assert head is not None and why == "banked"
+    assert head["kernel"] == "xla"
+    assert pallas_failed is True  # pallas rungs ran and failed
+    assert not watcher._mosaic_broken  # non-Mosaic error: flag untouched
+
+
+def test_handle_window_skips_upgrade_after_pallas_failure(monkeypatch):
+    """ADVICE r5 #1: when the banking sweep itself just attempted-and-
+    failed the pallas rungs (non-Mosaic error), the same-window
+    pallas-only upgrade must NOT re-run them."""
+    watcher = _load_watcher()
+    monkeypatch.setattr(watcher, "run_config", lambda name: None)
+    upgrade_calls = []
+
+    def fake_run_headline(pallas_only=False):
+        if pallas_only:
+            upgrade_calls.append(1)
+            return None, "exhausted", True
+        return ({"kernel": "xla", "rate": 30000.0}, "banked", True)
+
+    monkeypatch.setattr(watcher, "run_headline", fake_run_headline)
+    watcher.handle_window(set())
+    assert upgrade_calls == []  # upgrade skipped
+
+    def fake_run_headline2(pallas_only=False):
+        if pallas_only:
+            upgrade_calls.append(1)
+            return None, "yielded", True
+        return ({"kernel": "xla", "rate": 30000.0}, "banked", False)
+
+    monkeypatch.setattr(watcher, "run_headline", fake_run_headline2)
+    watcher.handle_window(set())
+    assert upgrade_calls == [1]  # pallas untried this sweep: upgrade runs
+
+
+# ---------- cpu baseline median-of-N ---------------------------------------
+
+
+def test_cpu_single_core_stats_median_and_spread():
+    from benchmarks.common import (
+        cpu_single_core_bench,
+        cpu_single_core_stats,
+        make_triples,
+    )
+
+    sample = make_triples(16)
+    stats = cpu_single_core_stats(sample, runs=3)
+    assert stats["rate_min"] <= stats["rate"] <= stats["rate_max"]
+    assert stats["rate_spread"] >= 0.0
+    assert stats["runs"] in (1, 3)  # 1 when only the python oracle exists
+    assert len(stats["verdicts"]) == len(sample)
+    rate, engine, out = cpu_single_core_bench(sample, runs=3)
+    assert rate > 0 and engine in ("native-cpp", "python-oracle")
+    assert len(out) == len(sample)

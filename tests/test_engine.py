@@ -366,3 +366,22 @@ def test_with_mosaic_fallback_contract(monkeypatch):
     with pytest.raises(RuntimeError, match="still broken"):
         K.with_mosaic_fallback(always_mosaic, "in test")
     assert K.pallas_broken()
+
+
+def test_verify_config_field_formulation_knob():
+    """VerifyConfig.field_mul/field_sqr (ISSUE 4) apply the process-wide
+    limb-product formulation at engine construction, so the first device
+    trace uses the requested mode; None leaves the mode alone."""
+    from tpunode.verify import field as F
+
+    prev = F.field_modes()
+    try:
+        VerifyConfig(backend="cpu", warmup=False,
+                     field_mul="dot_general", field_sqr="mul")
+        assert F.field_modes() == ("dot_general", "mul")
+        VerifyConfig(backend="cpu", warmup=False)  # None: unchanged
+        assert F.field_modes() == ("dot_general", "mul")
+        VerifyConfig(backend="cpu", warmup=False, field_sqr="half")
+        assert F.field_modes() == ("dot_general", "half")
+    finally:
+        F.set_field_modes(mul=prev[0], sqr=prev[1])

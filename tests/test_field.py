@@ -139,3 +139,119 @@ def test_mul_under_vmap():
     out = f(stacked)  # (6, L, 2)
     for i, a in enumerate(a_vals):
         assert ints(out[i])[0] % F.P == a * b % F.P
+
+
+# ---------- limb-product formulations (ISSUE 4) ---------------------------
+
+
+@pytest.fixture
+def restore_modes():
+    prev = F.field_modes()
+    yield
+    F.set_field_modes(mul=prev[0], sqr=prev[1])
+
+
+def test_formulations_bit_identical(restore_modes):
+    """Every (mul, sqr) mode combination must produce BIT-identical limb
+    vectors (not just equal mod p): downstream verdicts are pinned
+    bit-exact against the oracle, so the formulations must be
+    interchangeable mid-pipeline."""
+    a_vals = [rand_fe() for _ in range(16)]
+    b_vals = [rand_fe() for _ in range(16)]
+    la, lb = limbs(*a_vals), limbs(*b_vals)
+    neg = limbs(5) - limbs(b_vals[0])  # negative loose operand
+    F.set_field_modes(mul="shift_add", sqr="half")
+    ref = {
+        "mul": np.asarray(F.mul(la, lb)),
+        "mul_t": np.asarray(F.mul_t(la, lb)),
+        "sqr": np.asarray(F.sqr(la)),
+        "sqr_neg": np.asarray(F.sqr(neg)),
+    }
+    st = np.asarray(F.sqr_t(jnp.asarray(ref["mul"])))
+    for mm in F.MUL_MODES:
+        for sm in F.SQR_MODES:
+            F.set_field_modes(mul=mm, sqr=sm)
+            assert (np.asarray(F.mul(la, lb)) == ref["mul"]).all(), (mm, sm)
+            assert (np.asarray(F.mul_t(la, lb)) == ref["mul_t"]).all(), (mm, sm)
+            assert (np.asarray(F.sqr(la)) == ref["sqr"]).all(), (mm, sm)
+            assert (np.asarray(F.sqr(neg)) == ref["sqr_neg"]).all(), (mm, sm)
+            assert (
+                np.asarray(F.sqr_t(jnp.asarray(ref["mul"]))) == st
+            ).all(), (mm, sm)
+
+
+def test_sqr_matches_mul_exactly(restore_modes):
+    """The dedicated half-product sqr IS mul(a, a): same value, same limb
+    representation, including through long chains (bounds hold)."""
+    F.set_field_modes(mul="shift_add", sqr="half")
+    v = rand_fe()
+    x = limbs(v)
+    expect = v
+    for _ in range(50):
+        x2 = F.mul(x, x)
+        x = F.sqr(x)
+        assert (np.asarray(x) == np.asarray(x2)).all()
+        expect = expect * expect % F.P
+        assert np.abs(np.asarray(x)).max() < (1 << 13)
+    assert ints(x) % F.P == expect
+
+
+def test_sqr_t_contract(restore_modes):
+    """sqr_t under mul_t's contract: pre-tight operands (every limb
+    <= 2^13), including sums of two mul outputs (point coordinates)."""
+    for mm in F.MUL_MODES:
+        F.set_field_modes(mul=mm, sqr="half")
+        a, b = rand_fe(), rand_fe()
+        m1 = F.mul(limbs(a), limbs(b))
+        coord = m1 + m1  # sum of 2 mul outputs: <= 2^13
+        got = F.sqr_t(coord)
+        want = (2 * (a * b % F.P)) ** 2 % F.P
+        assert ints(got) % F.P == want, mm
+
+
+def test_set_field_modes_validates(restore_modes):
+    with pytest.raises(ValueError):
+        F.set_field_modes(mul="nope")
+    with pytest.raises(ValueError):
+        F.set_field_modes(sqr="nope")
+    # a rejected call mutates NOTHING — not even the valid half (a
+    # half-flipped process would silently mislabel every later trace)
+    before = F.field_modes()
+    with pytest.raises(ValueError):
+        F.set_field_modes(mul="dot_general", sqr="nope")
+    assert F.field_modes() == before
+    prev = F.set_field_modes(mul="dot_general")
+    assert prev[0] in F.MUL_MODES and F.mul_mode() == "dot_general"
+    assert F.field_modes() == (F.mul_mode(), F.sqr_mode())
+
+
+def test_env_mode_rejects_typos(monkeypatch):
+    """A mistyped env knob must fail fast, not silently measure the
+    default formulation and label it with the requested one."""
+    monkeypatch.setenv("TPUNODE_FIELD_MUL", "dot-general")
+    with pytest.raises(ValueError):
+        F._env_mode("TPUNODE_FIELD_MUL", F.MUL_MODES, "shift_add")
+    monkeypatch.setenv("TPUNODE_FIELD_MUL", " Dot_General ")
+    assert (
+        F._env_mode("TPUNODE_FIELD_MUL", F.MUL_MODES, "shift_add")
+        == "dot_general"
+    )
+    monkeypatch.delenv("TPUNODE_FIELD_MUL")
+    assert F._env_mode("TPUNODE_FIELD_MUL", F.MUL_MODES, "shift_add") == (
+        "shift_add"
+    )
+
+
+def test_dot_general_scatter_structure():
+    """The scatter matrices encode exactly the limb convolution: row k
+    selects pairs i + j == k; sqr's carries weight 2 off-diagonal."""
+    m = np.asarray(F._MUL_SCATTER)
+    assert m.shape == (2 * F.NLIMBS - 1, F.NLIMBS * F.NLIMBS)
+    assert m.sum() == F.NLIMBS * F.NLIMBS  # every pair lands exactly once
+    for col, (i, j) in enumerate(F._MUL_PAIRS):
+        assert m[i + j, col] == 1
+    s = np.asarray(F._SQR_SCATTER)
+    assert s.shape == (2 * F.NLIMBS - 1, len(F._SQR_PAIRS))
+    # total weight == 576: the 300 half-products with doubling cover the
+    # full 24x24 product matrix
+    assert s.sum() == F.NLIMBS * F.NLIMBS

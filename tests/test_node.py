@@ -858,3 +858,34 @@ async def test_peer_sending_bad_headers_is_killed():
         assert node.chain.get_best().height == 0  # nothing imported
         # the connect loop will keep re-dialing; the node itself is healthy
         assert node.chain.is_synced() is False
+
+
+@pytest.mark.asyncio
+async def test_tcp_connect_rejects_non_numeric_host():
+    """The connect path is NUMERIC-only (reference ``fromSockAddr``
+    resolves with NumericHost): hostnames are resolved once in
+    ``to_sock_addr`` at address-book build, so ``tcp_connect`` must fail
+    fast on a non-numeric host instead of performing DNS inside the
+    connect (a wedged resolver would stall the peer slot)."""
+    import time
+
+    from tpunode.node import PeerAddressInvalid, tcp_connect
+
+    t0 = time.monotonic()
+    with pytest.raises(PeerAddressInvalid, match="non-numeric host"):
+        async with tcp_connect(("definitely-not-an-ip.invalid", 8333))():
+            pass
+    # fail-fast: no resolver round-trip happened (DNS timeouts are >> 1s)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_numeric_host_classifier():
+    from tpunode.node import _numeric_host
+
+    assert _numeric_host("127.0.0.1")
+    assert _numeric_host("::1")
+    assert _numeric_host("2002::dead:beef")
+    assert _numeric_host("fe80::1%eth0")  # zone id allowed
+    assert not _numeric_host("localhost")
+    assert not _numeric_host("example.com")
+    assert not _numeric_host("")

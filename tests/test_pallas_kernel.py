@@ -22,6 +22,7 @@ from tpunode.verify.ecdsa_cpu import (
     Point,
     point_mul,
     sign,
+    verify,
     verify_batch_cpu,
 )
 from tpunode.verify.kernel import prepare_batch
@@ -216,3 +217,66 @@ def test_dispatch_derives_schnorr_free_from_flags(monkeypatch):
     mixed = ecdsa + [(pub, schnorr_challenge(r, pub, 99), r, s, "schnorr")]
     K._dispatch_prep(prepare_batch(mixed, pad_to=8))
     assert seen == [True, False]
+
+
+def test_pallas_field_formulations_bit_identical():
+    """PF.mul/sqr/sqr_t under every (mul, sqr) formulation mode match
+    field.py's shift-add reference BIT-exactly (ISSUE 4): the Mosaic
+    concatenate/iota-scatter constructions must not diverge from the
+    .at[]-based originals in any mode."""
+    rng2 = random.Random(0xF1E1D)
+    a_vals = [rng2.getrandbits(256) % F.P for _ in range(8)]
+    b_vals = [rng2.getrandbits(256) % F.P for _ in range(8)]
+    la = jnp.stack([jnp.array(F.to_limbs(v)) for v in a_vals], axis=1)
+    lb = jnp.stack([jnp.array(F.to_limbs(v)) for v in b_vals], axis=1)
+    prev = F.field_modes()
+    try:
+        F.set_field_modes(mul="shift_add", sqr="half")
+        ref_mul = np.asarray(F.mul(la, lb))
+        ref_sqr = np.asarray(F.sqr(la))
+        ref_sqr_t = np.asarray(F.sqr_t(jnp.asarray(ref_mul)))
+        for mm in F.MUL_MODES:
+            for sm in F.SQR_MODES:
+                F.set_field_modes(mul=mm, sqr=sm)
+                assert (np.asarray(PF.mul(la, lb)) == ref_mul).all(), (mm, sm)
+                assert (np.asarray(PF.sqr(la)) == ref_sqr).all(), (mm, sm)
+                assert (
+                    np.asarray(PF.sqr_t(jnp.asarray(ref_mul))) == ref_sqr_t
+                ).all(), (mm, sm)
+    finally:
+        F.set_field_modes(mul=prev[0], sqr=prev[1])
+
+
+def test_pallas_field_iota_scatter_matches_numpy():
+    """The iota-built anti-diagonal scatter (constructed in-kernel because
+    pallas can't capture array constants) equals field.py's numpy one."""
+    got = np.asarray(PF._mul_scatter())
+    assert (got == np.asarray(F._MUL_SCATTER)).all()
+
+
+@pytest.mark.slow  # a third interpret-mode kernel trace (~1 min on CPU)
+def test_pallas_kernel_interpret_dot_general_matches_oracle():
+    """The flagship pallas program under the dot_general formulation:
+    verdict parity against the oracle in interpret mode (the measured
+    proxy for the MXU path, per VERDICT r5 directive #2)."""
+    rng2 = random.Random(0xD07)
+    items, expect = [], []
+    for i in range(8):
+        priv = rng2.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        z = rng2.getrandbits(256)
+        r, s = sign(priv, z, rng2.getrandbits(256) % CURVE_N or 1)
+        if i % 3 == 1:
+            z ^= 1
+        items.append((pub, z, r, s))
+        expect.append(verify(pub, z, r, s))
+    prep = prepare_batch(items, pad_to=8)
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    prev = F.field_modes()
+    try:
+        F.set_field_modes(mul="dot_general", sqr="half")
+        out = verify_blocked(*args, interpret=True, block=8)
+        got = [bool(b) for b in np.asarray(out)[:8]]
+        assert got == expect
+    finally:
+        F.set_field_modes(mul=prev[0], sqr=prev[1])

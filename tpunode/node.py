@@ -998,11 +998,33 @@ class _TCPConnection:
         await self._writer.drain()
 
 
+def _numeric_host(host: str) -> bool:
+    """Is ``host`` a numeric IPv4/IPv6 literal (zone id allowed)?"""
+    import ipaddress
+
+    try:
+        ipaddress.ip_address(host.split("%", 1)[0])
+        return True
+    except ValueError:
+        return False
+
+
 def tcp_connect(sa: SockAddr) -> WithConnection:
-    """Production transport (reference ``withConnection`` Node.hs:108-128)."""
+    """Production transport (reference ``withConnection`` Node.hs:108-128).
+
+    NUMERIC hosts only (reference ``fromSockAddr`` resolves with
+    NumericHost): hostnames are resolved ONCE at address-book build time
+    (``peermgr.to_sock_addr``), so the connect path itself never performs
+    a DNS lookup — a slow or wedged resolver must not stall a peer slot
+    for its whole connect timeout.  A non-numeric host here is a caller
+    bug and fails fast as PeerAddressInvalid."""
 
     @contextlib.asynccontextmanager
     async def factory():
+        if not _numeric_host(sa[0]):
+            raise PeerAddressInvalid(
+                f"{sa}: non-numeric host (resolve via to_sock_addr first)"
+            )
         try:
             reader, writer = await asyncio.open_connection(sa[0], sa[1])
         except OSError as e:

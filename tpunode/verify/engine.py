@@ -199,10 +199,22 @@ class VerifyConfig:
     # device warmup discipline
     warmup_timeout: float = 600.0  # backend=tpu: max wait for warmup
     warmup: bool = True  # start warmup thread on engine start
+    # Field-arithmetic formulation (ISSUE 4): None keeps the process-wide
+    # mode (TPUNODE_FIELD_MUL / TPUNODE_FIELD_SQR env knobs, defaults
+    # measured in PERF.md's roofline section); "shift_add"/"dot_general"
+    # and "half"/"mul" select explicitly.  Applied process-globally at
+    # engine construction — every device program keys its jit cache on
+    # the modes, so the first dispatch traces the requested formulation.
+    field_mul: Optional[str] = None
+    field_sqr: Optional[str] = None
 
     def __post_init__(self):
         if self.device_batch < self.batch_size:
             self.device_batch = self.batch_size
+        if self.field_mul is not None or self.field_sqr is not None:
+            from . import field as _field
+
+            _field.set_field_modes(mul=self.field_mul, sqr=self.field_sqr)
 
 
 class VerifyEngine:

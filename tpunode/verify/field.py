@@ -33,6 +33,20 @@ Key design points (bounds are load-bearing):
 
 Host<->device speaks Python ints via ``to_limbs``/``from_limbs``.
 
+**Two limb-product formulations** (ISSUE 4): the classic shift-add
+convolution (``shift_add``, the default) keeps everything on the VPU;
+``dot_general`` materializes the 24x24 partial-product rows and contracts
+them against a constant anti-diagonal scatter matrix with one
+``lax.dot_general`` — the formulation that maps onto the MXU (the TPU's
+wide-MAC unit, the analogue of the FPGA batch-ECDSA engines' DSP arrays).
+Squaring additionally has a **dedicated half-product path** (~300 partial
+products instead of 576, exploiting a_i*a_j symmetry) used by the pow
+ladders and doubling formulas.  Both knobs are process-global, selectable
+via ``TPUNODE_FIELD_MUL`` / ``TPUNODE_FIELD_SQR`` (see
+:func:`set_field_modes`); every jit cache keyed on :func:`field_modes`
+retraces on a flip.  All formulations compute IDENTICAL anti-diagonal
+sums, so the int32 overflow audit below applies verbatim to each.
+
 This replaces the capability the reference gets from libsecp256k1's field
 module (reference stack.yaml:5,9; SURVEY.md C9), redesigned for vector/matrix
 units rather than translated from the C.
@@ -40,9 +54,12 @@ units rather than translated from the C.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax.numpy as jnp
+from jax import lax
 
 __all__ = [
     "RADIX",
@@ -54,6 +71,7 @@ __all__ = [
     "mul",
     "mul_t",
     "sqr",
+    "sqr_t",
     "mul_small_red",
     "tighten",
     "canonical",
@@ -62,6 +80,12 @@ __all__ = [
     "select",
     "ZERO",
     "ONE",
+    "MUL_MODES",
+    "SQR_MODES",
+    "field_modes",
+    "mul_mode",
+    "sqr_mode",
+    "set_field_modes",
 ]
 
 RADIX = 11
@@ -104,6 +128,77 @@ ZERO = jnp.zeros((NLIMBS, 1), dtype=jnp.int32)
 ONE = jnp.zeros((NLIMBS, 1), dtype=jnp.int32).at[0].set(1)
 
 
+# ---------- limb-product formulation knobs (ISSUE 4) ----------------------
+#
+# Process-global, read at TRACE time: every jitted program that embeds
+# field ops keys its jit cache on field_modes() (kernel.verify_device,
+# pallas_kernel.verify_blocked, multichip._FN_CACHE), so flipping a mode
+# retraces instead of silently keeping the old formulation.
+#
+# Defaults chosen by measurement (PERF.md roofline section): on cpu-jax
+# the fused shift-add chain beats the materialized dot_general outer
+# product, and the half-product sqr wins everywhere.
+
+MUL_MODES = ("shift_add", "dot_general")
+SQR_MODES = ("half", "mul")
+
+
+def _env_mode(var: str, allowed: tuple, default: str) -> str:
+    v = os.environ.get(var, "").strip().lower()
+    if not v:
+        return default
+    if v not in allowed:
+        # Fail fast: this is a measurement knob — silently falling back
+        # to the default would make an A/B run measure the wrong
+        # formulation and label it with the requested one.
+        raise ValueError(f"{var}={v!r} not in {allowed}")
+    return v
+
+
+_MUL_MODE = _env_mode("TPUNODE_FIELD_MUL", MUL_MODES, "shift_add")
+_SQR_MODE = _env_mode("TPUNODE_FIELD_SQR", SQR_MODES, "half")
+
+
+def mul_mode() -> str:
+    """Active limb-product formulation: "shift_add" | "dot_general"."""
+    return _MUL_MODE
+
+
+def sqr_mode() -> str:
+    """Active squaring path: "half" (dedicated ~half-product) | "mul"."""
+    return _SQR_MODE
+
+
+def field_modes() -> tuple:
+    """Hashable (mul_mode, sqr_mode) — THE jit-cache key for every program
+    that embeds field ops (a trace bakes the formulation in)."""
+    return (_MUL_MODE, _SQR_MODE)
+
+
+def set_field_modes(mul: str | None = None, sqr: str | None = None) -> tuple:
+    """Select the limb-product / squaring formulation process-wide.
+
+    Returns the previous (mul_mode, sqr_mode) so callers can restore.
+    Programs traced BEFORE the flip keep their formulation until their
+    owner re-traces — which every in-repo dispatch site does, because all
+    of them key on :func:`field_modes`.
+    """
+    global _MUL_MODE, _SQR_MODE
+    # Validate BOTH before mutating either: a caller that catches the
+    # ValueError must find the process-global modes untouched, not
+    # half-flipped (which would silently mislabel every later trace).
+    if mul is not None and mul not in MUL_MODES:
+        raise ValueError(f"mul mode {mul!r} not in {MUL_MODES}")
+    if sqr is not None and sqr not in SQR_MODES:
+        raise ValueError(f"sqr mode {sqr!r} not in {SQR_MODES}")
+    prev = (_MUL_MODE, _SQR_MODE)
+    if mul is not None:
+        _MUL_MODE = mul
+    if sqr is not None:
+        _SQR_MODE = sqr
+    return prev
+
+
 def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Limb convolution: (24, B) x (24, B) -> (47, B).
 
@@ -115,6 +210,85 @@ def _conv(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     for i in range(NLIMBS):
         out = out.at[i : i + NLIMBS].add(a[i] * b)
     return out
+
+
+# Constant scatter matrices for the dot_general formulation.  MUL: row k
+# of (47, 576) selects the partial products a_i*b_j with i+j == k — the
+# anti-diagonal sum becomes ONE contraction over 576, which is what
+# lax.dot_general maps onto the MXU.  SQR: only the 300 i <= j pairs are
+# materialized; off-diagonal entries carry weight 2 (a_i*a_j appears
+# twice in the square), so the contraction output is bit-identical to
+# the full convolution of a with itself.
+_MUL_PAIRS = [(i, j) for i in range(NLIMBS) for j in range(NLIMBS)]
+_SQR_PAIRS = [(i, j) for i in range(NLIMBS) for j in range(i, NLIMBS)]
+
+
+def _scatter(pairs, weighted: bool) -> np.ndarray:
+    m = np.zeros((2 * NLIMBS - 1, len(pairs)), dtype=np.int32)
+    for col, (i, j) in enumerate(pairs):
+        m[i + j, col] = 2 if (weighted and i != j) else 1
+    return m
+
+
+_MUL_SCATTER = jnp.asarray(_scatter(_MUL_PAIRS, weighted=False))
+_SQR_SCATTER = jnp.asarray(_scatter(_SQR_PAIRS, weighted=True))
+_SQR_I = np.array([i for i, _ in _SQR_PAIRS])
+_SQR_J = np.array([j for _, j in _SQR_PAIRS])
+
+
+def _contract(scatter: jnp.ndarray, partials: jnp.ndarray,
+              rest: tuple) -> jnp.ndarray:
+    """(47, NPAIRS) @ (NPAIRS, prod(rest)) -> (47,) + rest, int32-exact.
+
+    ``preferred_element_type=int32``: the accumulator must be exactly the
+    int32 carry-save arithmetic of the shift-add form (every anti-diagonal
+    sum is bounded inside int32 by the callers' contracts, so accumulation
+    order is irrelevant)."""
+    out = lax.dot_general(
+        scatter,
+        partials.reshape((partials.shape[0], -1)),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return out.reshape((2 * NLIMBS - 1,) + rest)
+
+
+def _conv_dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """_conv as outer-product + one dot_general (same partials, same
+    anti-diagonal sums — bit-identical output)."""
+    p = (a[:, None] * b[None, :]).reshape((NLIMBS * NLIMBS,) + a.shape[1:])
+    return _contract(_MUL_SCATTER, p, a.shape[1:])
+
+
+def _sqr_conv(a: jnp.ndarray) -> jnp.ndarray:
+    """Half-product squaring, shift-add form: out[i+j] += (2-δij)·a_i·a_j
+    over i <= j — ~300 partial products instead of 576.  Per-position sums
+    equal _conv(a, a)'s exactly (same value, same bounds: the doubling
+    only rebrackets 2 identical cross terms into one)."""
+    out = jnp.zeros((2 * NLIMBS - 1,) + a.shape[1:], dtype=jnp.int32)
+    d = a + a
+    for i in range(NLIMBS):
+        out = out.at[2 * i].add(a[i] * a[i])
+        if i + 1 < NLIMBS:
+            out = out.at[2 * i + 1 : i + NLIMBS].add(a[i] * d[i + 1 :])
+    return out
+
+
+def _sqr_dot(a: jnp.ndarray) -> jnp.ndarray:
+    """Half-product squaring, dot_general form: gather the 300 i <= j
+    partial rows, contract with the 2-weighted scatter matrix."""
+    p = a[_SQR_I] * a[_SQR_J]
+    return _contract(_SQR_SCATTER, p, a.shape[1:])
+
+
+def _convolve(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _conv(a, b) if _MUL_MODE == "shift_add" else _conv_dot(a, b)
+
+
+def _square_conv(a: jnp.ndarray) -> jnp.ndarray:
+    if _SQR_MODE == "mul":
+        return _convolve(a, a)
+    return _sqr_conv(a) if _MUL_MODE == "shift_add" else _sqr_dot(a)
 
 
 def _carry(x: jnp.ndarray, rounds: int) -> jnp.ndarray:
@@ -177,6 +351,16 @@ def _tight24(a: jnp.ndarray) -> jnp.ndarray:
     return _carry(_fold_top(a), 1)
 
 
+def _reduce_wide(wide: jnp.ndarray) -> jnp.ndarray:
+    """The shared reduction tail of every product: 47 loose product limbs
+    -> 24 limbs, every |limb| <= 2^12.  Bounds as audited in mul's
+    docstring (this is the exact op sequence the original mul inlined)."""
+    wide = _carry(_pad(wide, 1), 2)  # 48 limbs, |v| <= 2^12 (top <= 2^15)
+    x = _fold_once(wide)  # 24 limbs, loose <= 2^28
+    x = _carry(x, 1)  # <= 2^12, top <= 2^17-ish
+    return _carry(_fold_top(x), 1)  # fold residual top overflow; <= 2^12
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Modular multiply mod p (general loose inputs; see mul_t for the
     pre-tight fast path).
@@ -197,11 +381,7 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """
     a = _carry(a, 1)
     b = _carry(b, 1)
-    wide = _conv(a, b)  # 47 limbs, anti-diagonal sums < 2^28.6
-    wide = _carry(_pad(wide, 1), 2)  # 48 limbs, |v| <= 2^12 (top <= 2^15)
-    x = _fold_once(wide)  # 24 limbs, loose <= 2^28
-    x = _carry(x, 1)  # <= 2^12, top <= 2^17-ish
-    return _carry(_fold_top(x), 1)  # fold residual top overflow; <= 2^12
+    return _reduce_wide(_convolve(a, b))  # sums < 2^28.6 (see contract)
 
 
 def mul_t(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -213,15 +393,24 @@ def mul_t(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     and mul_small_red outputs do NOT.  Convolution bound: 24 * 2^13 * 2^13
     = 2^30.6 < 2^31.  Output identical contract to mul's.
     """
-    wide = _conv(a, b)
-    wide = _carry(_pad(wide, 1), 2)
-    x = _fold_once(wide)
-    x = _carry(x, 1)
-    return _carry(_fold_top(x), 1)
+    return _reduce_wide(_convolve(a, b))
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
-    return mul(a, a)
+    """Modular square — mul(a, a)'s contract, via the dedicated
+    half-product path when ``sqr_mode() == "half"`` (the default: the pow
+    ladders spend most of their muls here).  The pairwise top*top <= 2^30
+    condition reduces to |top limb| <= 2^15, which mul's contract already
+    requires.  Bit-identical output to mul(a, a) in every mode."""
+    a = _carry(a, 1)
+    return _reduce_wide(_square_conv(a))
+
+
+def sqr_t(a: jnp.ndarray) -> jnp.ndarray:
+    """``sqr`` for pre-tight operands — mul_t's contract (every |limb|
+    <= 2^13).  The doubled cross partials 2*a_i*a_j <= 2^27 and the
+    per-position sums equal mul_t's convolution sums (< 2^30.6)."""
+    return _reduce_wide(_square_conv(a))
 
 
 def mul_small_red(a: jnp.ndarray, k: int) -> jnp.ndarray:

@@ -659,7 +659,27 @@ def verify_core(
     return host_valid & on_curve & not_inf & algo_ok
 
 
-verify_device = jax.jit(verify_core)
+# Jitted verify_core, one executable per field-formulation mode
+# (TPUNODE_FIELD_MUL / TPUNODE_FIELD_SQR, ISSUE 4): the limb-product
+# formulation is read from process globals at TRACE time, so the modes
+# must be part of the jit cache key — as a static argument.  (Distinct
+# ``jax.jit(verify_core)`` wrapper objects share one underlying trace
+# cache keyed on the wrapped function, so a per-mode dict of wrappers
+# does NOT retrace — measured the hard way.)
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("field_modes",))
+def _verify_device_jit(*args, field_modes=None):
+    del field_modes  # cache key only: forces a retrace per formulation
+    return verify_core(*args)
+
+
+def verify_device(*args) -> jnp.ndarray:
+    """Jitted :func:`verify_core` under the ACTIVE field formulation
+    (field.field_modes()) — a drop-in for the former module-level
+    ``jax.jit(verify_core)``."""
+    return _verify_device_jit(*args, field_modes=F.field_modes())
 
 
 # Sticky per-process flag: set when a pallas compile fails with a

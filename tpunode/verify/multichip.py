@@ -36,6 +36,7 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from . import field as F
 from .ecdsa_cpu import Point
 from .kernel import (
     ARG_IS_2D,
@@ -69,6 +70,7 @@ def sharded_verify_fn(
     *,
     interpret: bool = False,
     block: Optional[int] = None,
+    schnorr_free: bool = False,
 ):
     """Jitted verify step sharded over ``mesh``: same signature as
     :func:`kernel.verify_core`, returns ``(ok: (B,) bool, total: int32)``.
@@ -81,16 +83,27 @@ def sharded_verify_fn(
     is how tests pin the Pallas-inside-shard_map specs without TPU
     hardware (VERDICT r3 item 7).
 
+    ``schnorr_free`` (ADVICE r5 #3): an ECDSA-only batch may select the
+    pallas program variant with the jacobi/parity acceptance pows pruned
+    at trace time, exactly like the single-chip dispatcher — callers must
+    derive it from ``PreparedBatch.schnorr_free`` (a wrong True would
+    accept jacobi/parity forgeries).  The XLA program needs no static
+    flag: its runtime lax.cond gating sheds the pows per shard already.
+
     ``B`` must be a multiple of the mesh size (callers pad; static shapes
-    also keep XLA from recompiling across batches).  Cached per mesh so
-    repeated batches reuse the compiled executable.
+    also keep XLA from recompiling across batches).  Cached per mesh,
+    program variant, and field formulation (field.field_modes() — the
+    limb-product formulation is baked in at trace time) so repeated
+    batches reuse the compiled executable.
     """
     if kernel not in ("auto", "pallas", "xla"):
         raise ValueError(f"unknown kernel {kernel!r}: auto|pallas|xla")
     use_pallas = kernel == "pallas" or (
         kernel == "auto" and _mesh_is_tpu(mesh) and not pallas_broken()
     )
-    cached = _FN_CACHE.get((mesh, use_pallas, interpret, block))
+    schnorr_free = bool(schnorr_free) and use_pallas
+    key = (mesh, use_pallas, interpret, block, schnorr_free, F.field_modes())
+    cached = _FN_CACHE.get(key)
     if cached is not None:
         return cached
     # limb-major layout: batch is the trailing axis of the 2-D arrays
@@ -108,6 +121,8 @@ def sharded_verify_fn(
             kw["interpret"] = True
         if block is not None:
             kw["block"] = block
+        if schnorr_free:
+            kw["schnorr_free"] = True
         _core = partial(verify_blocked_impl, **kw) if kw else verify_blocked_impl
     else:
         _core = verify_core
@@ -137,7 +152,7 @@ def sharded_verify_fn(
             check_rep=False,
         )
     fn = jax.jit(sharded)
-    _FN_CACHE[(mesh, use_pallas, interpret, block)] = fn
+    _FN_CACHE[key] = fn
     return fn
 
 
@@ -178,8 +193,13 @@ def verify_batch_sharded(
     def run():
         # resolved inside the retry: after a Mosaic failure marks pallas
         # broken, the auto selection yields the XLA variant (cached
-        # separately per use_pallas)
-        ok, _total = sharded_verify_fn(mesh)(*args)
+        # separately per use_pallas).  schnorr_free comes from the host
+        # prep flags (the ONE safe derivation — kernel.PreparedBatch):
+        # an ECDSA-only sharded batch sheds the acceptance pows exactly
+        # like the single-chip dispatcher.
+        ok, _total = sharded_verify_fn(
+            mesh, schnorr_free=prep.schnorr_free
+        )(*args)
         return [bool(b) for b in np.asarray(ok)[: prep.count]]
 
     return with_mosaic_fallback(run, "in shard_map")

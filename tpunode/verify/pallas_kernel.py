@@ -358,7 +358,20 @@ def verify_blocked_impl(
     return out[0].astype(jnp.bool_)
 
 
-@partial(jax.jit, static_argnames=("interpret", "block", "schnorr_free"))
+@partial(
+    jax.jit,
+    static_argnames=("interpret", "block", "schnorr_free", "field_modes"),
+)
+def _verify_blocked_jit(*args, interpret: bool = False, block: int = BLOCK,
+                        schnorr_free: bool = False, field_modes=None):
+    # ``field_modes`` is only a jit-cache key: the field formulation knobs
+    # (field.field_modes()) are process globals read at trace time, so a
+    # flip must force a retrace instead of reusing the stale executable.
+    del field_modes
+    return verify_blocked_impl(*args, interpret=interpret, block=block,
+                               schnorr_free=schnorr_free)
+
+
 def verify_blocked(*args, interpret: bool = False, block: int = BLOCK,
                    schnorr_free: bool = False):
     """Drop-in replacement for :func:`kernel.verify_core` (same argument
@@ -369,6 +382,8 @@ def verify_blocked(*args, interpret: bool = False, block: int = BLOCK,
     selects the ECDSA-only program variant (acceptance pows pruned at
     trace time) — callers must only set it when no lane carries a
     schnorr/bip340 flag (kernel._dispatch_prep derives it from the
-    prepared batch)."""
-    return verify_blocked_impl(*args, interpret=interpret, block=block,
-                               schnorr_free=schnorr_free)
+    prepared batch).  Jit-cached per field formulation
+    (field.field_modes())."""
+    return _verify_blocked_jit(*args, interpret=interpret, block=block,
+                               schnorr_free=schnorr_free,
+                               field_modes=F.field_modes())
