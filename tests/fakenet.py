@@ -22,12 +22,16 @@ from tpunode.wire import (
     Block,
     HEADER_SIZE,
     InvType,
+    InvVector,
     MsgBlock,
     MsgGetData,
     MsgGetHeaders,
     MsgHeaders,
+    MsgInv,
+    MsgNotFound,
     MsgPing,
     MsgPong,
+    MsgTx,
     MsgVerAck,
     MsgVersion,
     NetworkAddress,
@@ -35,6 +39,33 @@ from tpunode.wire import (
     decode_message_header,
     encode_message,
 )
+
+
+class TxRelay:
+    """Configurable tx-relay behavior for one fake remote (the seam the
+    mempool's inv-driven fetch pipeline is tested through).
+
+    * ``announce``: txids pushed in an ``inv`` right after the handshake
+      (the remote's reaction to the node's ``version``).
+    * ``mode``:
+        - ``"serve"``    — answer tx ``getdata`` with the matching ``tx``
+          messages (unknown txids get a ``notfound``);
+        - ``"notfound"`` — answer every tx ``getdata`` with ``notfound``
+          (the retry-from-another-announcer path);
+        - ``"stall"``    — never answer tx ``getdata`` (the trailing-ping
+          sentinel of ``peer.get_data`` then bounds the node's wait).
+    * ``push``: txs sent unsolicited as ``tx`` messages right after the
+      handshake (the duplicate-push dedup path).
+    """
+
+    def __init__(self, txs=(), announce: bool = True, mode: str = "serve",
+                 push=()):
+        if mode not in ("serve", "notfound", "stall"):
+            raise ValueError(f"unknown TxRelay mode: {mode!r}")
+        self.txs = list(txs)
+        self.announce = announce
+        self.mode = mode
+        self.push = list(push)
 
 
 class QueueConnection:
@@ -68,28 +99,56 @@ class _QueueReader:
 
 
 def mock_peer_react(
-    net: Network, blocks: list[Block], msg, getdata_blocks: list[Block] = ()
+    net: Network, blocks: list[Block], msg, getdata_blocks: list[Block] = (),
+    relay: "TxRelay | None" = None,
 ) -> list:
     """Scripted protocol brain (reference ``mockPeerReact`` NodeSpec.hs:135-147).
 
     ``getdata_blocks`` are served on ``getdata`` only — never announced in
     ``headers`` — so a test can deliver a block with arbitrary txs (e.g.
     signed fixtures for the verify pipeline) without breaking the canned
-    header chain's validation."""
+    header chain's validation.  ``relay`` adds tx-relay behavior (inv
+    announcements, tx serving/notfound/stall, unsolicited pushes) — see
+    :class:`TxRelay`."""
     if isinstance(msg, MsgPing):
         return [MsgPong(msg.nonce)]
     if isinstance(msg, MsgVersion):
-        return [MsgVerAck()]
+        out = [MsgVerAck()]
+        if relay is not None:
+            if relay.announce and relay.txs:
+                out.append(
+                    MsgInv(
+                        tuple(
+                            InvVector(InvType.TX, t.txid) for t in relay.txs
+                        )
+                    )
+                )
+            out.extend(MsgTx(t) for t in relay.push)
+        return out
     if isinstance(msg, MsgGetHeaders):
         return [MsgHeaders(tuple((b.header, len(b.txs)) for b in blocks))]
     if isinstance(msg, MsgGetData):
         out = []
         by_hash = {b.header.hash: b for b in [*blocks, *getdata_blocks]}
+        by_txid = (
+            {t.txid: t for t in relay.txs} if relay is not None else {}
+        )
+        missing = []
         for iv in msg.invs:
             if iv.type in (InvType.BLOCK, InvType.WITNESS_BLOCK):
                 b = by_hash.get(iv.hash)
                 if b is not None:
                     out.append(MsgBlock(b))
+            elif iv.type in (InvType.TX, InvType.WITNESS_TX):
+                if relay is None or relay.mode == "stall":
+                    continue  # never answered; the ping sentinel bounds it
+                t = by_txid.get(iv.hash)
+                if relay.mode == "serve" and t is not None:
+                    out.append(MsgTx(t))
+                else:  # notfound mode, or a txid we don't have
+                    missing.append(iv)
+        if missing:
+            out.append(MsgNotFound(tuple(missing)))
         return out
     return []
 
@@ -101,6 +160,7 @@ async def _fake_remote(
     from_node: asyncio.Queue,
     send_version_first: bool = True,
     getdata_blocks: list[Block] = (),
+    relay: "TxRelay | None" = None,
 ) -> None:
     """The remote endpoint: speaks real wire bytes over the pipe."""
     if send_version_first:
@@ -125,7 +185,9 @@ async def _fake_remote(
             header = decode_message_header(net, raw_header)
             payload = await reader.read_exact(header.length) if header.length else b""
             msg = decode_message(net, header, payload)
-            for reply in mock_peer_react(net, blocks, msg, getdata_blocks):
+            for reply in mock_peer_react(
+                net, blocks, msg, getdata_blocks, relay
+            ):
                 to_node.put_nowait(encode_message(net, reply))
     except EOFError:
         pass
@@ -136,9 +198,13 @@ def dummy_peer_connect(
     blocks: list[Block],
     send_version_first: bool = True,
     getdata_blocks: list[Block] = (),
+    relay: "TxRelay | None" = None,
 ):
     """Transport factory injected as ``NodeConfig.connect``
-    (reference ``dummyPeerConnect`` NodeSpec.hs:94-133)."""
+    (reference ``dummyPeerConnect`` NodeSpec.hs:94-133).  ``relay`` gives
+    the remote tx-relay behavior (inv announcements + tx serving); tests
+    with several peers pass a distinct relay per dialed address by
+    dispatching on the ``connect`` hook's SockAddr."""
 
     @contextlib.asynccontextmanager
     async def factory():
@@ -147,7 +213,7 @@ def dummy_peer_connect(
         task = asyncio.get_running_loop().create_task(
             _fake_remote(
                 net, blocks, to_node, from_node, send_version_first,
-                getdata_blocks,
+                getdata_blocks, relay,
             )
         )
         try:

@@ -270,6 +270,42 @@ def test_event_name_has_no_grandfather():
     assert analyze_source(good) == []
 
 
+def test_name_layer_must_be_registered():
+    """ISSUE 5 satellite: the `<layer>` half of a metric/event name must
+    come from the registered set (rules.KNOWN_LAYERS) — a schema-shaped
+    name on a typo'd layer ("mempol.") is a finding, and the new
+    `mempool` layer is registered."""
+    from tpunode.analysis.rules import KNOWN_LAYERS
+
+    assert "mempool" in KNOWN_LAYERS
+    bad_metric = (
+        "from tpunode.metrics import metrics\n"
+        "def f():\n    metrics.inc('mempol.dedup_hits')\n"
+    )
+    bad_event = "def f(log):\n    log.emit('mempol.orphan')\n"
+    good = (
+        "from tpunode.metrics import metrics\n"
+        "def f(log):\n"
+        "    metrics.inc('mempool.dedup_hits')\n"
+        "    log.emit('mempool.orphan')\n"
+    )
+    (f,) = analyze_source(bad_metric)
+    assert f.rule == "metric-name" and "unregistered layer" in f.message
+    (f,) = analyze_source(bad_event)
+    assert f.rule == "event-name" and "unregistered layer" in f.message
+    assert analyze_source(good) == []
+
+
+def test_inc_batch_layer_must_be_registered():
+    src = (
+        "from tpunode.metrics import metrics\n"
+        "def f():\n"
+        "    metrics.inc_batch((('mempol.x', 1.0, None),))\n"
+    )
+    (f,) = analyze_source(src)
+    assert f.rule == "metric-name" and "unregistered layer" in f.message
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     out = analyze_source("def broken(:\n")
     assert [f.rule for f in out] == ["syntax-error"]

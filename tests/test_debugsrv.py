@@ -90,10 +90,36 @@ async def test_endpoints_round_trip():
         status, _, body = await _get(srv.port, "/nope")
         assert status == 404
         assert "/metrics" in json.loads(body)["endpoints"]
+        assert "/mempool" in json.loads(body)["endpoints"]
 
     # server closed: connecting now fails
     with pytest.raises(OSError):
         await asyncio.open_connection("127.0.0.1", srv.port)
+
+
+@pytest.mark.asyncio
+async def test_mempool_endpoint():
+    """/mempool serves the supplied snapshot callable; without one (no
+    mempool configured on the node) it reports {"enabled": false}."""
+    snap = {
+        "size": 3,
+        "orphans": 1,
+        "dedup_hits": 8,
+        "dedup_hit_rate": 0.6667,
+        "top_announcers": [{"peer": "a:1", "announcements": 12}],
+    }
+    async with DebugServer(
+        port=0, registry=Metrics(disabled=False), mempool=lambda: snap
+    ) as srv:
+        status, headers, body = await _get(srv.port, "/mempool")
+        assert status == 200
+        assert headers["content-type"].startswith("application/json")
+        assert json.loads(body) == snap
+
+    async with DebugServer(port=0, registry=Metrics(disabled=False)) as srv:
+        status, _, body = await _get(srv.port, "/mempool")
+        assert status == 200
+        assert json.loads(body) == {"enabled": False}
 
 
 @pytest.mark.asyncio

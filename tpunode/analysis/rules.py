@@ -361,6 +361,41 @@ def _thread_loop_affinity(ctx: FileContext) -> None:
 
 _METRIC_ATTRS = {"inc", "observe", "set_gauge"}
 
+# Registered telemetry layers: the `<layer>` half of every
+# `<layer>.<name>` metric/span/event literal must come from this set, so
+# a new subsystem's names are REGISTERED (here + OBSERVABILITY.md), not
+# invented ad hoc — a typo'd or unregistered layer ("mempol.size") would
+# otherwise ship a parallel namespace no dashboard ever reads.  ISSUE 5
+# adds `mempool` (the mempool subsystem's metric/event/span names).
+KNOWN_LAYERS = frozenset({
+    "asyncsan",   # runtime sanitizers (tpunode/asyncsan.py)
+    "bench",      # driver bench traces (bench.py)
+    "bus",        # Publisher/user bus (tpunode/actors.py)
+    "chain",      # header-chain actor (tpunode/chain.py)
+    "events",     # event-log self-metrics (tpunode/events.py)
+    "mempool",    # mempool subsystem (tpunode/mempool.py)
+    "node",       # node composition/ingest (tpunode/node.py)
+    "peer",       # wire sessions (tpunode/peer.py)
+    "peermgr",    # fleet manager (tpunode/peermgr.py)
+    "store",      # KV store (tpunode/store.py)
+    "trace",      # tracing internals (tpunode/tracectx.py)
+    "verify",     # batch verify engine (tpunode/verify/)
+    "watchdog",   # stall watchdog (tpunode/watchdog.py)
+})
+
+
+def _name_violation(name: str) -> "str | None":
+    """Schema complaint for a metric/span/event name literal, or None."""
+    if not NAME_SCHEMA_RE.match(name):
+        return f"{name!r} violates <layer>.<name> schema"
+    layer = name.split(".", 1)[0]
+    if layer not in KNOWN_LAYERS:
+        return (
+            f"{name!r} uses unregistered layer {layer!r} (register in "
+            "analysis.rules.KNOWN_LAYERS + OBSERVABILITY.md)"
+        )
+    return None
+
 
 def _literal(node: ast.AST) -> "str | None":
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
@@ -371,7 +406,7 @@ def _literal(node: ast.AST) -> "str | None":
 @rule(
     "metric-name",
     "metric/span name literal violates the `<layer>.<name>` schema "
-    "(^[a-z]+(\\.[a-z_]+)+$, OBSERVABILITY.md)",
+    "(^[a-z]+(\\.[a-z_]+)+$ with a registered layer, OBSERVABILITY.md)",
 )
 def _metric_name(ctx: FileContext) -> None:
     for node in ast.walk(ctx.tree):
@@ -395,24 +430,26 @@ def _metric_name(ctx: FileContext) -> None:
                     for el in arg.elts:
                         if isinstance(el, (ast.Tuple, ast.List)) and el.elts:
                             name = _literal(el.elts[0])
-                            if name is not None and not NAME_SCHEMA_RE.match(name):
+                            why = (
+                                _name_violation(name)
+                                if name is not None else None
+                            )
+                            if why is not None:
                                 ctx.report(
                                     "metric-name", el,
-                                    f"metric name {name!r} violates "
-                                    "<layer>.<name> schema",
+                                    f"metric name {why}",
                                 )
             continue
-        if hit is not None and not NAME_SCHEMA_RE.match(hit):
-            ctx.report(
-                "metric-name", node,
-                f"metric name {hit!r} violates <layer>.<name> schema",
-            )
+        if hit is not None:
+            why = _name_violation(hit)
+            if why is not None:
+                ctx.report("metric-name", node, f"metric name {why}")
 
 
 @rule(
     "event-name",
     "event-type literal at .emit() violates the `<layer>.<name>` schema "
-    "(no grandfathered names)",
+    "(registered layer required, no grandfathered names)",
 )
 def _event_name(ctx: FileContext) -> None:
     for node in ast.walk(ctx.tree):
@@ -423,8 +460,6 @@ def _event_name(ctx: FileContext) -> None:
             and node.args
         ):
             lit = _literal(node.args[0])
-            if lit is not None and not NAME_SCHEMA_RE.match(lit):
-                ctx.report(
-                    "event-name", node,
-                    f"event type {lit!r} violates <layer>.<name> schema",
-                )
+            why = _name_violation(lit) if lit is not None else None
+            if why is not None:
+                ctx.report("event-name", node, f"event type {why}")

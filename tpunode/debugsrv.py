@@ -8,6 +8,8 @@ the telemetry that already exists in-process:
 * ``GET /stats``    — the full stats snapshot as JSON (when supplied)
 * ``GET /events?n=100&type=watchdog.stall`` — recent structured events
 * ``GET /traces?n=8`` — recent + slowest finished trace trees (tracectx)
+* ``GET /mempool`` — mempool snapshot (size, orphans, dedup hit-rate,
+  top announcers) when the node runs one (``NodeConfig.mempool``)
 
 Off by default: enable with ``NodeConfig.debug_port`` (0 binds an
 ephemeral port — read it back from ``DebugServer.port``).  Binds
@@ -50,6 +52,7 @@ class DebugServer:
         host: str = "127.0.0.1",
         health: Optional[Callable[[], dict]] = None,
         stats: Optional[Callable[[], dict]] = None,
+        mempool: Optional[Callable[[], dict]] = None,
         registry: Optional[Metrics] = None,
         log_: Optional[EventLog] = None,
         tracer_: Optional[Tracer] = None,
@@ -58,6 +61,7 @@ class DebugServer:
         self.host = host
         self.health = health
         self.stats = stats
+        self.mempool = mempool
         self.registry = registry if registry is not None else metrics
         self.log = log_ if log_ is not None else events
         self.tracer = tracer_ if tracer_ is not None else tracer
@@ -161,6 +165,11 @@ class DebugServer:
                     "slowest": self.tracer.slowest(n),
                 },
             )
+        elif path == "/mempool":
+            if self.mempool is not None:
+                self._respond(writer, 200, self.mempool())
+            else:
+                self._respond(writer, 200, {"enabled": False})
         else:
             self._respond(
                 writer,
@@ -169,7 +178,7 @@ class DebugServer:
                     "error": f"no such endpoint: {path}",
                     "endpoints": [
                         "/metrics", "/health", "/stats",
-                        "/events?n=&type=", "/traces?n=",
+                        "/events?n=&type=", "/traces?n=", "/mempool",
                     ],
                 },
             )

@@ -32,6 +32,7 @@ from .actors import (
 from .chain import Chain, ChainBestBlock, ChainConfig, ChainEvent
 from .debugsrv import DebugServer
 from .events import StatsReporter, events
+from .mempool import Mempool, MempoolConfig
 from .metrics import metrics, percentiles
 from .trace import span
 from .tracectx import (
@@ -65,9 +66,13 @@ from .peer import (
 from .peermgr import PeerMgr, PeerMgrConfig, SockAddr
 from .store import KVStore
 from .wire import (
+    InvType,
     MsgAddr,
     MsgBlock,
     MsgHeaders,
+    MsgInv,
+    MsgNotFound,
+    MsgOther,
     MsgPing,
     MsgPong,
     MsgTx,
@@ -162,6 +167,13 @@ class NodeConfig:
     # north-star hook: when set, inbound tx/block signatures stream through
     # the batch verify engine and TxVerdict events reach the user bus
     verify: Optional[VerifyConfig] = None
+    # mempool subsystem (tpunode/mempool.py): inv-driven tx relay with
+    # fetch retry, admission dedup + verdict cache (each unique tx is
+    # verified exactly once), orphan pool, confirmation eviction.  None
+    # (the default) preserves the bare ingest path: pushes go straight
+    # to the verify engine, inv announcements are dropped (and counted
+    # under ``node.unhandled``).
+    mempool: Optional[MempoolConfig] = None
     # telemetry: seconds between StatsReporter snapshots (windowed rates +
     # ``node.stats`` events on the structured event log); 0 disables the loop
     stats_interval: float = 30.0
@@ -242,6 +254,18 @@ class Node:
         self.verify_engine: Optional[VerifyEngine] = (
             VerifyEngine(cfg.verify) if cfg.verify is not None else None
         )
+        self.mempool: Optional[Mempool] = (
+            Mempool(
+                cfg.mempool,
+                net=cfg.net,
+                submit=self._mempool_submit,
+                prevout_lookup=cfg.prevout_lookup,
+                pressure=self._ingest_pressure,
+                on_failure=self._component_failed,
+            )
+            if cfg.mempool is not None
+            else None
+        )
         self._verify_tasks = Supervisor(
             name="verify-ingest", on_death=self._verify_task_died
         )
@@ -314,6 +338,8 @@ class Node:
         if self.verify_engine is not None:
             await self._stack.enter_async_context(self.verify_engine)
             await self._stack.enter_async_context(self._verify_tasks)
+        if self.mempool is not None:
+            await self._stack.enter_async_context(self.mempool)
         await self._stack.enter_async_context(self.chain)
         await self._stack.enter_async_context(self.peer_mgr)
         self._tasks.link(self._chain_events(chain_sub), name="glue-chain")
@@ -325,9 +351,12 @@ class Node:
             )
             self._tasks.link(self._stats_reporter.run(), name="stats-reporter")
         if self.cfg.watchdog_interval > 0:
+            boxes = [self.chain.mailbox, self.peer_mgr.mailbox]
+            if self.mempool is not None:
+                boxes.append(self.mempool.mailbox)
             self._watchdog = Watchdog(
                 WatchdogConfig(interval=self.cfg.watchdog_interval),
-                mailboxes=[self.chain.mailbox, self.peer_mgr.mailbox],
+                mailboxes=boxes,
                 engine=self.verify_engine,
                 attributor=self._attributor,
             )
@@ -337,6 +366,9 @@ class Node:
                 port=self.cfg.debug_port,
                 health=self.health,
                 stats=self.stats,
+                mempool=(
+                    self.mempool.stats if self.mempool is not None else None
+                ),
             )
             await self._stack.enter_async_context(self.debug_server)
         log.info(
@@ -383,6 +415,9 @@ class Node:
         if self.verify_engine is not None:
             extra["verify_backlog"] = self.verify_engine.queue_depth()
             extra["verify_pending"] = self._verify_pending
+        if self.mempool is not None:
+            extra["mempool_size"] = self.mempool.size()
+            extra["mempool_orphans"] = self.mempool.orphan_count()
         return extra
 
     def _uptime(self) -> float:
@@ -468,6 +503,11 @@ class Node:
             },
             "peers": peers,
             "verify": verify,
+            "mempool": (
+                self.mempool.stats()
+                if self.mempool is not None
+                else {"enabled": False}
+            ),
             "events": events.counts(),
         }
 
@@ -476,6 +516,81 @@ class Node:
         metrics.inc("node.verify_errors")
         events.emit("verify.failure", where=where, error=str(error)[:300])
 
+    def _publish_verdict(self, v: TxVerdict) -> None:
+        """Every TxVerdict flows through here: the mempool's verdict
+        cache learns it (dedup: re-relays of this tx now cost zero
+        verify work) before the user bus does."""
+        if self.mempool is not None:
+            self.mempool.verdict(v.txid, v.valid, v.verdicts, v.error)
+        self.cfg.pub.publish(v)
+
+    def _mempool_submit(self, peer, tx) -> None:
+        """Mempool admission -> verify ingest.  Without a verify engine
+        the mempool still dedups/relays, but nothing verifies (entries
+        stay pending until evicted)."""
+        if self.verify_engine is not None:
+            self._submit_verify_tx(peer, tx)
+
+    def _mempool_shed(self, txs) -> None:
+        """Shed txs never get a TxVerdict: a mempool-admitted one must
+        not stay PENDING in the dedup cache (it would block its own
+        re-verification on a later re-push) — the error verdict makes
+        the mempool forget it, same as an engine failure."""
+        if self.mempool is None:
+            return
+        for tx in txs:
+            try:
+                txid = tx.txid
+            except Exception:
+                continue  # unparseable: was never admitted
+            self.mempool.verdict(txid, False, (), error="shed")
+
+    def _ingest_pressure(self) -> bool:
+        """Is the verify ingest saturated?  The mempool defers fetch
+        scheduling while true, so inv floods degrade into a stale
+        want-list instead of feeding the shed path."""
+        return (
+            len(self._tx_accum) >= self.MAX_TX_ACCUM // 2
+            or self._verify_pending >= self.MAX_VERIFY_PENDING
+        )
+
+    def _prevout_oracle(self):
+        """The prevout lookup the verify paths consult: the mempool's
+        unconfirmed outputs FIRST (a child spending an in-mempool parent
+        extracts with full prevout data), then the embedder's
+        ``cfg.prevout_lookup``.  None when neither exists."""
+        if self.mempool is None:
+            return self.cfg.prevout_lookup
+        if self.cfg.prevout_lookup is None:
+            # empty mempool + no embedder oracle: every lookup would
+            # miss — None lets block ingest skip the whole
+            # scan_prevouts + per-input lookup pass (hot path)
+            return self.mempool.lookup_prevout if self.mempool.size() else None
+        mp, ext = self.mempool.lookup_prevout, self.cfg.prevout_lookup
+
+        def combined(txid: bytes, vout: int):
+            res = mp(txid, vout)
+            return res if res is not None else ext(txid, vout)
+
+        return combined
+
+    def _count_unhandled(self, msg) -> None:
+        """A peer message the event router has no handler for: count it
+        (bounded label set — every decoded command is one of wire.py's
+        fixed message classes; unknown commands decode to MsgOther and
+        collapse into one label) so the next missing handler shows up in
+        /metrics instead of vanishing (ISSUE 5 satellite)."""
+        if isinstance(msg, MsgNotFound):
+            # not a missing handler: RPC replies are consumed by the
+            # requester's own subscription (peer.get_data), and healthy
+            # mempool fetch-retry traffic produces them steadily —
+            # counting them would bury a real gap in noise
+            return
+        cmd = "other" if isinstance(msg, MsgOther) else getattr(
+            msg, "command", "other"
+        )
+        metrics.inc("node.unhandled", labels={"cmd": cmd})
+
     async def _chain_events(self, sub) -> None:
         """Chain events -> PeerMgr best height + user bus
         (reference ``chainEvents`` Node.hs:130-142)."""
@@ -483,6 +598,10 @@ class Node:
             event = await sub.receive()
             if isinstance(event, ChainBestBlock):
                 self.peer_mgr.set_best(event.node.height)
+                if self.mempool is not None:
+                    # chain activity triggers mempool housekeeping
+                    # (orphan expiry, deferred fetch scheduling)
+                    self.mempool.chain_event(event)
             self.cfg.pub.publish(event)
 
     async def _peer_events(self, sub) -> None:
@@ -496,6 +615,9 @@ class Node:
                 chain.peer_connected(event.peer)
             elif isinstance(event, PeerDisconnected):
                 chain.peer_disconnected(event.peer)
+                if self.mempool is not None:
+                    # release in-flight fetch slots + announcer entries
+                    self.mempool.peer_gone(event.peer)
             elif isinstance(event, PeerMessage):
                 p, msg = event.peer, event.message
                 if isinstance(msg, MsgVersion):
@@ -510,12 +632,33 @@ class Node:
                     mgr.addrs(p, [na for _, na in msg.addrs])
                 elif isinstance(msg, MsgHeaders):
                     chain.headers(p, [h for h, _ in msg.headers])
+                elif self.mempool is not None and isinstance(msg, MsgInv):
+                    # tx announcements feed the mempool's want-list;
+                    # block invs are ignored (sync is headers-driven)
+                    self.mempool.invs(
+                        p,
+                        [
+                            iv.hash
+                            for iv in msg.invs
+                            if iv.type in (InvType.TX, InvType.WITNESS_TX)
+                        ],
+                    )
+                elif self.mempool is not None and isinstance(msg, MsgTx):
+                    # admission (dedup/orphan gate) before the engine
+                    self.mempool.tx_pushed(p, msg.tx)
                 elif self.verify_engine is not None and isinstance(msg, MsgTx):
                     self._submit_verify_tx(p, msg.tx)
                 elif self.verify_engine is not None and isinstance(msg, MsgBlock):
                     # the block stays lazy (wire.LazyBlock): the native path
-                    # never parses its txs in Python
+                    # never parses its txs in Python.  Confirmation
+                    # eviction rides the ingest path (txids are computed
+                    # there, natively when possible).
                     self._submit_verify(p, block=msg.block)
+                elif self.mempool is not None and isinstance(msg, MsgBlock):
+                    # no verify engine: still evict confirmed txs
+                    self.mempool.block_connected(msg.block)
+                else:
+                    self._count_unhandled(msg)
                 # every message refreshes liveness (reference Node.hs:173)
                 mgr.tickle(p)
             self.cfg.pub.publish(event)
@@ -569,13 +712,14 @@ class Node:
         self, region, bch: bool
     ) -> "tuple[Optional[list[int]], Optional[list[Optional[bytes]]]]":
         """External-oracle rows for a parsed region: per-input amounts and
-        scriptPubKeys from ``cfg.prevout_lookup``, aligned with the
-        region's flat input order (only rows the tx-level wants gate
-        marks are looked up).  Shared by block and mempool ingest."""
-        if self.cfg.prevout_lookup is None:
+        scriptPubKeys from the prevout oracle (mempool outputs first,
+        then ``cfg.prevout_lookup``), aligned with the region's flat
+        input order (only rows the tx-level wants gate marks are looked
+        up).  Shared by block and mempool ingest."""
+        lookup = self._prevout_oracle()
+        if lookup is None:
             return None, None
         pv_txids, pv_vouts, pv_wants = region.scan_prevouts(bch)
-        lookup = self.cfg.prevout_lookup
         ext: list[int] = [-1] * len(pv_wants)
         ext_scripts: list[Optional[bytes]] = [None] * len(pv_wants)
         for i in pv_wants.nonzero()[0]:
@@ -603,6 +747,7 @@ class Node:
         if len(self._tx_accum) >= self.MAX_TX_ACCUM:
             metrics.inc("node.verify_dropped")
             self._publish_shed(peer, 1)
+            self._mempool_shed([tx])
             # the shed decision ends this message's pipeline: close its
             # trace unretained (a flood of shed stubs must not evict the
             # traces that matter from the rings)
@@ -687,7 +832,7 @@ class Node:
                     except Exception as e:
                         self._verify_failure("engine", e)
                         for ti, (peer, _, _, _) in enumerate(batch):
-                            self.cfg.pub.publish(
+                            self._publish_verdict(
                                 TxVerdict(peer, items.txid(ti), False, (),
                                           items.stats(ti),
                                           error=f"engine: {e}")
@@ -699,7 +844,7 @@ class Node:
                     sig_slices = items.sig_slices()
                     for ti, (peer, _, _, _) in enumerate(batch):
                         vs = tuple(per_sig[sig_slices[ti]])
-                        self.cfg.pub.publish(
+                        self._publish_verdict(
                             TxVerdict(peer, items.txid(ti), all(vs), vs,
                                       items.stats(ti))
                         )
@@ -736,6 +881,8 @@ class Node:
         if self._verify_pending >= self.MAX_VERIFY_PENDING:
             metrics.inc("node.verify_dropped", n_txs)
             self._publish_shed(peer, n_txs)
+            if txs is not None:  # block txs are never mempool-admitted
+                self._mempool_shed(txs)
             _discard_active_trace()  # shed: pipeline ends here, unretained
             return
         self._verify_pending += 1
@@ -754,13 +901,16 @@ class Node:
                     # report it and kill the peer, never crash the router.
                     self._verify_pending -= 1
                     self._verify_failure("block-decode", e)
-                    self.cfg.pub.publish(
+                    self._publish_verdict(
                         TxVerdict(peer, b"", False, (), ExtractStats(),
                                   error=f"block decode: {e}")
                     )
                     peer.kill(CannotDecodePayload(f"block: {e}"))
                     _finish_active_trace()  # verdict published: trace ends
                     return
+            if block is not None and self.mempool is not None:
+                # python-path block connect: txs parsed above anyway
+                self.mempool.confirmed([tx.txid for tx in txs])
             coro = self._verify_txs(peer, txs)
         self._verify_tasks.add_child(coro, name="verify-txs")
 
@@ -799,7 +949,7 @@ class Node:
                 txids = [b""]
                 peer.kill(CannotDecodePayload(str(e)))
             for txid in txids:
-                self.cfg.pub.publish(
+                self._publish_verdict(
                     TxVerdict(peer, txid, False, (), ExtractStats(),
                               error=f"extract: {e}")
                 )
@@ -839,6 +989,13 @@ class Node:
                 except Exception as e:
                     _publish_extract_error(e)
                     return
+            if block is not None and self.mempool is not None:
+                # block connect: evict confirmed txs from the mempool.
+                # The txids come from the native extract — no Python
+                # parse — and arrive before the verdicts do.
+                self.mempool.confirmed(
+                    [items.txid(ti) for ti in range(items.n_txs)]
+                )
             metrics.inc("node.verify_txs", items.n_txs)
             metrics.inc("node.verify_inputs", int(items.tx_n_inputs.sum()))
             verdicts: list[bool] = []
@@ -850,7 +1007,7 @@ class Node:
                 except Exception as e:
                     self._verify_failure("engine", e)
                     for ti in range(items.n_txs):
-                        self.cfg.pub.publish(
+                        self._publish_verdict(
                             TxVerdict(peer, items.txid(ti), False, (),
                                       items.stats(ti), error=f"engine: {e}")
                         )
@@ -860,7 +1017,7 @@ class Node:
                 per_sig = items.combine(verdicts)
                 for ti, sl in enumerate(items.sig_slices()):
                     vs = tuple(per_sig[sl])
-                    self.cfg.pub.publish(
+                    self._publish_verdict(
                         TxVerdict(peer, items.txid(ti), all(vs), vs,
                                   items.stats(ti))
                     )
@@ -883,6 +1040,7 @@ class Node:
         # (amount + script) digests need (VERDICT r2 item 5 / r4 item 3).
         # Misses fall through to cfg.prevout_lookup.
         block_outs = intra_block_prevouts(txs) if len(txs) > 1 else {}
+        oracle = self._prevout_oracle()
         per_tx: list[tuple[Tx, ExtractStats, list, Optional[asyncio.Task]]] = []
         try:
             with span("node.extract"):
@@ -906,12 +1064,10 @@ class Node:
                             hit = block_outs.get(key)
                             if hit is not None:
                                 amt, script = hit
-                            elif self.cfg.prevout_lookup is not None and (
+                            elif oracle is not None and (
                                 wants_amount(tx, idx, self.cfg.net.bch)
                             ):
-                                amt, script = _prevout_info(
-                                    self.cfg.prevout_lookup(*key)
-                                )
+                                amt, script = _prevout_info(oracle(*key))
                             else:
                                 amt = script = None
                             if amt is not None:
@@ -931,7 +1087,7 @@ class Node:
                         except Exception:
                             txid = b""  # unparseable lazy tx: aggregate
                             peer.kill(CannotDecodePayload(f"tx: {e}"))
-                        self.cfg.pub.publish(
+                        self._publish_verdict(
                             TxVerdict(peer, txid, False, (), ExtractStats(),
                                       error=f"extract: {e}")
                         )
@@ -954,7 +1110,7 @@ class Node:
             # something different on this path than on the native one.
             for tx, stats, items, task in per_tx:
                 if task is None:
-                    self.cfg.pub.publish(
+                    self._publish_verdict(
                         TxVerdict(peer, tx.txid, True, (), stats)
                     )
                     continue
@@ -964,7 +1120,7 @@ class Node:
                     raise
                 except Exception as e:
                     self._verify_failure("engine", e)
-                    self.cfg.pub.publish(
+                    self._publish_verdict(
                         TxVerdict(peer, tx.txid, False, (), stats,
                                   error=f"engine: {e}")
                     )
@@ -972,7 +1128,7 @@ class Node:
                 # candidate verdicts -> per-signature (consensus walk)
                 with span("node.commit"):
                     per_sig = tuple(combine_verdicts(items, verdicts))
-                    self.cfg.pub.publish(
+                    self._publish_verdict(
                         TxVerdict(peer, tx.txid, all(per_sig), per_sig,
                                   stats)
                     )
