@@ -36,6 +36,18 @@ _MEMPOOL_OK = {
     "txs_per_s": 8.0,
 }
 
+# Canned healthy chaos-resilience result (the real subprocess path is
+# covered by test_chaos_worker_subprocess).
+_CHAOS_OK = {
+    "ok": True, "plan": "seed=1", "unique_txs": 16, "verdicts": 16,
+    "duplicate_verdicts": 0, "error_verdicts": 0, "stuck_pending": 0,
+    "verdict_conservation": True, "failovers": 3, "breaker_opens": 2,
+    "breaker_closes": 1, "breaker_state": "ready",
+    "device_path_restored": True, "recovery_p50_ms": 210.0,
+    "recovery_p99_ms": 250.0, "injections": {}, "task_leaks": 0,
+    "watchdog_stalls": 0, "wall_s": 1.0,
+}
+
 
 def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
     """Run bench.main() with a scripted _run_worker; returns (json, calls).
@@ -56,6 +68,9 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
             # the mempool section rides every run; scenarios that don't
             # script it get a canned healthy result
             return dict(_MEMPOOL_OK)
+        if mode == "--chaos":
+            # likewise for the ride-along resilience section (ISSUE 7)
+            return dict(_CHAOS_OK)
         raise AssertionError(f"unexpected worker call: {mode} {env_extra}")
 
     monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
@@ -92,10 +107,10 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
     except SystemExit as e:
         rc = e.code
     line = json.loads(out[-1])
-    # the ride-along --mempool section call is not part of the
+    # the ride-along --mempool/--chaos section calls are not part of the
     # probe/ladder/fallback logic the scripted scenarios pin call counts
-    # and env shapes on — drop it from the returned transcript
-    calls = [c for c in calls if c[0] != "--mempool"]
+    # and env shapes on — drop them from the returned transcript
+    calls = [c for c in calls if c[0] not in ("--mempool", "--chaos")]
     return line, calls, rc
 
 
@@ -436,6 +451,104 @@ def test_mempool_section_failure_labeled(monkeypatch):
     assert rc == 0
     assert line["value"] == 9.0  # headline survived
     assert line["mempool"] == {"ok": False, "error": "timed out after 150s"}
+
+
+def _is_chaos(mode, env):
+    return mode == "--chaos"
+
+
+def test_resilience_section_always_present(monkeypatch):
+    """ISSUE 7: the BENCH JSON carries a ``resilience`` section (failover
+    count, breaker transitions, verdict conservation, recovery latency)
+    on every run."""
+    bench = _load_bench()
+    line, calls, _ = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 1.0, "device": "tpu:v5e"}),
+        ],
+    )
+    rs = line["resilience"]
+    assert rs["ok"] is True
+    for key in ("verdict_conservation", "failovers", "breaker_opens",
+                "breaker_closes", "recovery_p50_ms", "recovery_p99_ms",
+                "device_path_restored"):
+        assert key in rs
+
+
+def test_resilience_section_worker_env_is_device_free(monkeypatch):
+    """The chaos scenario simulates its device in-process: the worker
+    must launch with jax pinned to cpu, never touching the tunnel."""
+    bench = _load_bench()
+    seen = []
+    monkeypatch.setattr(
+        bench, "_run_worker",
+        lambda mode, timeout, env=None: (
+            seen.append((mode, timeout, dict(env or {}))) or dict(_CHAOS_OK)
+        ),
+    )
+    assert bench._resilience_section()["ok"] is True
+    ((mode, timeout, env),) = seen
+    assert mode == "--chaos"
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert timeout == bench.T_CHAOS
+
+
+def test_resilience_section_failure_labeled(monkeypatch):
+    """A failed/timed-out chaos scenario is labeled in the artifact —
+    with whatever partial evidence it produced — never masked, and never
+    takes the headline down with it."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_chaos, {"ok": False, "error": "timed out after 150s",
+                         "failovers": 2, "breaker_opens": 1}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 9.0  # headline survived
+    rs = line["resilience"]
+    assert rs["ok"] is False
+    assert rs["error"] == "timed out after 150s"
+    assert rs["failovers"] == 2 and rs["breaker_opens"] == 1
+
+
+def test_chaos_worker_subprocess():
+    """The real ``--chaos`` worker end-to-end in a subprocess: verdict
+    conservation under the seeded fault plan, the breaker opens on the
+    injected device loss and the canary restores the device path, zero
+    leaks/stalls."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "bench.py"), "--chaos"],
+        env=dict(
+            os.environ,
+            TPUNODE_BENCH_CHAOS_TXS="12",
+            JAX_PLATFORMS="cpu",
+        ),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=150,
+    )
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["ok"] is True, line
+    assert line["verdict_conservation"] is True
+    assert line["verdicts"] == line["unique_txs"]
+    assert line["duplicate_verdicts"] == 0 and line["error_verdicts"] == 0
+    assert line["failovers"] >= 2  # every injected loss failed over
+    assert line["breaker_opens"] >= 1 and line["breaker_state"] == "ready"
+    assert line["device_path_restored"] is True
+    assert line["recovery_p50_ms"] > 0
+    assert line["task_leaks"] == 0 and line["watchdog_stalls"] == 0
 
 
 def test_mempool_worker_subprocess():

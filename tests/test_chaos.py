@@ -1,0 +1,682 @@
+"""chaos (ISSUE 7): deterministic fault injection + the self-healing it
+proves out.
+
+Three tiers:
+
+* units — plan parsing, seeded determinism, per-spec gating (p/n/after/
+  match), the zero-overhead-off contract (micro-bench in the style of
+  the span overhead test), and each injection point in isolation
+  (connection wrapper, mailbox delay/reorder, store writes).
+* engine — the circuit breaker state machine (direct + through a fake
+  device under injected device loss) and the dispatch ladder (verdicts,
+  never exceptions, for transient faults).
+* soak — the ISSUE 7 acceptance scenario: a full fakenet node + mempool
+  under a seeded fault plan (peer garbage + churn + mid-run device loss
+  + mailbox delivery chaos) asserting VERDICT CONSERVATION: every unique
+  submitted tx yields exactly one verdict, none with an error, no stuck
+  PENDING, zero task leaks, watchdog quiet — and the breaker demonstrably
+  re-opens the device path after the fault clears.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpunode.actors import Mailbox, Publisher, task_registry
+from tpunode.chaos import (
+    ChaosDeviceLoss,
+    ChaosFault,
+    ChaosPlan,
+    FaultSpec,
+    chaos,
+)
+from tpunode.events import events
+from tpunode.metrics import metrics
+from tpunode.verify.engine import CircuitBreaker, VerifyConfig, VerifyEngine
+
+from tests.test_engine import make_items
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """Every test leaves the process-wide registry disarmed."""
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# --- plan parsing & determinism ---------------------------------------------
+
+
+def test_plan_parse_roundtrip():
+    plan = ChaosPlan.parse(
+        "seed=42;peer.recv:garbage:p=0.25,after=3;"
+        "engine.dispatch:device_loss:match=tpu,n=2;"
+        "mailbox.send:delay:dur=0.01"
+    )
+    assert plan.seed == 42
+    assert [f.point for f in plan.faults] == [
+        "peer.recv", "engine.dispatch", "mailbox.send",
+    ]
+    g, d, m = plan.faults
+    assert (g.action, g.p, g.after) == ("garbage", 0.25, 3)
+    assert (d.action, d.match, d.n) == ("device_loss", "tpu", 2)
+    assert (m.action, m.dur) == ("delay", 0.01)
+    # describe() re-parses to the same plan (reproducible-seed contract)
+    again = ChaosPlan.parse(plan.describe())
+    assert again.seed == plan.seed
+    assert [f.describe() for f in again.faults] == [
+        f.describe() for f in plan.faults
+    ]
+
+
+def test_plan_parse_rejects_typos():
+    """A typo'd plan must fail loudly, never silently no-op."""
+    with pytest.raises(ValueError, match="unknown chaos point"):
+        ChaosPlan.parse("peer.rcv:drop")
+    with pytest.raises(ValueError, match="no action"):
+        ChaosPlan.parse("peer.recv:explode")
+    with pytest.raises(ValueError, match="unknown chaos option"):
+        ChaosPlan.parse("peer.recv:drop:bogus=1")
+    with pytest.raises(ValueError, match="bad chaos segment"):
+        ChaosPlan.parse("justapoint")
+    with pytest.raises(ValueError, match="outside"):
+        FaultSpec("peer.recv", "drop", p=1.5)
+
+
+def test_seeded_decisions_are_reproducible():
+    """Same plan, same seed -> the same fire/skip sequence and the same
+    garbage bytes: any failure scenario is a reproducible seed."""
+    spec = "seed=1234;peer.recv:garbage:p=0.4"
+
+    def run():
+        chaos.install(ChaosPlan.parse(spec))
+        fires = [chaos.decide("peer.recv", "x") is not None for _ in range(64)]
+        noise = chaos.garbage(32)
+        return fires, noise
+
+    f1, n1 = run()
+    f2, n2 = run()
+    assert f1 == f2
+    assert n1 == n2
+    assert True in f1 and False in f1  # p=0.4 actually gates
+    # a different seed diverges
+    chaos.install(ChaosPlan.parse("seed=99;peer.recv:garbage:p=0.4"))
+    f3 = [chaos.decide("peer.recv", "x") is not None for _ in range(64)]
+    assert f3 != f1
+
+
+def test_spec_gating_after_n_match():
+    chaos.install(
+        ChaosPlan.parse("seed=0;engine.dispatch:error:match=tpu,after=2,n=2")
+    )
+    # non-matching labels don't even consume eligible hits
+    assert chaos.decide("engine.dispatch", "cpu") is None
+    got = [
+        chaos.decide("engine.dispatch", "tpu") is not None for _ in range(6)
+    ]
+    # hits 1-2 skipped (after=2), hits 3-4 fire (n=2), then exhausted
+    assert got == [False, False, True, True, False, False]
+    st = chaos.stats()
+    assert st["enabled"] and st["faults"][0]["fired"] == 2
+
+
+def test_env_var_installs_plan():
+    """TPUNODE_CHAOS at import time arms the registry (subprocess: the
+    in-process module is already imported)."""
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "from tpunode.chaos import chaos;"
+            "print(chaos.on, chaos._plan.describe())",
+        ],
+        env=dict(os.environ, TPUNODE_CHAOS="seed=5;peer.recv:drop:p=0.5"),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    assert out.stdout.split() == ["True", "seed=5;peer.recv:drop:p=0.5"]
+
+
+# --- zero overhead / zero behavior change when off --------------------------
+
+
+@pytest.mark.asyncio
+async def test_chaos_off_send_overhead_micro():
+    """The acceptance bar (span-overhead-test style): with TPUNODE_CHAOS
+    unset every injection site is one attribute read + a never-taken
+    branch.  Mailbox.send carries the check on the hottest path — one
+    send must stay well under 10µs.  Early-exits on the first clean
+    batch; only fails if ~20 attempts never once get one (scheduler
+    noise on a busy shared box)."""
+    assert not chaos.on
+    mb: Mailbox = Mailbox(name="chaos-overhead")
+
+    def one_batch(n=2000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            mb.send(None)
+        dt = (time.perf_counter() - t0) / n
+        mb.drain_nowait()
+        return dt
+
+    one_batch(500)  # warm caches
+    best = min(one_batch() for _ in range(3))
+    attempts = 0
+    while best >= 10e-6 and attempts < 20:
+        attempts += 1
+        best = min(best, one_batch())
+    assert best < 10e-6, f"chaos-off send {best * 1e6:.2f}µs >= 10µs"
+
+
+def test_chaos_off_is_behavior_free():
+    """Off: decisions never fire, the connection wrapper is an identity,
+    and an armed-but-unrelated plan doesn't wrap peer transports."""
+    assert chaos.decide("peer.recv", "x") is None
+    sentinel = object()
+    assert chaos.wrap_connection(sentinel, "p") is sentinel
+    chaos.install(ChaosPlan.parse("seed=1;store.write:error:p=0.5"))
+    # armed, but no peer faults planned: transports stay unwrapped
+    assert chaos.wrap_connection(sentinel, "p") is sentinel
+
+
+# --- injection points in isolation ------------------------------------------
+
+
+class _FakeConn:
+    def __init__(self, chunks):
+        self.chunks = list(chunks)
+        self.written: list = []
+
+    async def read_chunk(self) -> bytes:
+        return self.chunks.pop(0) if self.chunks else b""
+
+    async def write(self, data: bytes) -> None:
+        self.written.append(bytes(data))
+
+
+@pytest.mark.asyncio
+async def test_connection_garbage_drop_partial():
+    payload = b"x" * 64
+    # garbage: same length, different (deterministic) bytes
+    chaos.install(ChaosPlan.parse("seed=7;peer.recv:garbage:n=1"))
+    conn = chaos.wrap_connection(_FakeConn([payload, payload]), "p1")
+    noisy = await conn.read_chunk()
+    assert len(noisy) == 64 and noisy != payload
+    assert await conn.read_chunk() == payload  # n=1: second read clean
+    # drop: immediate EOF
+    chaos.install(ChaosPlan.parse("seed=7;peer.recv:drop"))
+    conn = chaos.wrap_connection(_FakeConn([payload]), "p1")
+    assert await conn.read_chunk() == b""
+    # partial: a mid-frame cut — half the chunk, then EOF
+    chaos.install(ChaosPlan.parse("seed=7;peer.recv:partial"))
+    conn = chaos.wrap_connection(_FakeConn([payload, payload]), "p1")
+    assert await conn.read_chunk() == payload[:32]
+    assert await conn.read_chunk() == b""
+    # send-side garbage
+    chaos.install(ChaosPlan.parse("seed=9;peer.send:garbage:n=1"))
+    inner = _FakeConn([])
+    conn = chaos.wrap_connection(inner, "p1")
+    await conn.write(payload)
+    await conn.write(payload)
+    assert inner.written[0] != payload and len(inner.written[0]) == 64
+    assert inner.written[1] == payload
+
+
+@pytest.mark.asyncio
+async def test_mailbox_delay_and_reorder():
+    chaos.install(
+        ChaosPlan.parse("seed=2;mailbox.send:delay:dur=0.03,n=1,match=mbx")
+    )
+    mb: Mailbox = Mailbox(name="mbx")
+    mb.send("late")  # delayed 30ms
+    mb.send("prompt")
+    assert await asyncio.wait_for(mb.receive(), 2.0) == "prompt"
+    assert await asyncio.wait_for(mb.receive(), 2.0) == "late"
+
+    chaos.install(
+        ChaosPlan.parse("seed=2;mailbox.send:reorder:after=1,n=1,match=mbx")
+    )
+    mb2: Mailbox = Mailbox(name="mbx")
+    mb2.send("first")   # hit 1: skipped (after=1)
+    mb2.send("second")  # hit 2: fires — jumps the head
+    assert await mb2.receive() == "second"
+    assert await mb2.receive() == "first"
+    # an unrelated mailbox name never matches
+    other: Mailbox = Mailbox(name="other")
+    other.send(1)
+    other.send(2)
+    assert await other.receive() == 1
+
+
+def test_store_write_injection():
+    from tpunode.store import MemoryKV
+
+    chaos.install(ChaosPlan.parse("seed=3;store.write:error:n=1"))
+    kv = MemoryKV()
+    with pytest.raises(ChaosFault):
+        kv.put(b"k", b"v")
+    kv.put(b"k", b"v")  # n=1: the store heals
+    assert kv.get(b"k") == b"v"
+
+
+# --- circuit breaker --------------------------------------------------------
+
+
+def test_breaker_state_machine_direct():
+    br = CircuitBreaker(threshold=2, window=60.0, cooldown=0.05)
+    assert br.state == "ready" and br.allow_device()
+    br.record_failure("boom 1")
+    assert br.state == "degraded" and br.allow_device()
+    br.record_failure("boom 2")
+    assert br.state == "open" and br.opens == 1
+    assert not br.allow_device()  # cooldown not elapsed
+    time.sleep(0.06)
+    assert br.allow_device()  # open -> probing: this caller is the canary
+    assert br.state == "probing"
+    assert not br.allow_device()  # exactly one canary at a time
+    br.record_failure("canary failed")
+    assert br.state == "open"  # re-opened, cooldown restarted
+    time.sleep(0.06)
+    assert br.allow_device() and br.state == "probing"
+    br.record_success()
+    assert br.state == "ready" and br.closes == 1
+    st = br.stats()
+    assert st["state"] == "ready" and st["opens"] == 2
+    assert st["failures_in_window"] == 0
+
+
+def test_breaker_window_expires_failures():
+    br = CircuitBreaker(threshold=3, window=0.05, cooldown=1.0)
+    br.record_failure("a")
+    br.record_failure("b")
+    time.sleep(0.06)
+    br.record_failure("c")  # a+b aged out: still under threshold
+    assert br.state == "degraded"
+    assert br.stats()["failures_in_window"] == 1
+
+
+def test_breaker_success_clears_degraded():
+    br = CircuitBreaker(threshold=3, window=60.0, cooldown=1.0)
+    br.record_failure("x")
+    assert br.state == "degraded"
+    br.record_success()
+    assert br.state == "ready"
+    assert br.stats()["failures_in_window"] == 0
+
+
+# --- engine ladder + breaker under injected faults --------------------------
+
+
+def _fake_device(monkeypatch):
+    """Instant 'tpu' warmup + a kernel whose device path computes real
+    verdicts on the host: the engine runs its genuine tpu rung
+    (verify.tpu_items counted, breaker engaged) with no device."""
+    import tpunode.verify.kernel as K
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+
+    monkeypatch.setattr(
+        VerifyEngine, "_warmup_fn",
+        staticmethod(lambda bs, db=0: "tpu:chaos-sim"),
+    )
+    monkeypatch.setattr(
+        K, "dispatch_batch_tpu_raw",
+        lambda chunk, pad_to=None: (verify_batch_cpu(chunk.to_tuples()),
+                                    len(chunk)),
+    )
+    monkeypatch.setattr(K, "collect_verdicts", lambda arr, count: arr)
+
+
+@pytest.mark.asyncio
+async def test_ladder_failover_yields_verdicts_not_exceptions(monkeypatch):
+    """An injected batch failure on the cpu rung re-dispatches on the
+    oracle: waiters get correct verdicts, the failover is counted."""
+    chaos.install(
+        ChaosPlan.parse("seed=4;engine.dispatch:error:match=cpu,n=1")
+    )
+    before = metrics.get("verify.failovers")
+    items, expected = make_items(6, tamper_every=3)
+    async with VerifyEngine(
+        VerifyConfig(backend="cpu", max_wait=0.0)
+    ) as eng:
+        assert await eng.verify(items) == expected
+    assert metrics.get("verify.failovers") == before + 1
+
+
+@pytest.mark.asyncio
+async def test_device_loss_opens_breaker_then_canary_recovers(monkeypatch):
+    """Mid-run device loss (ISSUE 7 acceptance core): injected
+    ChaosDeviceLoss on the tpu rung fails batches over to cpu (verdicts
+    keep flowing), opens the breaker at the threshold, and — once the
+    fault clears — a half-open canary batch restores the device path
+    (state back to `ready`, verify.tpu_items counting again)."""
+    _fake_device(monkeypatch)
+    chaos.install(
+        ChaosPlan.parse("seed=5;engine.dispatch:device_loss:match=tpu,n=2")
+    )
+    failovers0 = metrics.get("verify.failovers")
+    cfg = VerifyConfig(
+        backend="auto", max_wait=0.0, min_tpu_batch=1, batch_size=64,
+        breaker_threshold=2, breaker_window=30.0, breaker_cooldown=0.1,
+    )
+    items, expected = make_items(8, tamper_every=3)
+    async with VerifyEngine(cfg) as eng:
+        assert eng._warmup_done.wait(10) and eng.device_state == "ready"
+        # two injected device losses: both batches still verify (ladder)
+        assert await eng.verify(items) == expected
+        assert eng.breaker.state == "degraded"
+        assert await eng.verify(items) == expected
+        assert eng.breaker.opens == 1
+        assert eng.breaker.state in ("open", "probing")
+        assert metrics.get("verify.failovers") == failovers0 + 2
+        # while open, traffic still verifies (cpu rung)
+        assert await eng.verify(items) == expected
+        # fault cleared (n=2 exhausted): drive batches until the canary
+        # closes the breaker
+        deadline = time.monotonic() + 10.0
+        while eng.breaker.state != "ready" and time.monotonic() < deadline:
+            assert await eng.verify(items) == expected
+            await asyncio.sleep(0.03)
+        assert eng.breaker.state == "ready"
+        assert eng.breaker.closes == 1
+        # the device path is genuinely back: tpu items count again
+        tpu0 = metrics.get("verify.tpu_items")
+        assert await eng.verify(items) == expected
+        assert metrics.get("verify.tpu_items") > tpu0
+        # breaker surfaces in stats()
+        st = eng.stats()
+        assert st["breaker"]["state"] == "ready"
+        assert st["breaker"]["opens"] == 1
+    rec = metrics.histogram("verify.breaker_recovery_seconds")
+    assert rec is not None and rec.count >= 1
+
+
+@pytest.mark.asyncio
+async def test_warmup_failure_reprobes_not_terminal(monkeypatch):
+    """ISSUE 7 motivation line: 'forever, if warmup fails' is gone — an
+    injected warmup failure puts the engine on cpu, then the retry timer
+    re-probes and the device comes up."""
+    monkeypatch.setattr(
+        VerifyEngine, "_warmup_fn",
+        staticmethod(lambda bs, db=0: "tpu:chaos-sim"),
+    )
+    chaos.install(ChaosPlan.parse("seed=6;engine.warmup:error:n=1"))
+    cfg = VerifyConfig(
+        backend="auto", max_wait=0.0, min_tpu_batch=10**9,
+        warmup_retry=0.1,
+    )
+    items, expected = make_items(3)
+    async with VerifyEngine(cfg) as eng:
+        assert eng._warmup_done.wait(10)
+        assert eng.device_state == "failed"
+        assert "chaos" in (eng._device_error or "")
+        # verdicts flow on cpu meanwhile
+        assert await eng.verify(items) == expected
+        # dispatches past the retry interval trigger the re-probe
+        deadline = time.monotonic() + 10.0
+        while eng.device_state != "ready" and time.monotonic() < deadline:
+            assert await eng.verify(items) == expected
+            await asyncio.sleep(0.03)
+        assert eng.device_state == "ready"
+        assert eng._device_kind == "tpu:chaos-sim"
+
+
+# --- the chaos soak (ISSUE 7 acceptance) ------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_chaos_soak_verdict_conservation(monkeypatch):
+    """Full fakenet node + mempool under a seeded fault plan: peer
+    garbage (one misbehaving pusher), random session drops (churn),
+    mailbox delivery chaos on the mempool actor, and a mid-run device
+    loss.  Asserts verdict conservation — every unique submitted tx
+    yields exactly ONE verdict, none carrying an error — plus zero stuck
+    PENDING, zero task leaks, a quiet watchdog, and the breaker
+    re-opening the device path after the fault clears."""
+    from benchmarks.txgen import gen_signed_txs
+    from tests.fakenet import TxRelay, dummy_peer_connect, poll_until
+    from tests.fixtures import all_blocks
+    from tpunode import BCH_REGTEST, Node, NodeConfig, TxVerdict
+    from tpunode.mempool import MempoolConfig
+    from tpunode.store import MemoryKV
+
+    _fake_device(monkeypatch)
+    net = BCH_REGTEST
+    txs = gen_signed_txs(32, inputs_per_tx=1, seed=0xC7A05)
+    unique = {t.txid for t in txs}
+    blocks = all_blocks()
+    relays = {
+        # two serving announcers carry the full set (a banned/garbled
+        # peer never strands a tx)
+        18801: TxRelay(txs, announce=True, mode="serve"),
+        18802: TxRelay(txs, announce=True, mode="serve"),
+        # one firehose pusher — also the garbage target below
+        18803: TxRelay(announce=False, push=txs),
+    }
+    chaos.install(ChaosPlan.parse(
+        "seed=1337;"
+        "peer.recv:garbage:p=0.05,n=2,match=18803;"  # misbehaving pusher
+        "peer.recv:drop:p=0.02,n=3;"                 # random churn
+        "mailbox.send:delay:p=0.05,dur=0.005,match=mempool;"
+        "mailbox.send:reorder:p=0.05,n=4,match=mempool;"
+        "engine.dispatch:device_loss:match=tpu,after=1,n=3"
+    ))
+    leaks0 = events.counts().get("asyncsan.task_leak", 0)
+    stalls0 = events.counts().get("watchdog.stall", 0)
+    pub = Publisher(name="chaos-soak", maxsize=None)
+    cfg = NodeConfig(
+        net=net,
+        store=MemoryKV(),
+        pub=pub,
+        peers=[f"[::1]:{port}" for port in relays],
+        discover=False,
+        max_peers=len(relays),
+        connect=lambda sa: dummy_peer_connect(
+            net, blocks, relay=relays.get(sa[1])
+        ),
+        verify=VerifyConfig(
+            backend="auto", max_wait=0.005, batch_size=64,
+            min_tpu_batch=1, breaker_threshold=2, breaker_cooldown=0.2,
+        ),
+        mempool=MempoolConfig(tick_interval=0.05),
+    )
+    verdict_counts: dict = {}
+    async with pub.subscription() as sub:
+        async with Node(cfg) as node:
+            eng = node.verify_engine
+            assert eng is not None
+            deadline = time.monotonic() + 60.0
+            while unique - set(verdict_counts) and time.monotonic() < deadline:
+                try:
+                    ev = await asyncio.wait_for(sub.receive(), 5.0)
+                except asyncio.TimeoutError:
+                    continue
+                if isinstance(ev, TxVerdict):
+                    verdict_counts[ev.txid] = verdict_counts.get(
+                        ev.txid, 0
+                    ) + 1
+                    assert ev.error is None, f"waiter saw a fault: {ev}"
+            # -- verdict conservation ---------------------------------
+            assert not (unique - set(verdict_counts)), (
+                f"{len(unique - set(verdict_counts))} txs never got a "
+                "verdict"
+            )
+            dupes = {k: v for k, v in verdict_counts.items() if v != 1}
+            assert not dupes, f"non-singular verdicts: {len(dupes)}"
+            # -- no stuck PENDING (poll: the mempool actor processes
+            # the verdicts we just observed asynchronously, and chaos
+            # is delaying its mailbox on purpose) ---------------------
+            assert node.mempool is not None
+            await poll_until(
+                lambda: all(
+                    node.mempool.state(t) != "pending" for t in unique
+                ),
+                timeout=15.0,
+                what="mempool verdicts drained (no stuck PENDING)",
+            )
+            # -- mid-run device loss: keep traffic flowing until the
+            # remaining injected losses fire (soak traffic may have
+            # coalesced into few dispatches), the breaker opens, and —
+            # once the fault plan is exhausted — the half-open canary
+            # restores the device path.  Every one of these batches must
+            # verify: open/degraded states serve from the cpu rungs.
+            items, expected = make_items(4, tamper_every=2)
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                assert await eng.verify(items) == expected
+                if eng.breaker.opens >= 1 and eng.breaker.state == "ready":
+                    break
+                await asyncio.sleep(0.02)
+            assert eng.breaker.opens >= 1, chaos.stats()
+            assert eng.breaker.state == "ready"
+            tpu0 = metrics.get("verify.tpu_items")
+            assert await eng.verify(items) == expected
+            assert metrics.get("verify.tpu_items") > tpu0
+            assert node.health()["verify_breaker"] == "ready"
+    # -- zero task leaks, quiet watchdog -------------------------------
+    assert task_registry.report_leaks() == []
+    assert events.counts().get("asyncsan.task_leak", 0) == leaks0
+    assert events.counts().get("watchdog.stall", 0) == stalls0
+    # the run's artifact shows what was injected
+    st = chaos.stats()
+    assert any(f["fired"] for f in st["faults"]), st
+
+
+# --- peer-fleet hardening (ISSUE 7 part 3) ----------------------------------
+
+
+@pytest.mark.asyncio
+async def test_peermgr_backoff_and_timed_ban():
+    """A session death backs its address off (decorrelated jitter), a
+    protocol violation escalates to a timed ban, and a completed
+    handshake resets the dial backoff (not the misbehavior score)."""
+    from tpunode.peermgr import PeerMgr, PeerMgrConfig, _AddrState
+    from tpunode.peer import PeerMisbehaving
+    from tpunode.params import BCH_REGTEST
+    from tpunode.wire import NetworkAddress
+
+    mgr = PeerMgr(
+        PeerMgrConfig(
+            max_peers=2,
+            peers=[],
+            discover=False,
+            address=NetworkAddress.from_host_port("::1", 0),
+            net=BCH_REGTEST,
+            pub=Publisher(name="t", maxsize=None),
+            timeout=5.0,
+            max_peer_life=60.0,
+            connect=lambda sa: None,
+            dial_backoff_base=0.2,
+            dial_backoff_cap=5.0,
+            ban_base=3.0,
+            ban_cap=30.0,
+        )
+    )
+
+    class _Dead:
+        def __init__(self, exc):
+            self._exc = exc
+
+        def done(self):
+            return True
+
+        def cancelled(self):
+            return False
+
+        def exception(self):
+            return self._exc
+
+    from tpunode.peermgr import OnlinePeer
+    from tpunode.peer import Peer
+
+    def dead_peer(addr, exc):
+        p = Peer(Mailbox(name="x"), mgr.cfg.pub, f"{addr[0]}:{addr[1]}")
+        o = OnlinePeer(
+            address=addr, peer=p, task=_Dead(exc), nonce=1,
+            connected=time.monotonic(), tickled=time.monotonic(),
+        )
+        mgr._peers.append(o)
+        return o
+
+    now = time.monotonic()
+    # ordinary churn: backoff, no ban
+    o1 = dead_peer(("10.0.0.1", 1), OSError("conn reset"))
+    mgr._process_peer_offline(o1.task)
+    st = mgr._addr_state[("10.0.0.1", 1)]
+    assert st.failures == 1 and st.not_before > now
+    assert st.banned_until == 0.0
+    assert not mgr._dialable(("10.0.0.1", 1), time.monotonic())
+    assert ("10.0.0.1", 1) in mgr._addresses  # back in the book
+    # misbehavior: timed ban, escalating with the score
+    o2 = dead_peer(("10.0.0.2", 2), PeerMisbehaving("garbage"))
+    mgr._process_peer_offline(o2.task)
+    st2 = mgr._addr_state[("10.0.0.2", 2)]
+    assert st2.score == 1
+    first_ban = st2.banned_until - time.monotonic()
+    assert 2.0 < first_ban <= 3.1
+    o2b = dead_peer(("10.0.0.2", 2), PeerMisbehaving("garbage again"))
+    mgr._process_peer_offline(o2b.task)
+    assert st2.score == 2
+    assert st2.banned_until - time.monotonic() > first_ban  # escalated
+    # success reset: backoff cleared, score kept
+    st2.backoff = 4.0
+    st2.not_before = time.monotonic() + 4.0
+    o3 = dead_peer(("10.0.0.2", 2), None)
+    o3.online = True
+    mgr._announce_peer(o3)
+    assert st2.backoff == 0.0 and st2.not_before == 0.0
+    assert st2.score == 2
+    mgr._peers.clear()
+    stats = mgr.backoff_stats()
+    assert stats["timed_bans"] >= 2 and stats["tracked"] >= 2
+
+
+@pytest.mark.asyncio
+async def test_peermgr_reconnect_storm_cap():
+    """More dials than the burst cap inside one window are deferred back
+    into the address book, not dialed."""
+    from tpunode.peermgr import PeerMgr, PeerMgrConfig
+    from tpunode.params import BCH_REGTEST
+    from tpunode.wire import NetworkAddress
+    from tests.fakenet import silent_peer_connect
+
+    mgr = PeerMgr(
+        PeerMgrConfig(
+            max_peers=10,
+            peers=[],
+            discover=False,
+            address=NetworkAddress.from_host_port("::1", 0),
+            net=BCH_REGTEST,
+            pub=Publisher(name="t", maxsize=None),
+            timeout=5.0,
+            max_peer_life=60.0,
+            connect=lambda sa: silent_peer_connect(),
+            reconnect_burst=2,
+            reconnect_window=30.0,
+        )
+    )
+    capped0 = metrics.get("peermgr.reconnects_capped")
+    try:
+        for i in range(1, 5):
+            mgr._connect_peer((f"10.9.9.{i}", 1000 + i))
+        assert len(mgr._peers) == 2  # the burst cap held
+        assert metrics.get("peermgr.reconnects_capped") == capped0 + 2
+        # the capped addresses went back into the book, deferred
+        assert ("10.9.9.3", 1003) in mgr._addresses
+        assert not mgr._dialable(("10.9.9.3", 1003), time.monotonic())
+    finally:
+        for o in mgr._peers:
+            o.task.cancel()
+        await asyncio.gather(
+            *(o.task for o in mgr._peers), return_exceptions=True
+        )
+        await mgr.supervisor.aclose()

@@ -368,6 +368,80 @@ def test_with_mosaic_fallback_contract(monkeypatch):
     assert K.pallas_broken()
 
 
+@pytest.mark.asyncio
+async def test_all_rungs_failure_fails_only_that_batch(monkeypatch):
+    """ISSUE 7 satellite: the waiter-failure path (a batch that fails on
+    EVERY ladder rung) fails only that batch's waiters, and the dispatch
+    loop survives to serve the next batch."""
+    eng = VerifyEngine(VerifyConfig(backend="oracle", max_wait=0.0))
+    calls = {"n": 0}
+    orig = eng._dispatch_multi
+
+    def flaky(payloads, target=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("all rungs down")
+        return orig(payloads, target)
+
+    monkeypatch.setattr(eng, "_dispatch_multi", flaky)
+    items, expected = make_items(4, tamper_every=2)
+    async with eng:
+        with pytest.raises(RuntimeError, match="all rungs down"):
+            await eng.verify(items)
+        # the queue loop survived: the next batch verifies normally
+        assert await asyncio.wait_for(eng.verify(items), 10) == expected
+    assert calls["n"] == 2
+
+
+@pytest.mark.asyncio
+async def test_concurrent_waiters_all_fail_then_recover(monkeypatch):
+    """Coalesced-batch flavor of the waiter-failure pin: every waiter of
+    the failed batch gets the exception (none left pending), then the
+    engine keeps serving."""
+    eng = VerifyEngine(
+        VerifyConfig(backend="oracle", max_wait=0.05, batch_size=64)
+    )
+    calls = {"n": 0}
+    orig = eng._dispatch_multi
+
+    def flaky(payloads, target=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        return orig(payloads, target)
+
+    monkeypatch.setattr(eng, "_dispatch_multi", flaky)
+    items1, _ = make_items(3)
+    items2, exp2 = make_items(2, tamper_every=1)
+    async with eng:
+        f1 = asyncio.ensure_future(eng.verify(items1))
+        f2 = asyncio.ensure_future(eng.verify(items2))
+        r1, r2 = await asyncio.gather(f1, f2, return_exceptions=True)
+        assert isinstance(r1, RuntimeError) and isinstance(r2, RuntimeError)
+        assert await eng.verify(items2) == exp2
+
+
+@pytest.mark.asyncio
+async def test_rung_failure_fails_over_within_dispatch(monkeypatch):
+    """ISSUE 7 ladder: a cpu-rung crash re-dispatches the same batch on
+    the python oracle — waiters see verdicts, not the exception."""
+    eng = VerifyEngine(VerifyConfig(backend="cpu", max_wait=0.0))
+    seen = []
+    orig = eng._run_backend
+
+    def flaky(rung, payloads, total):
+        seen.append(rung)
+        if rung == "cpu":
+            raise RuntimeError("native engine crashed")
+        return orig(rung, payloads, total)
+
+    monkeypatch.setattr(eng, "_run_backend", flaky)
+    items, expected = make_items(6, tamper_every=3)
+    async with eng:
+        assert await eng.verify(items) == expected
+    assert seen[-1] == "oracle"
+
+
 def test_verify_config_field_formulation_knob():
     """VerifyConfig.field_mul/field_sqr (ISSUE 4) apply the process-wide
     limb-product formulation at engine construction, so the first device

@@ -40,6 +40,7 @@ import time
 import weakref
 from collections import deque
 
+from .chaos import chaos
 from .compat import timeout as _timeout
 from .events import events
 from .metrics import metrics
@@ -227,6 +228,14 @@ class Mailbox(Generic[T]):
         act = _active_trace.get()
         if act is not None:
             item = _Traced(item, act)  # type: ignore[assignment]
+        if chaos.on:  # injected delivery faults (tpunode/chaos.py)
+            spec = chaos.decide("mailbox.send", self.name)
+            if spec is not None and self._chaos_deliver(spec, item):
+                return
+        self._put(item)
+
+    def _put(self, item) -> None:
+        """Enqueue a (possibly trace-wrapped) item: the delivery core."""
         if self.maxsize is not None and self._queue.qsize() >= self.maxsize:
             try:
                 self._queue.get_nowait()
@@ -238,6 +247,32 @@ class Mailbox(Generic[T]):
             metrics.inc("bus.dropped")
         self._queue.put_nowait(item)
         self._times.append(time.monotonic())
+
+    def _chaos_deliver(self, spec, item) -> bool:
+        """Apply an injected delivery fault; True = chaos owns delivery.
+        ``delay`` re-enqueues after ``dur`` seconds via the running loop;
+        ``reorder`` jumps this message ahead of the current queue head.
+        Both preserve at-least-once delivery — chaos perturbs timing and
+        order, never drops actor mail (mailboxes are the crash-only
+        control plane; loss belongs to the socket points)."""
+        if spec.action == "delay":
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return False  # no loop to schedule on: deliver normally
+            loop.call_later(spec.dur, self._put, item)
+            return True
+        if spec.action == "reorder":
+            try:
+                prev = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                return False  # nothing to swap with
+            if self._times:
+                self._times.popleft()
+            self._put(item)  # the newcomer jumps the head
+            self._put(prev)
+            return True
+        return False
 
     def _unwrap(self, item) -> T:
         """Pop-side of the trace envelope: re-activate the carried trace

@@ -372,6 +372,7 @@ KNOWN_LAYERS = frozenset({
     "bench",      # driver bench traces (bench.py)
     "bus",        # Publisher/user bus (tpunode/actors.py)
     "chain",      # header-chain actor (tpunode/chain.py)
+    "chaos",      # fault injection (tpunode/chaos.py, ISSUE 7)
     "events",     # event-log self-metrics (tpunode/events.py)
     "mempool",    # mempool subsystem (tpunode/mempool.py)
     "node",       # node composition/ingest (tpunode/node.py)

@@ -450,6 +450,13 @@ class Node:
                 if self.verify_engine is not None
                 else "off"
             ),
+            # device-path breaker (ISSUE 7): ready/degraded/open/probing
+            # once the device is warm, else the warmup state
+            "verify_breaker": (
+                self.verify_engine.breaker_state
+                if self.verify_engine is not None
+                else None
+            ),
         }
 
     def stats(self) -> dict:
@@ -502,6 +509,7 @@ class Node:
                 "reorgs": metrics.get("chain.reorgs"),
             },
             "peers": peers,
+            "peermgr": self.peer_mgr.backoff_stats(),
             "verify": verify,
             "mempool": (
                 self.mempool.stats()

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol, Union
 
 from .actors import Mailbox, Publisher, spawn_supervised
+from .chaos import chaos
 from .compat import timeout as _timeout
 from .metrics import metrics
 from .params import Network
@@ -368,6 +369,8 @@ async def run_peer(cfg: PeerConfig, peer: Peer, inbox: Mailbox) -> None:
     """
     log.debug("[Peer] %s: session starting", cfg.label)
     async with cfg.connect() as conn:
+        if chaos.on:  # fault injection on the transport (tpunode/chaos.py)
+            conn = chaos.wrap_connection(conn, cfg.label)
         # owner=peer: both loops are cancelled+awaited in the finally
         # below, but the registry still scopes them to this session so a
         # concurrent node's shutdown never misreads them as leaks

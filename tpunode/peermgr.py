@@ -17,6 +17,7 @@ import logging
 import random
 import statistics
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Union
 
@@ -95,6 +96,39 @@ class PeerMgrConfig:
     max_peer_life: float
     # injectable transport: SockAddr -> WithConnection (reference Node.hs:95)
     connect: Callable[[SockAddr], WithConnection]
+    # -- fleet hardening (ISSUE 7) ------------------------------------------
+    # Per-address dial backoff: decorrelated jitter
+    # (next = min(cap, uniform(base, 3 * prev))), reset on a completed
+    # handshake — a dead or flapping address cannot monopolize dial slots.
+    dial_backoff_base: float = 0.5
+    dial_backoff_cap: float = 30.0
+    # Misbehavior-score escalation: each protocol-violation death (the
+    # _BAN_ERRORS classes) bumps the address's score and bans it for
+    # min(ban_cap, ban_base * 2**(score-1)) seconds — timed bans, not
+    # one-shot kills, so a garbage-spewing peer stays gone for a while
+    # but a once-glitchy one gets another chance.
+    ban_base: float = 10.0
+    ban_cap: float = 600.0
+    # Reconnect-storm cap: at most `reconnect_burst` dials per
+    # `reconnect_window` seconds; excess dials are deferred back into the
+    # address book.  0 = auto (max(8, 2 * max_peers)); negative disables.
+    reconnect_burst: int = 0
+    reconnect_window: float = 1.0
+
+
+@dataclass
+class _AddrState:
+    """Per-address dial/ban bookkeeping (ISSUE 7 fleet hardening).  The
+    reference evicts misbehavers one-shot (PeerMgr.hs kills and forgets);
+    here an address carries its dial backoff and misbehavior score across
+    sessions so churn and garbage degrade that address's slot, not the
+    fleet's."""
+
+    backoff: float = 0.0  # current decorrelated-jitter backoff (seconds)
+    not_before: float = 0.0  # monotonic: no dial before this
+    failures: int = 0  # consecutive session deaths (reset on handshake)
+    score: int = 0  # misbehavior incidents (never auto-reset)
+    banned_until: float = 0.0  # monotonic: timed ban horizon
 
 
 @dataclass
@@ -188,6 +222,14 @@ class PeerMgr:
         self._best_height = 0
         self._addresses: set[SockAddr] = set()
         self._peers: list[OnlinePeer] = []
+        # ISSUE 7: per-address backoff/ban state + the dial-rate window
+        self._addr_state: dict[SockAddr, _AddrState] = {}
+        self._dial_times: deque[float] = deque()
+        self._burst: Optional[int] = (
+            None
+            if cfg.reconnect_burst < 0
+            else (cfg.reconnect_burst or max(8, 2 * cfg.max_peers))
+        )
         self._tasks = LinkedTasks(name="peermgr", on_failure=on_failure)
         self._started = asyncio.Event()
 
@@ -306,6 +348,13 @@ class PeerMgr:
 
     def _announce_peer(self, o: OnlinePeer) -> None:
         # reference logConnectedPeers (PeerMgr.hs:285-290)
+        st = self._addr_state.get(o.address)
+        if st is not None:
+            # success reset (ISSUE 7): a completed handshake clears the
+            # dial backoff — misbehavior score deliberately persists
+            st.backoff = 0.0
+            st.not_before = 0.0
+            st.failures = 0
         n_online = sum(1 for x in self._peers if x.online)
         log.info(
             "[PeerMgr] connected to peer %s (%d online)", o.peer.label, n_online
@@ -395,15 +444,50 @@ class PeerMgr:
             "peer.disconnect", peer=o.peer.label, online=o.online,
             error=repr(exc) if exc else None,
         )
+        now = time.monotonic()
+        st = self._addr_state.setdefault(o.address, _AddrState())
+        # Dial backoff with decorrelated jitter (ISSUE 7): every session
+        # death backs the address off; repeated failures grow the window
+        # up to the cap, a completed handshake resets it (_announce_peer).
+        st.failures += 1
+        st.backoff = min(
+            self.cfg.dial_backoff_cap,
+            random.uniform(
+                self.cfg.dial_backoff_base,
+                max(self.cfg.dial_backoff_base, 3.0 * st.backoff),
+            ),
+        )
+        st.not_before = now + st.backoff
+        metrics.inc("peermgr.backoffs")
+        metrics.observe("peermgr.backoff_seconds", st.backoff)
+        events.emit(
+            "peermgr.backoff", peer=o.peer.label,
+            seconds=round(st.backoff, 3), failures=st.failures,
+        )
         if isinstance(exc, _BAN_ERRORS):
+            # Misbehavior-score escalation to a TIMED ban (ISSUE 7): the
+            # address sits out min(cap, base * 2**(score-1)) seconds —
+            # repeat offenders sit out exponentially longer.
+            st.score += 1
+            ban = min(
+                self.cfg.ban_cap,
+                self.cfg.ban_base * (2.0 ** min(st.score - 1, 16)),
+            )
+            st.banned_until = now + ban
             metrics.inc("peermgr.bans")
+            metrics.inc("peermgr.timed_bans")
             events.emit(
                 "peer.ban", peer=o.peer.label,
                 reason=type(exc).__name__, error=str(exc),
+                ban_seconds=round(ban, 1), score=st.score,
             )
         if o.online:
             self.cfg.pub.publish(PeerDisconnected(o.peer))
         self._peers.remove(o)
+        # the address returns to the book behind its backoff/ban horizon
+        # (gossip addresses used to vanish on death; static peers were
+        # re-resolved anyway)
+        self._addresses.add(o.address)
         # evict the dead peer's labeled series (peer.msgs{peer=},
         # peer.rtt{peer=}): churn through thousands of addresses must not
         # grow the registry without bound
@@ -432,22 +516,74 @@ class PeerMgr:
             return
         self._addresses.add(sa)
 
+    def _dialable(self, sa: SockAddr, now: float) -> bool:
+        """Is this address past its backoff and ban horizons (ISSUE 7)?"""
+        st = self._addr_state.get(sa)
+        return st is None or (now >= st.not_before and now >= st.banned_until)
+
     async def _get_new_peer(self) -> Optional[SockAddr]:
         """Random unconnected candidate (reference ``getNewPeer``
-        PeerMgr.hs:505-520)."""
+        PeerMgr.hs:505-520), skipping addresses still backing off or
+        serving a timed ban — those stay in the book for later."""
         await self._load_peers()
-        while self._addresses:
-            sa = random.choice(tuple(self._addresses))
+        now = time.monotonic()
+        eligible = [sa for sa in self._addresses if self._dialable(sa, now)]
+        while eligible:
+            sa = random.choice(eligible)
+            eligible.remove(sa)
             self._addresses.discard(sa)
             if not any(o.address == sa for o in self._peers):
                 return sa
         return None
+
+    # Address-state pruning bound: churn through thousands of gossip
+    # addresses must not grow _addr_state without limit (the same
+    # discipline as metrics.drop_label on peer churn).
+    _ADDR_STATE_MAX = 4096
+
+    def _prune_addr_state(self, now: float) -> None:
+        if len(self._addr_state) <= self._ADDR_STATE_MAX:
+            return
+        for sa in [
+            sa
+            for sa, st in self._addr_state.items()
+            if now >= st.not_before and now >= st.banned_until
+            and st.score == 0
+        ]:
+            del self._addr_state[sa]
 
     def _connect_peer(self, sa: SockAddr) -> None:
         """Launch one supervised peer session (reference ``connectPeer``
         PeerMgr.hs:522-589)."""
         if any(o.address == sa for o in self._peers):
             return
+        now = time.monotonic()
+        if self._burst is not None:
+            # Reconnect-storm cap (ISSUE 7): a mass disconnect (network
+            # blip, remote restart) must not translate into an immediate
+            # synchronized dial storm.  Excess dials defer back into the
+            # address book behind a one-window not_before.
+            while (
+                self._dial_times
+                and now - self._dial_times[0] > self.cfg.reconnect_window
+            ):
+                self._dial_times.popleft()
+            if len(self._dial_times) >= self._burst:
+                metrics.inc("peermgr.reconnects_capped")
+                events.emit(
+                    "peermgr.reconnect_capped",
+                    address=f"{sa[0]}:{sa[1]}",
+                    burst=self._burst,
+                    window=self.cfg.reconnect_window,
+                )
+                st = self._addr_state.setdefault(sa, _AddrState())
+                st.not_before = max(
+                    st.not_before, now + self.cfg.reconnect_window
+                )
+                self._addresses.add(sa)
+                return
+            self._dial_times.append(now)
+        self._prune_addr_state(now)
         label = f"[{sa[0]}]:{sa[1]}" if ":" in sa[0] else f"{sa[0]}:{sa[1]}"
         log.debug("[PeerMgr] connecting to %s", label)
         metrics.inc("peermgr.connect_attempts")
@@ -552,6 +688,22 @@ class PeerMgr:
 
     def get_online_peer(self, p: Peer) -> Optional[OnlinePeer]:
         return self._find_peer(p)
+
+    def backoff_stats(self) -> dict:
+        """Fleet-hardening snapshot (ISSUE 7) for Node.stats(): how many
+        addresses are backing off or banned right now, plus the lifetime
+        escalation counters."""
+        now = time.monotonic()
+        sts = self._addr_state.values()
+        return {
+            "addresses": len(self._addresses),
+            "tracked": len(self._addr_state),
+            "backing_off": sum(1 for s in sts if s.not_before > now),
+            "banned": sum(1 for s in sts if s.banned_until > now),
+            "backoffs": metrics.get("peermgr.backoffs"),
+            "timed_bans": metrics.get("peermgr.timed_bans"),
+            "capped_dials": metrics.get("peermgr.reconnects_capped"),
+        }
 
 
 def _srv(net: Network) -> int:

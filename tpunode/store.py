@@ -25,6 +25,7 @@ import struct
 import time
 from typing import Iterator, Optional, Protocol, Sequence
 
+from .chaos import chaos
 from .metrics import metrics
 
 __all__ = [
@@ -74,12 +75,18 @@ class MemoryKV:
         return self._data.get(key)
 
     def put(self, key: bytes, value: bytes) -> None:
+        if chaos.on:  # injected write failure (tpunode/chaos.py)
+            chaos.maybe_raise("store.write", "memory")
         self._data[key] = value
 
     def delete(self, key: bytes) -> None:
+        if chaos.on:
+            chaos.maybe_raise("store.write", "memory")
         self._data.pop(key, None)
 
     def write_batch(self, ops: Sequence[BatchOp]) -> None:
+        if chaos.on:  # injected write failure (tpunode/chaos.py)
+            chaos.maybe_raise("store.write", "memory")
         for op, k, v in ops:
             if op == "put":
                 self._data[k] = v
@@ -192,6 +199,8 @@ class LogKV:
         self.write_batch([delete_op(key)])
 
     def write_batch(self, ops: Sequence[BatchOp]) -> None:
+        if chaos.on:  # injected write failure (tpunode/chaos.py)
+            chaos.maybe_raise("store.write", self.path)
         t0 = time.perf_counter()
         self._write_batch(ops)
         if not metrics.disabled:

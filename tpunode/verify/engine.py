@@ -9,13 +9,26 @@ resolves per-item futures.  Double-buffered by construction: device dispatch
 runs in a worker thread so the asyncio event loop (the P2P side) never
 blocks, and the next batch accumulates while the previous one runs.
 
-Device survival discipline (VERDICT r2 item 4): the TPU path is only used
-after an off-queue **warmup** (backend init + XLA compile at the fixed batch
-shape + a verdict cross-check against the oracle) completes in a background
-thread.  Until then — and forever, if warmup fails — batches flow to the
-CPU engine, so a box with a broken or slow TPU backend still produces
-verdicts with nothing blocked and the decision logged.  Compiles go through
-a persistent compilation cache so a restart reuses earlier work.
+Device survival discipline (VERDICT r2 item 4 + ISSUE 7): the TPU path is
+only used after an off-queue **warmup** (backend init + XLA compile at the
+fixed batch shape + a verdict cross-check against the oracle) completes in
+a background thread.  Until then batches flow to the CPU engine, so a box
+with a broken or slow TPU backend still produces verdicts with nothing
+blocked and the decision logged; a failed warmup is re-probed on a timer
+(``warmup_retry``), never terminal.  Compiles go through a persistent
+compilation cache so a restart reuses earlier work.
+
+Self-healing dispatch (ISSUE 7): a batch that fails on one backend
+re-dispatches down the ladder (tpu -> cpu-native -> python oracle), so
+waiters get verdicts — not exceptions — for transient faults; only a
+batch that fails on EVERY rung fails its waiters (and only its own: the
+queue loop survives to serve the next batch).  Device-rung failures feed
+a :class:`CircuitBreaker` (``ready -> degraded -> open -> probing ->
+ready``): repeated failures inside a window open the breaker and route
+all traffic to the CPU, then a periodic half-open canary batch re-probes
+the device and restores the fast path when it recovers.  The state
+machine is observable as ``verify.breaker`` events, the
+``verify.breaker_state`` gauge, engine ``stats()`` and ``/health``.
 
 Mirrors the role the reference's synchronous libsecp256k1 callout plays, but
 asynchronous and batched (SURVEY.md §2.3: this IS the data-parallel north
@@ -35,6 +48,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
 from ..actors import spawn_supervised
+from ..chaos import chaos
 from ..events import events
 from ..metrics import metrics
 from ..trace import span
@@ -42,7 +56,13 @@ from ..tracectx import activate as _activate_trace, current as _trace_current
 from .ecdsa_cpu import Point, verify_batch_cpu
 from .raw import as_raw_batch, concat_raw
 
-__all__ = ["VerifyConfig", "VerifyEngine", "VerifyItem", "enable_compile_cache"]
+__all__ = [
+    "CircuitBreaker",
+    "VerifyConfig",
+    "VerifyEngine",
+    "VerifyItem",
+    "enable_compile_cache",
+]
 
 # (pubkey, z, r, s) for ECDSA; 5-tuples append "schnorr" (BCH) or
 # "bip340" (taproot) with the precomputed challenge in the z position.
@@ -173,6 +193,137 @@ def _device_warmup(batch_size: int, device_batch: int = 0) -> str:
     return kind
 
 
+class CircuitBreaker:
+    """Device-path health state machine (ISSUE 7).
+
+    States (``STATES`` order is the ``verify.breaker_state`` gauge
+    encoding):
+
+    * ``ready``    — device path in use, no recent failures.
+    * ``degraded`` — failures seen inside the window (< threshold); the
+      device is still used, each failed batch already re-ran on the CPU
+      rung via the dispatch ladder.
+    * ``open``     — threshold reached: all traffic to the CPU, the
+      device isn't attempted at all until the cooldown elapses.
+    * ``probing``  — cooldown elapsed: exactly one live batch is routed
+      to the device as a half-open canary.  Success closes the breaker
+      (``ready``, recovery latency observed); failure re-opens it and
+      restarts the cooldown.
+
+    Thread-safe: transitions happen on the engine's dispatch worker
+    thread (ladder outcomes) and the queue loop (backend picks).  Every
+    transition emits one ``verify.breaker`` event and updates the
+    ``verify.breaker_state`` gauge.
+    """
+
+    STATES = ("ready", "degraded", "open", "probing")
+
+    def __init__(
+        self, threshold: int = 3, window: float = 30.0, cooldown: float = 5.0
+    ):
+        self.threshold = max(1, threshold)
+        self.window = window
+        self.cooldown = cooldown
+        self._lock = threading.Lock()
+        self._state = "ready"
+        self._failures: collections.deque[float] = collections.deque()
+        self._opened_at: Optional[float] = None
+        self._last_error: Optional[str] = None
+        self.opens = 0
+        self.closes = 0
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def allow_device(self) -> bool:
+        """May this batch take the device path?  ``open -> probing`` when
+        the cooldown has elapsed — the caller's batch becomes the canary
+        (exactly one: while ``probing``, everyone else stays on cpu)."""
+        with self._lock:
+            if self._state in ("ready", "degraded"):
+                return True
+            if self._state == "probing":
+                return False  # a canary is already in flight
+            now = time.monotonic()
+            if (
+                self._opened_at is not None
+                and now - self._opened_at >= self.cooldown
+            ):
+                self._transition("probing")
+                return True
+            return False
+
+    def record_success(self) -> None:
+        """A device batch completed: close toward ``ready``."""
+        with self._lock:
+            self._failures.clear()
+            if self._state == "ready":
+                return
+            fields = {}
+            if self._opened_at is not None:
+                recovery = time.monotonic() - self._opened_at
+                metrics.observe("verify.breaker_recovery_seconds", recovery)
+                fields["recovery_seconds"] = round(recovery, 3)
+            if self._state in ("open", "probing"):
+                self.closes += 1
+                metrics.inc("verify.breaker_closes")
+            self._opened_at = None
+            self._last_error = None
+            self._transition("ready", **fields)
+
+    def record_failure(self, error: str = "") -> None:
+        """A device batch failed (the ladder already re-dispatched it)."""
+        with self._lock:
+            now = time.monotonic()
+            self._failures.append(now)
+            while self._failures and now - self._failures[0] > self.window:
+                self._failures.popleft()
+            self._last_error = error or None
+            if (
+                self._state == "probing"
+                or len(self._failures) >= self.threshold
+            ):
+                # a failed canary re-opens immediately; repeated failures
+                # inside the window open from ready/degraded
+                self._opened_at = now
+                if self._state != "open":
+                    self.opens += 1
+                    metrics.inc("verify.breaker_opens")
+                    self._transition(
+                        "open", failures=len(self._failures), error=error,
+                    )
+            elif self._state == "ready":
+                self._transition(
+                    "degraded", failures=len(self._failures), error=error,
+                )
+
+    def _transition(self, to: str, **fields) -> None:
+        # lock held by the caller
+        frm, self._state = self._state, to
+        metrics.set_gauge(
+            "verify.breaker_state", float(self.STATES.index(to))
+        )
+        log.warning("[Engine] breaker %s -> %s %s", frm, to, fields or "")
+        events.emit("verify.breaker", **{"from": frm, "to": to, **fields})
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {
+                "state": self._state,
+                "failures_in_window": len(self._failures),
+                "threshold": self.threshold,
+                "opens": self.opens,
+                "closes": self.closes,
+                "last_error": self._last_error,
+            }
+            if self._opened_at is not None:
+                out["open_age_seconds"] = round(
+                    time.monotonic() - self._opened_at, 3
+                )
+            return out
+
+
 @dataclass
 class VerifyConfig:
     """Knobs (gated behind NodeConfig like the reference's config surface,
@@ -199,6 +350,19 @@ class VerifyConfig:
     # device warmup discipline
     warmup_timeout: float = 600.0  # backend=tpu: max wait for warmup
     warmup: bool = True  # start warmup thread on engine start
+    # A failed warmup is re-probed after this many seconds (ISSUE 7:
+    # the old terminal `failed` state outlived many a transient outage
+    # — the r5 Mosaic remote-compile 500s cleared within the round).
+    # 0 disables re-probing (the pre-ISSUE-7 terminal behavior).
+    warmup_retry: float = 60.0
+    # Circuit breaker on the device dispatch path (ISSUE 7):
+    # `breaker_threshold` failures inside `breaker_window` seconds open
+    # the breaker (all traffic to cpu); after `breaker_cooldown` seconds
+    # one live batch probes the device and, on success, restores the
+    # fast path.
+    breaker_threshold: int = 3
+    breaker_window: float = 30.0
+    breaker_cooldown: float = 5.0
     # Field-arithmetic formulation (ISSUE 4): None keeps the process-wide
     # mode (TPUNODE_FIELD_MUL / TPUNODE_FIELD_SQR env knobs, defaults
     # measured in PERF.md's roofline section); "shift_add"/"dot_general"
@@ -253,12 +417,22 @@ class VerifyEngine:
         # (never written back into the caller's cfg).
         self._device_batch = self.cfg.device_batch
         # device readiness state machine: cold -> warming -> ready | failed
+        # (failed re-probes on the warmup_retry timer — never terminal)
         self._device_state = "cold"
         self._device_kind = ""
         self._device_error: Optional[str] = None
         self._warmup_started = 0.0
+        self._warmup_failed_at = 0.0
+        self._warmup_lock = threading.Lock()
         self._warmup_done = threading.Event()
         self._slow_logged = False
+        # device-dispatch circuit breaker (ISSUE 7): engaged only once
+        # the device is warm; open = all traffic on the cpu rungs
+        self._breaker = CircuitBreaker(
+            threshold=self.cfg.breaker_threshold,
+            window=self.cfg.breaker_window,
+            cooldown=self.cfg.breaker_cooldown,
+        )
         if self.cfg.warmup and self.cfg.backend in ("auto", "tpu"):
             self.start_warmup()
 
@@ -276,6 +450,8 @@ class VerifyEngine:
 
         def run() -> None:
             try:
+                if chaos.on:  # injected compile/init failure (ISSUE 7)
+                    chaos.maybe_raise("engine.warmup")
                 kind = type(self)._warmup_fn(
                     self.cfg.batch_size, self.cfg.device_batch
                 )
@@ -298,9 +474,12 @@ class VerifyEngine:
                 )
             except Exception as e:  # noqa: BLE001 — any failure disables tpu
                 self._device_error = f"{type(e).__name__}: {e}"
+                self._warmup_failed_at = time.monotonic()
                 self._device_state = "failed"
                 log.warning(
-                    "[Engine] device warmup failed, using cpu engine: %s",
+                    "[Engine] device warmup failed, using cpu engine"
+                    " (re-probe in %.0fs): %s",
+                    self.cfg.warmup_retry,
                     self._device_error,
                 )
                 events.emit(
@@ -320,9 +499,46 @@ class VerifyEngine:
 
         threading.Thread(target=run, name="verify-warmup", daemon=True).start()
 
+    def _retry_warmup(self) -> None:
+        """Re-probe a failed device warmup (ISSUE 7: `failed` is a
+        cooldown, not a verdict).  Called from the dispatch path once the
+        retry interval elapses; idempotent and thread-safe — exactly one
+        caller flips failed -> cold and relaunches the warmup thread."""
+        with self._warmup_lock:
+            if self._device_state != "failed":
+                return
+            if (
+                time.monotonic() - self._warmup_failed_at
+                < self.cfg.warmup_retry
+            ):
+                return
+            log.info(
+                "[Engine] re-probing device warmup after failure: %s",
+                self._device_error,
+            )
+            events.emit("verify.device", state="reprobe",
+                        error=self._device_error)
+            # fresh latch: forced-tpu waiters must block on THIS attempt
+            self._warmup_done = threading.Event()
+            self._slow_logged = False
+            self._device_state = "cold"
+            self.start_warmup()
+
     @property
     def device_state(self) -> str:
         return self._device_state
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        return self._breaker
+
+    @property
+    def breaker_state(self) -> str:
+        """Device-path breaker state (``/health``): the warmup machine's
+        view until the device is warm, the breaker's after."""
+        if self._device_state != "ready":
+            return self._device_state
+        return self._breaker.state
 
     def queue_depth(self) -> dict[str, int]:
         """Current backlog: queued submissions and total items in them."""
@@ -353,6 +569,8 @@ class VerifyEngine:
             "batches": metrics.get("verify.batches"),
             "items": metrics.get("verify.items"),
             "errors": metrics.get("verify.dispatch_errors"),
+            "failovers": metrics.get("verify.failovers"),
+            "breaker": self._breaker.stats(),
         }
         occ = metrics.histogram("verify.occupancy")
         if occ is not None:
@@ -500,9 +718,17 @@ class VerifyEngine:
             return self._dispatch_multi(payloads, target)
 
     def _pick(self, n: int) -> str:
-        """Resolve the backend for one batch.  Never blocks except for the
-        forced-tpu backend, which waits (bounded) for warmup."""
+        """Resolve the starting backend rung for one batch.  Never blocks
+        except for the forced-tpu backend, which waits (bounded) for
+        warmup.  The device path additionally passes through the circuit
+        breaker: open = cpu, one canary batch while probing."""
         backend = self.cfg.backend
+        if (
+            backend in ("auto", "tpu")
+            and self._device_state == "failed"
+            and self.cfg.warmup_retry > 0
+        ):
+            self._retry_warmup()  # no-op until the retry interval elapses
         if backend == "tpu":
             if self._device_state == "cold":  # cfg.warmup=False: warm lazily
                 self.start_warmup()
@@ -519,7 +745,11 @@ class VerifyEngine:
             return "tpu"
         if backend != "auto":
             return backend
-        if n >= self.cfg.min_tpu_batch and self._device_state == "ready":
+        if (
+            n >= self.cfg.min_tpu_batch
+            and self._device_state == "ready"
+            and self._breaker.allow_device()
+        ):
             return "tpu"
         if (
             self._device_state == "warming"
@@ -552,31 +782,7 @@ class VerifyEngine:
                 )
             backend = self._pick(total)
             t0 = time.perf_counter()
-            try:
-                if backend == "tpu":
-                    out = self._run_tpu(payloads)  # counts tpu/cpu items per chunk
-                elif backend == "cpu" and self._cpu is not None:
-                    out = self._cpu.verify_raw(
-                        concat_raw([as_raw_batch(p) for p in payloads]),
-                        nthreads=self.cfg.cpu_threads,
-                    )
-                    metrics.inc("verify.cpu_items", total)
-                else:
-                    out = []
-                    for p in payloads:
-                        out.extend(
-                            verify_batch_cpu(
-                                p if isinstance(p, list) else as_raw_batch(p).to_tuples()
-                            )
-                        )
-                    metrics.inc("verify.oracle_items", total)
-            except Exception as e:
-                metrics.inc("verify.dispatch_errors")
-                events.emit(
-                    "verify.failure", where="dispatch", backend=backend,
-                    size=total, error=f"{type(e).__name__}: {e}"[:300],
-                )
-                raise
+            out, backend = self._run_ladder(backend, payloads, total)
             dt = time.perf_counter() - t0
             metrics.inc("verify.seconds", dt)
             events.emit(
@@ -585,6 +791,79 @@ class VerifyEngine:
                 seconds=round(dt, 6),
             )
             return out
+
+    # Failover order (ISSUE 7): each rung is strictly more available and
+    # strictly slower than the one above it; the python oracle cannot
+    # fail for device/native reasons, so transient faults never surface
+    # to waiters as exceptions.
+    _LADDER = ("tpu", "cpu", "oracle")
+
+    def _run_ladder(
+        self, backend: str, payloads: list, total: int
+    ) -> tuple[list[bool], str]:
+        """Run one coalesced batch starting at ``backend``, re-dispatching
+        the SAME batch down the ladder on failure.  Device-rung outcomes
+        feed the circuit breaker.  Returns (results, rung that served).
+        Only a batch that fails on every rung raises — and then fails
+        just this batch's waiters; the queue loop survives (pinned by
+        tests/test_engine.py)."""
+        start = self._LADDER.index(backend) if backend in self._LADDER else 0
+        rungs = [
+            r
+            for r in self._LADDER[start:]
+            if r != "cpu" or self._cpu is not None
+        ]
+        for i, rung in enumerate(rungs):
+            try:
+                if chaos.on:  # injected batch/device failure (ISSUE 7)
+                    chaos.maybe_raise("engine.dispatch", rung)
+                out = self._run_backend(rung, payloads, total)
+            except Exception as e:
+                err = f"{type(e).__name__}: {e}"[:300]
+                metrics.inc("verify.dispatch_errors")
+                events.emit(
+                    "verify.failure", where="dispatch", backend=rung,
+                    size=total, error=err,
+                )
+                if rung == "tpu":
+                    self._breaker.record_failure(err)
+                if i + 1 >= len(rungs):
+                    raise  # every rung failed: the waiters learn it
+                metrics.inc("verify.failovers")
+                events.emit(
+                    "verify.failover", source=rung, target=rungs[i + 1],
+                    size=total, error=err,
+                )
+                log.warning(
+                    "[Engine] batch of %d failed on %s, retrying on %s: %s",
+                    total, rung, rungs[i + 1], err,
+                )
+                continue
+            if rung == "tpu":
+                self._breaker.record_success()
+            return out, rung
+        raise RuntimeError("no verify backend available")  # unreachable
+
+    def _run_backend(self, rung: str, payloads: list, total: int) -> list[bool]:
+        """Execute one ladder rung over the coalesced payloads."""
+        if rung == "tpu":
+            return self._run_tpu(payloads)  # counts tpu/cpu items per chunk
+        if rung == "cpu" and self._cpu is not None:
+            out = self._cpu.verify_raw(
+                concat_raw([as_raw_batch(p) for p in payloads]),
+                nthreads=self.cfg.cpu_threads,
+            )
+            metrics.inc("verify.cpu_items", total)
+            return out
+        out = []
+        for p in payloads:
+            out.extend(
+                verify_batch_cpu(
+                    p if isinstance(p, list) else as_raw_batch(p).to_tuples()
+                )
+            )
+        metrics.inc("verify.oracle_items", total)
+        return out
 
     def _run_tpu(self, payloads: list) -> list[bool]:
         """Device dispatch in fixed-size chunks: every call is one of the
