@@ -91,6 +91,20 @@ T_MEMPOOL = float(os.environ.get("TPUNODE_BENCH_MEMPOOL_TIMEOUT", 150))
 # verdicts on the genuine tpu rung) — jax imported, tunnel never
 # touched.  Budget shaped like the mempool scenario's.
 T_CHAOS = float(os.environ.get("TPUNODE_BENCH_CHAOS_TIMEOUT", 150))
+# Kernel point-form A/B (ISSUE 8): projective vs affine step time on
+# cpu-jax, per batch size.  Batch 1024 fits its budget once the
+# persistent compile cache is warm (two cold XLA compiles ~2x90s + 10
+# timed steps ~35s; a cold-cache round may label it timed-out — never
+# masking the headline).  Batch 32768 is DISABLED by default: the
+# repo's watchdog discipline forbids host-side XLA compiles above 4096
+# (compile grows super-linearly — blew r02/r03), and a single cpu-jax
+# step at 32768 is ~2 min, so median-of-5 for two forms cannot fit any
+# driver budget; set TPUNODE_BENCH_KERNELAB_BIG_TIMEOUT > 0 to attempt
+# (PERF.md records a manual no-watchdog run at both batches instead).
+T_KERNEL_AB = float(os.environ.get("TPUNODE_BENCH_KERNELAB_TIMEOUT", 270))
+T_KERNEL_AB_BIG = float(
+    os.environ.get("TPUNODE_BENCH_KERNELAB_BIG_TIMEOUT", 0)
+)
 # Total ceiling: probe (<=120s) + ladder (<=600s) + fallback (<=210s)
 # + mempool (<=150s) keeps the worst case ~18 min; r03's artifact
 # demonstrated the driver tolerating 810s, and the in-round watcher
@@ -223,6 +237,7 @@ def _worker_bench() -> None:
             kernel_name = "xla"
 
         from benchmarks.common import device_kind, make_triples, tile
+        from tpunode.verify.curve import point_form as _point_form
         from tpunode.verify.ecdsa_cpu import verify_batch_cpu
 
         base = make_triples(min(UNIQUE, batch))
@@ -300,6 +315,7 @@ def _worker_bench() -> None:
                     "rate": batch / dt,
                     "device": device_kind(),
                     "kernel": kernel_name,
+                    "point_form": _point_form(),
                     "batch": batch,
                     "step_ms": round(dt * 1e3, 3),
                     "compile_s": round(compile_s, 1),
@@ -682,6 +698,139 @@ def _worker_chaos() -> None:
         print(json.dumps(asyncio.run(run())))
     except Exception as e:  # noqa: BLE001 — worker reports, parent decides
         print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
+
+
+def _worker_kernel_ab() -> None:
+    """Kernel point-form A/B worker (ISSUE 8): projective vs affine XLA
+    step time at one batch size on cpu-jax, in a bounded subprocess.
+
+    Both forms compile first (persistent cache), verdicts cross-check
+    against the C++ engine (a mismatch is FATAL — an A/B must never
+    time a wrong program), then the timed steps run ROUND-ROBIN so
+    host-load drift hits both forms equally (the PERF r6 lesson:
+    sequential per-process runs on this box swing ±75%).  Prints one
+    JSON line with median-of-N + spread per form, like
+    ``baseline_cpu_single_core``.
+    """
+    batch = int(os.environ.get("TPUNODE_BENCH_KERNELAB_BATCH", 1024))
+    iters = int(os.environ.get("TPUNODE_BENCH_KERNELAB_ITERS", 5))
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        # this box's TPU shim force-sets jax_platforms in every process
+        jax.config.update("jax_platforms", "cpu")
+        from tpunode.verify.engine import enable_compile_cache
+
+        enable_compile_cache()
+        from benchmarks.common import make_triples, tile
+        from tpunode.verify import curve as C
+        from tpunode.verify.cpu_native import load_native_verifier
+        from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+        from tpunode.verify.kernel import (
+            collect_verdicts,
+            prepare_batch,
+            verify_device,
+        )
+
+        base = make_triples(min(UNIQUE, batch))
+        items = tile(base, batch)
+        prep = prepare_batch(items, pad_to=batch)
+        args = tuple(jnp.asarray(a) for a in prep.device_args)
+        native = load_native_verifier()
+        expect = (
+            native.verify_batch(base)
+            if native is not None
+            else verify_batch_cpu(base)
+        )
+        forms = ("projective", "affine")
+        stats: dict = {f: {"times": []} for f in forms}
+        for form in forms:
+            C.set_point_form(form)
+            _progress(f"compiling {form} XLA program at batch {batch}...")
+            t0 = time.perf_counter()
+            out = verify_device(*args)
+            got = collect_verdicts(out, len(base))
+            stats[form]["compile_s"] = round(time.perf_counter() - t0, 1)
+            if got != expect:
+                print(
+                    json.dumps(
+                        {"ok": False, "fatal": True,
+                         "error": f"{form}/oracle verdict mismatch"}
+                    )
+                )
+                return
+        for i in range(iters):
+            _progress(f"timed round {i + 1}/{iters}...")
+            for form in forms:
+                C.set_point_form(form)
+                t0 = time.perf_counter()
+                verify_device(*args).block_until_ready()
+                stats[form]["times"].append(time.perf_counter() - t0)
+        section: dict = {
+            "ok": True,
+            "batch": batch,
+            "proxy": "cpu-jax",
+            "iters": iters,
+            "forms": {},
+        }
+        for form in forms:
+            ts = stats[form]["times"]
+            section["forms"][form] = {
+                "step_ms": round(statistics.median(ts) * 1e3, 1),
+                "step_ms_min": round(min(ts) * 1e3, 1),
+                "step_ms_max": round(max(ts) * 1e3, 1),
+                "spread_rel": round(max(ts) / min(ts) - 1.0, 3),
+                "compile_s": stats[form]["compile_s"],
+            }
+        proj = section["forms"]["projective"]["step_ms"]
+        aff = section["forms"]["affine"]["step_ms"]
+        section["affine_vs_projective"] = round(aff / proj - 1.0, 4)
+        print(json.dumps(section))
+    except Exception as e:  # noqa: BLE001 — worker reports, parent decides
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
+
+
+def _kernel_section() -> dict:
+    """The BENCH JSON ``kernel`` section (ISSUE 8): projective-vs-affine
+    step-time comparison per batch size, each in its own bounded worker
+    so a timed-out cell is labeled without losing the others — and never
+    masks the headline.  Batch 32768 is attempted only when its budget
+    env is set (see T_KERNEL_AB_BIG)."""
+    out: dict = {}
+    for batch, budget in ((1024, T_KERNEL_AB), (32768, T_KERNEL_AB_BIG)):
+        key = f"batch_{batch}"
+        if budget <= 0:
+            # per-batch reason: the big batch is disabled BY DEFAULT for
+            # the compile-discipline reason; a small batch only gets
+            # here when the operator zeroed its own knob (review r8 —
+            # the 32768 rationale would be a false label there)
+            out[key] = {
+                "ok": False,
+                "error": (
+                    "disabled by default: cpu-jax XLA compile above "
+                    "4096 violates the watchdog discipline and a 32768 "
+                    "step is ~2 min — see PERF.md for the manual "
+                    "no-watchdog A/B; set "
+                    "TPUNODE_BENCH_KERNELAB_BIG_TIMEOUT to attempt"
+                    if batch > 4096
+                    else "disabled by operator: "
+                    "TPUNODE_BENCH_KERNELAB_TIMEOUT <= 0"
+                ),
+            }
+            continue
+        res = _run_worker(
+            "--kernel-ab", budget,
+            {"JAX_PLATFORMS": "cpu",
+             "TPUNODE_BENCH_KERNELAB_BATCH": str(batch)},
+        )
+        if not res.get("ok") and "error" in res:
+            out[key] = {"ok": False, "error": str(res["error"])[:300]}
+            if res.get("fatal"):
+                out[key]["fatal"] = True
+        else:
+            out[key] = res
+    return out
 
 
 def _resilience_section() -> dict:
@@ -1088,9 +1237,21 @@ def _main_locked() -> None:
     # transitions and recovery latency, failure-labeled like the
     # mempool section so it never masks the headline.
     out["resilience"] = _resilience_section()
+    # Kernel point-form A/B section (ISSUE 8): projective vs affine step
+    # time on cpu-jax, failure-labeled per batch like the sections above.
+    # Named "kernel_ab" because the top-level "kernel" key already names
+    # the program (pallas/xla) that produced the headline.
+    out["kernel_ab"] = _kernel_section()
     print(json.dumps(out))
-    if res.get("fatal"):
-        sys.exit(1)  # kernel correctness failure must not look like success
+    # A fatal anywhere is a kernel correctness failure (device/oracle or
+    # affine/oracle verdict mismatch) and must not look like success —
+    # the A/B section's fatal counts exactly like the headline's.
+    kab_fatal = any(
+        isinstance(cell, dict) and cell.get("fatal")
+        for cell in out["kernel_ab"].values()
+    )
+    if res.get("fatal") or kab_fatal:
+        sys.exit(1)
 
 
 if __name__ == "__main__":
@@ -1102,5 +1263,7 @@ if __name__ == "__main__":
         _worker_mempool()
     elif "--chaos" in sys.argv:
         _worker_chaos()
+    elif "--kernel-ab" in sys.argv:
+        _worker_kernel_ab()
     else:
         main()

@@ -23,11 +23,12 @@ Run (CPU-only, never touches the tunnel):
 interpret mode (numpy semantics of the exact Mosaic program; block 32)
 instead of the XLA program — both device paths validated by one
 harness.  ``--field-mul=shift_add|dot_general`` and
-``--field-sqr=half|mul`` select the limb-product formulation (ISSUE 4):
-the dot_general/MXU formulation and the dedicated half-product squaring
-must produce ZERO mismatches on the full adversarial pool before they
-are eligible for dispatch.  Prints one JSON line: items compared,
-mismatches (MUST be 0), the formulation, and the per-shape tally.
+``--field-sqr=half|mul`` select the limb-product formulation (ISSUE 4);
+``--point-form projective|affine`` selects the MSM point form (ISSUE 8):
+a new formulation must produce ZERO mismatches on the full adversarial
+pool before it is eligible for dispatch.  Prints one JSON line: items
+compared, mismatches (MUST be 0), the formulation, and the per-shape
+tally.
 Replaces the one-off scripts behind PERF.md's r5 campaign notes with a
 committed, re-runnable harness.
 """
@@ -136,16 +137,19 @@ def run_campaign(
     pallas: bool = False,
     field_mul: str | None = None,
     field_sqr: str | None = None,
+    point_form: str | None = None,
 ) -> dict:
     """Build the pool and compare the chosen device program against the
     C++ verifier AND each shape's required verdict.  Returns the result
     dict (``mismatches`` MUST be 0).  ``field_mul``/``field_sqr`` select
-    the limb-product formulation process-wide (None keeps the active
-    mode); every dispatch path retraces per mode."""
+    the limb-product formulation and ``point_form`` the MSM point form
+    (ISSUE 8) process-wide (None keeps the active mode); every dispatch
+    path retraces per mode."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
+    from tpunode.verify import curve as C
     from tpunode.verify import field as F
     from tpunode.verify.cpu_native import load_native_verifier
     from tpunode.verify.ecdsa_cpu import verify_batch_cpu
@@ -155,6 +159,8 @@ def run_campaign(
     enable_compile_cache()
     if field_mul is not None or field_sqr is not None:
         F.set_field_modes(mul=field_mul, sqr=field_sqr)
+    if point_form is not None:
+        C.set_point_form(point_form)
     if pallas:
         import jax.numpy as jnp
 
@@ -206,6 +212,7 @@ def run_campaign(
         "mismatch_detail": mismatches[:10],
         "kernel": "pallas-interpret" if pallas else "xla",
         "field_modes": {"mul": F.mul_mode(), "sqr": F.sqr_mode()},
+        "point_form": C.point_form(),
         "gen_s": round(gen_s, 1),
         "run_s": round(run_s, 1),
         "oracle": "native-cpp" if native is not None else "python",
@@ -216,15 +223,23 @@ def run_campaign(
 
 def main() -> None:
     pallas = "--pallas" in sys.argv
-    field_mul = field_sqr = None
+    field_mul = field_sqr = point_form = None
     pos = []
-    for a in sys.argv[1:]:
+    args = list(sys.argv[1:])
+    while args:
+        a = args.pop(0)
         if a == "--pallas":
             continue
         if a.startswith("--field-mul="):
             field_mul = a.split("=", 1)[1]
         elif a.startswith("--field-sqr="):
             field_sqr = a.split("=", 1)[1]
+        elif a.startswith("--point-form="):
+            point_form = a.split("=", 1)[1]
+        elif a == "--point-form":  # ISSUE 8 spells it space-separated
+            if not args:
+                sys.exit("--point-form needs a value (projective|affine)")
+            point_form = args.pop(0)
         else:
             pos.append(a)
     n_base = int(pos[0]) if pos else (32 if pallas else 256)
@@ -233,7 +248,8 @@ def main() -> None:
         sys.exit(f"--pallas batch must be a multiple of the 32-lane "
                  f"interpret block (got {batch})")
     res = run_campaign(n_base, batch, pallas=pallas,
-                       field_mul=field_mul, field_sqr=field_sqr)
+                       field_mul=field_mul, field_sqr=field_sqr,
+                       point_form=point_form)
     print(json.dumps(res))
     if res["mismatches"]:
         sys.exit(1)

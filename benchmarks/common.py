@@ -76,19 +76,26 @@ def run_json_subprocess(
     }
 
 
-def worker_rung_env(batch: int, kernel: str | None = None):
+def worker_rung_env(batch: int, kernel: str | None = None,
+                    point_form: str | None = None):
     """Env + display label for one device-ladder rung.
 
     Shared by bench.py's round-end ladder and benchmarks/watcher.py (the
     round-long sampler) so the TPUNODE_BENCH_* worker contract lives in
     one place: ``kernel`` None means auto-select (pallas on TPU), "xla"
-    forces the portable XLA program (the Mosaic-outage fallback).
+    forces the portable XLA program (the Mosaic-outage fallback);
+    ``point_form`` selects the MSM point form (ISSUE 8 — the watcher's
+    affine rungs ride this; None keeps the worker's process default).
     """
     env = {"TPUNODE_BENCH_BATCH": str(batch),
            "TPUNODE_BENCH_REQUIRE_TPU": "1"}
+    label = f"tpu{'-' + kernel if kernel else ''}@{batch}"
     if kernel:
         env["TPUNODE_BENCH_KERNEL"] = kernel
-    return env, f"tpu{'-' + kernel if kernel else ''}@{batch}"
+    if point_form:
+        env["TPUNODE_POINT_FORM"] = point_form
+        label += f"/{point_form}"
+    return env, label
 
 
 def make_triples(n: int, seed: int = 0xBE5C, invalid_every: int = 16):

@@ -18,7 +18,13 @@ the two possible causes when an uptime window allows:
    canonical placement the kernel now uses (pallas_kernel.py:190-215).
    ``pow_window`` failing while this passes confirms the VMEM read as
    the cause and the SMEM fix as sufficient.
-6. ``flagship`` — the real ``verify_blocked`` at batch 256 (one block).
+6. ``mixed_add`` / ``batch_inv`` / ``pow_descan`` / ``select_tree`` —
+   the ISSUE-8 affine-MSM primitives (complete mixed addition, the
+   Montgomery-trick batch inversion with its SMEM-digit Fermat ladder,
+   the de-scanned static-digit pow, the 4-level select tree), each as a
+   minimal kernel so a short uptime window can bisect which ones Mosaic
+   lowers before the affine flagship is attempted on device.
+7. ``flagship`` — the real ``verify_blocked`` at batch 256 (one block).
    The failing-construct set names the thing to fix.
 
 Run by benchmarks/watcher.py once per round after its first successful
@@ -251,6 +257,248 @@ def _pow_window_smem() -> None:
     _pow_window_impl(smem_digits=True)
 
 
+def _mixed_add() -> None:
+    """The ISSUE-8 affine-form primitive: curve.pt_add_mixed (complete
+    RCB Algorithm 8, 11M+2) with the Mosaic field namespace inside a
+    pallas kernel.  Verified projectively: X - x_e*Z ≡ Y - y_e*Z ≡ 0
+    (mod p) against host-side affine point addition."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    from tpunode.verify import field as F
+    from tpunode.verify import pallas_field as PF
+    from tpunode.verify.curve import pt_add_mixed
+    from tpunode.verify.ecdsa_cpu import GENERATOR, point_add, point_mul
+
+    b = 256
+    P1 = point_mul(7, GENERATOR)
+    P2 = point_mul(11, GENERATOR)
+    E = point_add(P1, P2)
+
+    def kernel(px_ref, py_ref, qx_ref, qy_ref, ex_ref, ey_ref, o_ref):
+        one = jnp.concatenate(
+            [jnp.ones((1, b), jnp.int32),
+             jnp.zeros((F.NLIMBS - 1, b), jnp.int32)], axis=0)
+        p = jnp.stack([px_ref[...], py_ref[...], one], axis=0)
+        q = jnp.stack([qx_ref[...], qy_ref[...]], axis=0)
+        r = pt_add_mixed(p, q, F=PF)
+        bad_x = PF.canonical(r[0] - PF.mul(ex_ref[...], r[2]))
+        bad_y = PF.canonical(r[1] - PF.mul(ey_ref[...], r[2]))
+        o_ref[...] = bad_x + bad_y
+
+    def cols(v):
+        return jnp.asarray(
+            np.broadcast_to(F.to_limbs(v)[:, None], (F.NLIMBS, b)))
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((F.NLIMBS, b), jnp.int32),
+        interpret=_INTERPRET,
+    )(cols(P1.x), cols(P1.y), cols(P2.x), cols(P2.y), cols(E.x), cols(E.y))
+    assert not np.asarray(out).any(), "mixed add mismatch"
+
+
+def _batch_inv() -> None:
+    """The ISSUE-8 on-device batch inversion composed exactly like the
+    affine kernel's: a 16-entry Z column in VMEM scratch, prefix
+    products, ONE Fermat ladder (SMEM digit row), suffix pass — then
+    z_15 * zinv_15 must canonicalize to 1."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpunode.verify import field as F
+    from tpunode.verify import pallas_field as PF
+
+    b = 256
+    pm2 = [((F.P - 2) >> (4 * (63 - w))) & 0xF for w in range(64)]
+
+    def kernel(z_ref, dig_ref, o_ref, ztab_ref, ptab_ref, powtab_ref):
+        one = jnp.concatenate(
+            [jnp.ones((1, b), jnp.int32),
+             jnp.zeros((F.NLIMBS - 1, b), jnp.int32)], axis=0)
+        z = z_ref[...]
+        ztab_ref[1] = one
+        ztab_ref[pl.ds(2, 1)] = z[None]
+
+        def zbuild(k, c):
+            ztab_ref[pl.ds(k, 1)] = PF.mul(
+                ztab_ref[pl.ds(k - 1, 1)][0], z)[None]
+            return c
+
+        lax.fori_loop(3, 16, zbuild, 0)
+        ptab_ref[1] = one
+        ptab_ref[2] = ztab_ref[2]
+
+        def prefix(k, c):
+            ptab_ref[pl.ds(k, 1)] = PF.mul(
+                ptab_ref[pl.ds(k - 1, 1)][0], ztab_ref[pl.ds(k, 1)][0])[None]
+            return c
+
+        lax.fori_loop(3, 16, prefix, 0)
+        t = ptab_ref[15]
+        powtab_ref[0] = one
+        powtab_ref[1] = t
+
+        def pbuild(k, c):
+            powtab_ref[pl.ds(k, 1)] = PF.mul(
+                powtab_ref[pl.ds(k - 1, 1)][0], t)[None]
+            return c
+
+        lax.fori_loop(2, 16, pbuild, 0)
+
+        def window(w, pacc):
+            pacc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(pacc))))
+            d = dig_ref[0, w]
+            sel = None
+            for tv in range(16):
+                contrib = jnp.where(d == tv, powtab_ref[tv], 0)
+                sel = contrib if sel is None else sel + contrib
+            return PF.mul(pacc, sel)
+
+        inv = lax.fori_loop(0, 64, window, one)
+        # suffix step for entry 15 (the first the real kernel takes):
+        # zinv_15 = inv * (z_2..z_14), then z_15 * zinv_15 must be 1
+        zinv15 = PF.mul(inv, ptab_ref[14])
+        o_ref[...] = PF.canonical(PF.mul(ztab_ref[15], zinv15))
+
+    rng = np.random.default_rng(17)
+    zv = [int(rng.integers(2, 2**61)) for _ in range(b)]
+    zcol = jnp.asarray(np.stack([F.to_limbs(v) for v in zv], axis=1))
+    dig = jnp.asarray(np.array([pm2, pm2], dtype=np.int32))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((F.NLIMBS, b), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(zcol.shape),
+            pl.BlockSpec((2, 64), memory_space=pltpu.SMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((16, F.NLIMBS, b), jnp.int32),
+            pltpu.VMEM((16, F.NLIMBS, b), jnp.int32),
+            pltpu.VMEM((16, F.NLIMBS, b), jnp.int32),
+        ],
+        interpret=_INTERPRET,
+    )(zcol, dig)
+    got = np.asarray(out)
+    for i in (0, b - 1):
+        assert F.from_limbs(got[:, i]) == 1, (i, F.from_limbs(got[:, i]))
+
+
+def _pow_descan() -> None:
+    """The ISSUE-8 de-scanned pow ladder: 64 UNROLLED windows with
+    static digits (plain static powtab indices, no per-digit selects,
+    no fori_loop).  XLA-CPU chokes on the program size (the measured
+    reason TPUNODE_POW_LADDER defaults to scan); whether Mosaic compiles
+    it — and faster than the fori_loop form — is exactly what this case
+    answers."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpunode.verify import field as F
+    from tpunode.verify import pallas_field as PF
+
+    b = 256
+    exp = (F.P - 1) // 2
+    digits = [(exp >> (4 * (63 - w))) & 0xF for w in range(64)]
+
+    def kernel(a_ref, o_ref, powtab_ref):
+        one = jnp.concatenate(
+            [jnp.ones((1, b), jnp.int32),
+             jnp.zeros((F.NLIMBS - 1, b), jnp.int32)], axis=0)
+        t = a_ref[...]
+        powtab_ref[0] = one
+        powtab_ref[1] = t
+        for k in range(2, 16):  # log-depth static build
+            src = powtab_ref[k // 2] if k % 2 == 0 else powtab_ref[k - 1]
+            powtab_ref[k] = (
+                PF.sqr(src) if k % 2 == 0 else PF.mul(src, t)
+            )
+        acc = powtab_ref[digits[0]]
+        for d in digits[1:]:
+            acc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(acc))))
+            if d:
+                acc = PF.mul(acc, powtab_ref[d])
+        o_ref[...] = PF.canonical(acc)
+
+    rng = np.random.default_rng(19)
+    av = [int(rng.integers(2, 2**61)) ** 2 % F.P for _ in range(b)]  # QRs
+    a = jnp.asarray(np.stack([F.to_limbs(v) for v in av], axis=1))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        scratch_shapes=[pltpu.VMEM((16, F.NLIMBS, b), jnp.int32)],
+        interpret=_INTERPRET,
+    )(a)
+    for i in (0, b - 1):
+        got = F.from_limbs(np.asarray(out)[:, i])
+        assert got == 1, (i, got)
+
+
+def _select_tree() -> None:
+    """The ISSUE-8 balanced 4-level select tree over a VMEM table ref
+    (kernel/pallas _select16 tree mode): select entry d per lane via 15
+    bit-resolved wheres; the selected power must equal t^d."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpunode.verify import field as F
+    from tpunode.verify import pallas_field as PF
+
+    b = 256
+
+    def kernel(a_ref, d_ref, o_ref, tab_ref):
+        one = jnp.concatenate(
+            [jnp.ones((1, b), jnp.int32),
+             jnp.zeros((F.NLIMBS - 1, b), jnp.int32)], axis=0)
+        t = a_ref[...]
+        tab_ref[0] = one
+        tab_ref[1] = t
+
+        def build(k, c):
+            tab_ref[pl.ds(k, 1)] = PF.mul(
+                tab_ref[pl.ds(k - 1, 1)][0], t)[None]
+            return c
+
+        lax.fori_loop(2, 16, build, 0)
+        d = d_ref[...]  # (1, B)
+        level = [tab_ref[tv] for tv in range(16)]
+        for i in range(4):
+            bit = ((d >> i) & 1) == 1
+            level = [
+                jnp.where(bit, level[2 * j + 1], level[2 * j])
+                for j in range(len(level) // 2)
+            ]
+        o_ref[...] = PF.canonical(level[0])
+
+    rng = np.random.default_rng(23)
+    av = [int(rng.integers(2, 2**31)) for _ in range(b)]
+    dv = [int(rng.integers(0, 16)) for _ in range(b)]
+    a = jnp.asarray(np.stack([F.to_limbs(v) for v in av], axis=1))
+    d = jnp.asarray(np.array(dv, dtype=np.int32)[None])
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        scratch_shapes=[pltpu.VMEM((16, F.NLIMBS, b), jnp.int32)],
+        interpret=_INTERPRET,
+    )(a, d)
+    got = np.asarray(out)
+    for i in (0, 7, b - 1):
+        assert F.from_limbs(got[:, i]) == pow(av[i], dv[i], F.P), i
+
+
 def _flagship() -> None:
     import jax.numpy as jnp
 
@@ -303,6 +551,10 @@ def main() -> None:
                      ("table_build", _table_build),
                      ("pow_window", _pow_window),
                      ("pow_window_smem", _pow_window_smem),
+                     ("mixed_add", _mixed_add),
+                     ("batch_inv", _batch_inv),
+                     ("pow_descan", _pow_descan),
+                     ("select_tree", _select_tree),
                      ("flagship", _flagship)):
         out = _case(name, fn)
         res["cases"].append(out)
@@ -325,6 +577,22 @@ def main() -> None:
             # formulation (the PERF.md MXU-path verdict wants this fact).
             res["verdict"] = ("healthy; int32 dot_general formulation "
                               "not lowerable (MXU knob stays off on TPU)")
+        elif "select_tree" in failed:
+            # NOT a calming verdict: the select tree is the DEFAULT
+            # (TPUNODE_SELECT16=tree rides in the flagship), so a
+            # failing tree lowering takes the pallas headline down with
+            # it — the operator escape hatch is the onehot knob.
+            res["verdict"] = ("repo: DEFAULT select-tree lowering "
+                              "failing — set TPUNODE_SELECT16=onehot to "
+                              "restore the flagship; failing = "
+                              + ",".join(failed))
+        elif failed and set(failed) <= {"field_mul_dot", "mixed_add",
+                                        "batch_inv", "pow_descan"}:
+            # Default programs healthy; only OFF-BY-DEFAULT experimental
+            # primitives fail — the corresponding knobs stay off on TPU
+            # (PERF.md records which).
+            res["verdict"] = ("healthy; experimental primitives failing: "
+                              + ",".join(failed))
         elif oks.get("trivial"):
             res["verdict"] = f"repo: failing constructs = {','.join(failed)}"
     print(json.dumps(res))

@@ -74,58 +74,136 @@ class CountingField:
 
 
 def _point_op_counts():
-    """(pt_add_counts, pt_double_counts) by running the live formulas."""
+    """(pt_add, pt_double, pt_add_mixed) counts by running the live
+    formulas — the mixed add (RCB'16 Algorithm 8, ISSUE 8) is the affine
+    window loop's addition; its 11M+2 must pin one full mul under the
+    projective add's 12M+2."""
     import jax.numpy as jnp
 
     from tpunode.verify import field as F
-    from tpunode.verify.curve import pt_add, pt_double
+    from tpunode.verify.curve import pt_add, pt_add_mixed, pt_double
 
     one = jnp.asarray(F.ONE)
     p = jnp.stack([one, one, one], axis=0)
+    q2 = jnp.stack([one, one], axis=0)
     cf = CountingField(F)
     pt_add(p, p, F=cf)
     add_counts = dict(cf.counts)
     cf = CountingField(F)
     pt_double(p, F=cf)
     dbl_counts = dict(cf.counts)
-    return add_counts, dbl_counts
+    cf = CountingField(F)
+    pt_add_mixed(p, q2, F=cf)
+    mixed_counts = dict(cf.counts)
+    return add_counts, dbl_counts, mixed_counts
+
+
+def _batch_inversion_counts():
+    """Field-op counts of the affine Q-table batch normalization
+    (kernel._normalize_q_table: prefix/suffix products + per-entry X/Y
+    scaling), by EXECUTING the live helper with a counting namespace.
+    The shared Fermat ladder is counted separately (`_pow_ladder_model`)
+    — the stub pow_const here contributes zero ops."""
+    import jax.numpy as jnp
+
+    from tpunode.verify import field as F
+    from tpunode.verify import kernel as K
+
+    one = jnp.asarray(F.ONE)
+    qt = jnp.stack([jnp.stack([one, one, one], axis=0)] * 16, axis=0)
+    cf = CountingField(F)
+    K._normalize_q_table(qt, F=cf, pow_const=lambda t, d: t)
+    return dict(cf.counts)
+
+
+def _pow_ladder_model(digits) -> collections.Counter:
+    """Field-op counts of one constant-exponent pow ladder under the
+    ACTIVE ladder mode (kernel.pow_ladder_mode()).
+
+    ``scan``: 14 sequential table muls, then per digit window 4
+    squarings + 1 table mul.  ``unroll`` (de-scanned, ISSUE 8 lever 2):
+    log-depth table build (7 sqr + 7 mul), the MSB window seeds the
+    accumulator for free, zero digits skip their mul."""
+    from tpunode.verify import kernel as K
+
+    tab_entries = 1 << K.WINDOW_BITS
+    n = len(digits)
+    if K.pow_ladder_mode() == "scan":
+        return collections.Counter(
+            {"mul": (tab_entries - 2) + n, "sqr": K.WINDOW_BITS * n}
+        )
+    c = collections.Counter()
+    for k in range(2, tab_entries):
+        c["sqr" if k % 2 == 0 else "mul"] += 1
+    c["sqr"] += K.WINDOW_BITS * (n - 1)
+    c["mul"] += sum(1 for d in list(digits)[1:] if int(d))
+    return c
+
+
+def _q_table_build_model(add_c: dict, dbl_c: dict) -> collections.Counter:
+    """Field-op counts of the on-device Q-table build under the ACTIVE
+    ladder mode: ``scan`` = 14 sequential complete adds; ``unroll`` = a
+    log-depth double-and-add chain (7 doublings + 7 additions — fewer
+    muls AND a ~5-deep critical path)."""
+    from tpunode.verify import kernel as K
+
+    tab_entries = 1 << K.WINDOW_BITS
+    if K.pow_ladder_mode() == "scan":
+        return _scale(add_c, tab_entries - 2)
+    c = collections.Counter()
+    for k in range(2, tab_entries):
+        c.update(dbl_c if k % 2 == 0 else add_c)
+    return c
 
 
 def _scale(counts: dict, k: int) -> collections.Counter:
     return collections.Counter({op: n * k for op, n in counts.items()})
 
 
-def field_op_model() -> dict:
+def field_op_model(point_form: "str | None" = None) -> dict:
     """Per-verify (per lane) field-op counts for each signature algorithm,
-    assembled from kernel.py's structure."""
+    assembled from kernel.py's structure under the ACTIVE formulation
+    modes (or ``point_form`` explicitly — the affine/projective A/B the
+    ISSUE 8 acceptance wants stated side by side)."""
+    from tpunode.verify import curve as C
     from tpunode.verify import kernel as K
 
-    add_c, dbl_c = _point_op_counts()
+    form = point_form or C.point_form()
+    add_c, dbl_c, mixed_c = _point_op_counts()
     tab_entries = 1 << K.WINDOW_BITS  # 16
-    tab_adds = tab_entries - 2  # scan length in _build_q_table
     halves = sum(
         1 for name, nd in K._DEVICE_FIELDS if nd == 2 and name.startswith("d")
     )  # the 4 GLV half-scalar digit streams
     pow_digits = len(K._EULER_DIGITS)  # 64 4-bit windows
     assert len(K._PM2_DIGITS) == pow_digits
 
-    msm = _scale(dbl_c, K.WINDOWS * halves) + _scale(add_c, K.WINDOWS * halves)
-    q_table = _scale(add_c, tab_adds)
+    pow_ladder = _pow_ladder_model(K._PM2_DIGITS)
+    euler_ladder = _pow_ladder_model(K._EULER_DIGITS)
+    q_table = _q_table_build_model(add_c, dbl_c)
     lambda_table = collections.Counter({"mul": tab_entries})  # β·X per entry
 
-    # _pow_const: table build = (16-2) muls via scan, then per digit
-    # window WINDOW_BITS squarings + one table mul.
-    pow_ladder = collections.Counter(
-        {"mul": (tab_entries - 2) + pow_digits, "sqr": K.WINDOW_BITS * pow_digits}
-    )
+    msm = _scale(dbl_c, K.WINDOWS * halves)
+    batch_inv = collections.Counter()
+    if form == "affine":
+        # mixed additions against the batch-normalized 2-coordinate
+        # tables (ISSUE 8): one Montgomery-trick inversion per lane —
+        # prefix/suffix/normalize muls counted by executing the live
+        # helper, plus ONE shared Fermat ladder over the whole table.
+        msm += _scale(mixed_c, K.WINDOWS * halves)
+        batch_inv = collections.Counter(_batch_inversion_counts())
+        batch_inv += pow_ladder
+    else:
+        msm += _scale(add_c, K.WINDOWS * halves)
 
     accept_ecdsa = collections.Counter({"mul": 2})  # m1, m2 projective checks
     on_curve = collections.Counter({"mul": 1, "sqr": 2})  # qy² = qx³ + 7
 
-    base = msm + q_table + lambda_table + accept_ecdsa + on_curve
+    base = (
+        msm + q_table + batch_inv + lambda_table + accept_ecdsa + on_curve
+    )
     ecdsa = base
     # BCH Schnorr: + jacobi(Y·Z) Euler pow (1 mul + ladder)
-    schnorr = base + collections.Counter({"mul": 1}) + pow_ladder
+    schnorr = base + collections.Counter({"mul": 1}) + euler_ladder
     # BIP340: + Fermat inverse Z^(p-2) (ladder) + y = Y·Z⁻¹ (1 mul)
     bip340 = base + collections.Counter({"mul": 1}) + pow_ladder
 
@@ -138,11 +216,16 @@ def field_op_model() -> dict:
     return {
         "pt_add": dict(add_c),
         "pt_double": dict(dbl_c),
+        "pt_add_mixed": dict(mixed_c),
+        "point_form": form,
         "structure": {
             "windows": K.WINDOWS,
             "half_scalars": halves,
             "table_entries": tab_entries,
             "pow_digits": pow_digits,
+            "pow_ladder": K.pow_ladder_mode(),
+            "select16": K.select_mode(),
+            "batch_inversion": flat(batch_inv) if batch_inv else None,
         },
         "per_verify": {
             "ecdsa": flat(ecdsa),
@@ -302,16 +385,7 @@ MEASURED = {
 }
 
 
-def roofline(chip: str = "v5e") -> dict:
-    """The full model: op counts -> per-verify work -> ideal rates ->
-    utilization of the measured rates."""
-    from tpunode.verify import field as F
-
-    ch = CHIPS[chip]
-    ops = field_op_model()
-    macs = mac_model()
-    leaf = field_leaf_costs()
-
+def _per_algo_work(ops: dict, macs: dict, leaf: dict) -> dict:
     per_algo = {}
     for algo, counts in ops["per_verify"].items():
         mac_total = sum(
@@ -334,6 +408,23 @@ def roofline(chip: str = "v5e") -> dict:
             "vector_int_ops": int(vec_total),
             "vector_mul_ops": int(vec_mul),
         }
+    return per_algo
+
+
+def roofline(chip: str = "v5e") -> dict:
+    """The full model: op counts -> per-verify work -> ideal rates ->
+    utilization of the measured rates — under the ACTIVE formulation
+    modes, with a projective-vs-affine comparison block (ISSUE 8)."""
+    from tpunode.verify import curve as C
+    from tpunode.verify import field as F
+    from tpunode.verify import kernel as K
+
+    ch = CHIPS[chip]
+    ops = field_op_model()
+    macs = mac_model()
+    leaf = field_leaf_costs()
+
+    per_algo = _per_algo_work(ops, macs, leaf)
 
     vpu_ops_s = ch["vpu_lanes"] * ch["vpu_issue"] * ch["clock_ghz"] * 1e9
     mxu_macs_s = ch["mxu_int8_tops"] * 1e12 / 2  # TOPS counts mul+add
@@ -348,6 +439,21 @@ def roofline(chip: str = "v5e") -> dict:
                 w["int8_macs_if_packed"] / mxu_macs_s
                 + (w["vector_int_ops"] - w["vector_mul_ops"]) / vpu_ops_s
             ),
+        }
+
+    # Projective-vs-affine A/B at the arithmetic floor (ECDSA headline
+    # workload): the affine form trades one batch inversion (one Fermat
+    # ladder + ~67 muls per lane) for 132 cheaper window additions and a
+    # third less select traffic — the FIELD-OP floor moves one way, the
+    # non-arithmetic overhead the other; the measured step-time delta
+    # (PERF.md) is the decider.
+    form_compare = {}
+    for form in C.POINT_FORMS:
+        w = _per_algo_work(field_op_model(form), macs, leaf)["ecdsa"]
+        form_compare[form] = {
+            "field_muls": w["field_muls"],
+            "vector_int_ops": w["vector_int_ops"],
+            "vpu_bound_sigs_s": round(vpu_ops_s / w["vector_int_ops"]),
         }
 
     # Bytes per lane over the PCIe/HBM boundary (device inputs + verdict):
@@ -370,6 +476,12 @@ def roofline(chip: str = "v5e") -> dict:
         "chip": chip,
         "chip_model": ch,
         "field_modes": {"mul": F.mul_mode(), "sqr": F.sqr_mode()},
+        "kernel_modes": {
+            "point_form": C.point_form(),
+            "select16": K.select_mode(),
+            "pow_ladder": K.pow_ladder_mode(),
+        },
+        "point_form_compare": form_compare,
         "op_model": ops,
         "mac_model": macs,
         "leaf_costs": {k: {kk: round(vv, 1) for kk, vv in v.items()}
@@ -419,6 +531,15 @@ def _markdown(r: dict) -> str:
         f"on the MXU at int8 (dot_general + packing; carry/fold stays on "
         f"the VPU and dominates that bound)."
     )
+    lines.append("")
+    lines.append("| point form (ecdsa) | field muls | vector int ops "
+                 "| all-VPU bound (sigs/s) |")
+    lines.append("|---|---|---|---|")
+    for form, w in r["point_form_compare"].items():
+        lines.append(
+            f"| {form} | {w['field_muls']} | {w['vector_int_ops']:,} "
+            f"| {w['vpu_bound_sigs_s']:,} |"
+        )
     return "\n".join(lines)
 
 

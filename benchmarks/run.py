@@ -301,7 +301,9 @@ def config3() -> None:
         async def factory():
             to_node: asyncio.Queue = asyncio.Queue()
             from_node: asyncio.Queue = asyncio.Queue()
-            task = asyncio.ensure_future(fast_remote(to_node, from_node))
+            task = asyncio.ensure_future(  # asyncsan: disable=raw-spawn (bench harness task, cancelled in finally)
+                fast_remote(to_node, from_node)
+            )
             try:
                 yield QueueConnection(to_node, from_node)
             finally:
@@ -366,7 +368,9 @@ def config3() -> None:
                 )
                 header_s = time.perf_counter() - t0
                 assert node.chain.get_best().height == n_blocks
-                counter = asyncio.ensure_future(count_events(events))
+                counter = asyncio.ensure_future(  # asyncsan: disable=raw-spawn (bench harness task, cancelled in finally)
+                    count_events(events)
+                )
                 try:
                     t0 = time.perf_counter()
                     hashes = [b.header.hash for b in blocks]
@@ -453,7 +457,7 @@ def config4() -> None:
             async def factory():
                 to_node: asyncio.Queue = asyncio.Queue()
                 from_node: asyncio.Queue = asyncio.Queue()
-                remote = asyncio.ensure_future(
+                remote = asyncio.ensure_future(  # asyncsan: disable=raw-spawn (bench harness task, cancelled in finally)
                     _fake_remote(net, blocks, to_node, from_node)
                 )
 
@@ -473,7 +477,9 @@ def config4() -> None:
                             i += 1
                         await asyncio.sleep(0)
 
-                pumper = asyncio.ensure_future(pump())
+                pumper = asyncio.ensure_future(  # asyncsan: disable=raw-spawn (bench harness task, cancelled in finally)
+                    pump()
+                )
                 try:
                     yield QueueConnection(to_node, from_node)
                 finally:

@@ -73,7 +73,9 @@ async def remote(reader, writer, writers):
                     await writer.drain()
                     await asyncio.sleep(0.05)
 
-        pumper = asyncio.ensure_future(pump())
+        pumper = asyncio.ensure_future(  # asyncsan: disable=raw-spawn (soak harness task, cancelled in finally)
+            pump()
+        )
         try:
             while True:
                 hdr_raw = await reader.readexactly(HEADER_SIZE)
@@ -124,7 +126,9 @@ async def main():
 
     async with pub.subscription() as events:
         async with Node(cfg) as node:
-            consumer = asyncio.ensure_future(consume(events))
+            consumer = asyncio.ensure_future(  # asyncsan: disable=raw-spawn (soak harness task, cancelled on teardown)
+                consume(events)
+            )
             await asyncio.sleep(5)
             gc.collect()
             base_tasks = len(asyncio.all_tasks())

@@ -93,6 +93,15 @@ FIRSTBANK_LADDER = (
     (8192, 180.0, None),
     (16384, 420.0, "xla"),
 )
+# Affine point-form rungs (ISSUE 8): once per round after the configs,
+# bank a device number for the new formulation (kind="affine" rows —
+# bench.py's headline fallback ignores them, so a slower affine sample
+# can never mask the projective headline).  The pallas rung leads; the
+# XLA rung is the Mosaic-outage fallback, same discipline as LADDER.
+AFFINE_LADDER = (
+    (32768, 360.0, None),
+    (8192, 300.0, "xla"),
+)
 CONFIG_BUDGETS = {"config2": 600.0, "config5": 900.0, "config3": 900.0}
 # Sweep order: config2 is cheap; config3 (full-node IBD on device) is
 # the VERDICT item-2 money shot and must be banked before config5,
@@ -139,6 +148,10 @@ _mosaic_broken = False
 # Set after the first banked headline: later sweeps chase the pallas
 # number; until then FIRSTBANK_LADDER banks the quickest device number.
 _headline_banked = False
+# AFFINE-program-only Mosaic/timeout failures (ISSUE 8 rungs): kept
+# separate from _mosaic_broken so an experiment that Mosaic can't lower
+# never degrades the projective headline ladder (review r8).
+_affine_pallas_broken = False
 
 BENCH_LOCK = os.path.join(REPO, "benchmarks", ".bench_running")
 
@@ -176,7 +189,7 @@ def run_headline(
     ``pallas_only``: the same-window upgrade attempt after an XLA
     first-bank — only the pallas rungs are worth running (an XLA number
     is already on disk)."""
-    global _mosaic_broken, _headline_banked
+    global _mosaic_broken, _headline_banked, _affine_pallas_broken
     if pallas_only:
         rungs = [r for r in LADDER if r[2] is None]
     elif _mosaic_broken:
@@ -198,8 +211,13 @@ def run_headline(
         )
         if res.get("ok"):
             if kernel is None:
-                # pallas works (again): restore the full-budget ladder
+                # pallas works (again): restore the full-budget ladder,
+                # and give the affine pallas rung its chance back too —
+                # a transient tunnel hang on the affine rung must not
+                # skip it for the rest of a multi-hour watcher session
+                # once the flagship proves Mosaic healthy (review r8)
                 _mosaic_broken = False
+                _affine_pallas_broken = False
             _headline_banked = True
             _record("headline", {
                 "metric": "sig_verify_throughput",
@@ -245,6 +263,64 @@ def run_headline(
             _mosaic_broken = True
             rungs = [r for r in rungs if r[2] == "xla"]
     return None, "exhausted", pallas_failed
+
+
+def run_affine() -> bool:
+    """One pass over the affine point-form rungs (ISSUE 8): bank a
+    device number for the new formulation as a ``kind="affine"`` row.
+    Returns True when a sample was banked (the once-per-round slot is
+    then spent).  Same short-window discipline as the headline sweep:
+    yield to bench.py, abort on tunnel loss, fast-skip the pallas rung
+    during a Mosaic outage, and treat a fatal verdict mismatch exactly
+    like the headline's (recorded — poisoning the round — and raised).
+
+    A failing AFFINE pallas rung sets only the affine-local broken flag
+    (review r8): the affine program carries primitives Mosaic may reject
+    while the projective flagship lowers fine (exactly what the
+    mosaic_diag mixed_add/batch_inv cases probe), so conflating it with
+    ``_mosaic_broken`` would degrade the PROJECTIVE headline ladder for
+    the rest of the round over an experiment's failure."""
+    global _affine_pallas_broken
+    rungs = (
+        [r for r in AFFINE_LADDER if r[2] == "xla"]
+        if (_mosaic_broken or _affine_pallas_broken)
+        else list(AFFINE_LADDER)
+    )
+    for batch, budget, kernel in rungs:
+        if _bench_running():
+            _log("affine: bench.py running — yielding the tunnel")
+            return False
+        env, label = worker_rung_env(batch, kernel, point_form="affine")
+        res = _run_json(
+            [sys.executable, "bench.py", "--worker"], budget, env,
+        )
+        if res.get("ok"):
+            _record("affine", {
+                "metric": "sig_verify_throughput",
+                "value": round(res["rate"], 1), "unit": "sigs/sec/chip",
+                "device": res.get("device"), "kernel": res.get("kernel"),
+                "point_form": res.get("point_form", "affine"),
+                "batch": res.get("batch"), "step_ms": res.get("step_ms"),
+                "compile_s": res.get("compile_s"),
+                "init_s": res.get("init_s"),
+            })
+            return True
+        err = str(res.get("error", ""))
+        _log(f"affine {label}: {err or '?'}")
+        if res.get("fatal"):
+            # an affine/oracle verdict mismatch is a kernel correctness
+            # failure like any other: poison the round's sampling
+            _record("fatal", {"error": res.get("error"),
+                              "point_form": "affine"})
+            raise FatalMismatch(res.get("error", "verdict mismatch"))
+        if "initializing backend" in err or "probing backend" in err:
+            _log("affine: tunnel lost — back to probing")
+            return False
+        if kernel is None and ("MosaicError" in err or "timed out" in err):
+            _log("affine: pallas AFFINE program broken/hanging — affine "
+                 "XLA rung only (projective headline ladder unaffected)")
+            _affine_pallas_broken = True
+    return False
 
 
 def run_config(name: str) -> dict | None:
@@ -429,7 +505,8 @@ def _rotate_runs_file() -> list[dict]:
 
 def handle_window(swept: set) -> float:
     """One live-window pass: headline sweep, same-window pallas upgrade,
-    config sweep, once-per-round Mosaic diagnostic.  Mutates ``swept``
+    config sweep, once-per-round affine point-form sample (ISSUE 8),
+    once-per-round Mosaic diagnostic.  Mutates ``swept``
     (the on-device captures so far this round) and returns the sleep
     interval until the next probe.  Raises FatalMismatch to stop the
     watcher for the round.
@@ -469,6 +546,12 @@ def handle_window(swept: set) -> float:
         for name in CONFIG_ORDER:
             if name not in swept and run_config(name) is not None:
                 swept.add(name)
+        # Affine point-form sample (ISSUE 8): once per round, AFTER the
+        # configs — the projective headline and the config money shots
+        # outrank banking the new formulation's number, and a short
+        # window must not spend itself on the experiment first.
+        if "affine" not in swept and run_affine():
+            swept.add("affine")
     if (
         (why == "exhausted" or (head is not None and _mosaic_broken))
         and "mosaic_diag" not in swept

@@ -31,17 +31,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_tree_is_clean():
-    """ISSUE 3 acceptance: the analyzer over the real tree finds nothing."""
+    """ISSUE 3 acceptance (extended over benchmarks/ by ISSUE 8): the
+    analyzer over the real tree finds nothing."""
     findings = Analyzer().check_paths(
-        [os.path.join(REPO, "tpunode"), os.path.join(REPO, "bench.py")]
+        [
+            os.path.join(REPO, "tpunode"),
+            os.path.join(REPO, "bench.py"),
+            os.path.join(REPO, "benchmarks"),
+        ]
     )
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
-def test_default_paths_cover_package_and_bench():
+def test_default_paths_cover_package_bench_and_benchmarks():
     paths = default_paths()
     assert paths[0].endswith("tpunode")
     assert paths[1].endswith("bench.py")
+    assert paths[2].endswith("benchmarks")
 
 
 # --- per-rule fixtures -------------------------------------------------------

@@ -190,6 +190,116 @@ def test_roofline_full_model_runs():
         assert 0.0 < u["of_mxu_bound"] < 1.0, label
 
 
+def test_roofline_affine_op_model_pins():
+    """ISSUE 8: the affine op model's pins — mixed add = 11M + 2 reduced
+    scalings (one full mul under the projective add), batch inversion =
+    67 prefix/suffix/normalize muls + one shared Fermat ladder, and the
+    per-verify assembly recomputed structurally."""
+    from benchmarks.roofline import field_op_model
+    from tpunode.verify.kernel import WINDOW_BITS, WINDOWS
+
+    m = field_op_model("affine")
+    assert m["point_form"] == "affine"
+    mixed, add, dbl = m["pt_add_mixed"], m["pt_add"], m["pt_double"]
+    assert mixed["mul"] + mixed.get("mul_t", 0) == 11  # RCB'16 Alg 8
+    assert mixed["mul_small_red"] == 2
+    per_add = sum(add.values())
+    per_mixed = sum(mixed.values())
+    per_dbl = sum(dbl.values())
+    assert per_mixed == per_add - 1  # the lever: 1 full mul per window add
+
+    inv = m["structure"]["batch_inversion"]
+    # prefix 13 + suffix 26 + X/Y normalize 28 = 67 muls, plus the scan-
+    # mode Fermat ladder (14 table muls + 64 window muls + 4*64 sqr)
+    assert inv["mul"] == 67 + 14 + 64
+    assert inv["sqr"] == 4 * 64
+
+    tab = 1 << WINDOW_BITS
+    expect = (
+        WINDOWS * 4 * (per_dbl + per_mixed)  # MSM with mixed adds
+        + (tab - 2) * per_add                # q-table build (scan mode)
+        + inv["total_mul_like"]              # batch inversion
+        + tab                                # λ-table β·X
+        + 2 + 3                              # m1/m2 + on-curve
+    )
+    ecdsa = m["per_verify"]["ecdsa"]["total_mul_like"]
+    assert ecdsa == expect
+    proj = field_op_model("projective")["per_verify"]["ecdsa"][
+        "total_mul_like"]
+    # affine = projective - 132 cheaper adds + the inversion's cost
+    assert ecdsa == proj - WINDOWS * 4 + inv["total_mul_like"]
+
+
+def test_roofline_point_form_compare_block():
+    """roofline() states the projective-vs-affine arithmetic floors side
+    by side (the ISSUE 8 acceptance's 'restates utilization')."""
+    from benchmarks.roofline import roofline
+
+    r = roofline()
+    pc = r["point_form_compare"]
+    assert set(pc) == {"projective", "affine"}
+    for w in pc.values():
+        assert w["field_muls"] > 0
+        assert w["vector_int_ops"] > 0
+        assert w["vpu_bound_sigs_s"] > 0
+    assert r["kernel_modes"]["point_form"] in ("projective", "affine")
+    # the ECDSA mul totals really are per-form (not one model twice)
+    assert pc["affine"]["field_muls"] != pc["projective"]["field_muls"]
+
+
+@pytest.mark.slow  # ~35 s of interpret-mode numpy in a subprocess
+def test_mosaic_diag_affine_primitive_cases():
+    """The ISSUE-8 mosaic_diag repro cases (mixed add, batch inversion,
+    select tree) pass in interpret mode; the de-scanned pow case — whose
+    interpret run is ~3 min of numpy — has its own slow test below."""
+    env = dict(os.environ)
+    env.update(TPUNODE_DIAG_INTERPRET="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "from benchmarks import mosaic_diag as d;"
+            "import json;"
+            "print(json.dumps([d._case('mixed_add', d._mixed_add),"
+            "                  d._case('batch_inv', d._batch_inv),"
+            "                  d._case('select_tree', d._select_tree)]))",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    cases = json.loads(out.stdout.strip().splitlines()[-1])
+    assert [c["ok"] for c in cases] == [True] * 3, cases
+
+
+@pytest.mark.slow  # ~3 min of interpret-mode numpy for 64 unrolled windows
+def test_mosaic_diag_pow_descan_case():
+    env = dict(os.environ)
+    env.update(TPUNODE_DIAG_INTERPRET="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "from benchmarks import mosaic_diag as d;"
+            "import json;"
+            "print(json.dumps([d._case('pow_descan', d._pow_descan)]))",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    cases = json.loads(out.stdout.strip().splitlines()[-1])
+    assert [c["ok"] for c in cases] == [True], cases
+
+
 def test_roofline_jaxpr_walk_counts_scans():
     """The jaxpr walker multiplies scan bodies by their trip count (a
     wrong multiplier would silently corrupt every derived bound)."""
@@ -281,6 +391,7 @@ def test_handle_window_skips_upgrade_after_pallas_failure(monkeypatch):
     pallas-only upgrade must NOT re-run them."""
     watcher = _load_watcher()
     monkeypatch.setattr(watcher, "run_config", lambda name: None)
+    monkeypatch.setattr(watcher, "run_affine", lambda: False)
     upgrade_calls = []
 
     def fake_run_headline(pallas_only=False):
@@ -302,6 +413,142 @@ def test_handle_window_skips_upgrade_after_pallas_failure(monkeypatch):
     monkeypatch.setattr(watcher, "run_headline", fake_run_headline2)
     watcher.handle_window(set())
     assert upgrade_calls == [1]  # pallas untried this sweep: upgrade runs
+
+
+def test_run_affine_banks_kind_affine(monkeypatch, tmp_path):
+    """ISSUE 8: the watcher's affine rungs bank a ``kind="affine"`` row
+    (NOT "headline" — bench.py's fallback must never report an affine
+    sample as the projective headline), pass TPUNODE_POINT_FORM to the
+    worker, and keep only the XLA rung during a Mosaic outage."""
+    watcher = _load_watcher()
+    runs = tmp_path / "runs.jsonl"
+    monkeypatch.setattr(watcher, "RUNS_PATH", str(runs))
+    monkeypatch.setattr(watcher, "_bench_running", lambda: False)
+
+    calls = []
+
+    def fake_run_json(argv, timeout, env=None):
+        calls.append(env or {})
+        return {"ok": True, "rate": 123456.0, "device": "tpu:v5e",
+                "kernel": "pallas", "point_form": "affine", "batch": 32768}
+
+    monkeypatch.setattr(watcher, "_run_json", fake_run_json)
+    assert watcher.run_affine() is True
+    assert calls[0].get("TPUNODE_POINT_FORM") == "affine"
+    rows = [json.loads(line) for line in open(runs)]
+    assert [r["kind"] for r in rows] == ["affine"]
+    assert rows[0]["point_form"] == "affine"
+    # bench.py's headline fallback ignores the affine row
+    import bench
+
+    assert bench._freshest_device_run(str(runs)) is None
+
+    # Mosaic outage: only the XLA rung is attempted
+    calls.clear()
+    watcher._mosaic_broken = True
+    assert watcher.run_affine() is True
+    assert len(calls) == 1
+    assert calls[0].get("TPUNODE_BENCH_KERNEL") == "xla"
+
+
+def test_run_affine_pallas_failure_does_not_degrade_headline(
+    monkeypatch, tmp_path
+):
+    """Review r8: a MosaicError on the AFFINE pallas rung sets only the
+    affine-local broken flag — the projective headline ladder's
+    _mosaic_broken must stay untouched (the affine program carries
+    primitives Mosaic may reject while the flagship lowers fine)."""
+    watcher = _load_watcher()
+    monkeypatch.setattr(watcher, "RUNS_PATH", str(tmp_path / "runs.jsonl"))
+    monkeypatch.setattr(watcher, "_bench_running", lambda: False)
+
+    calls = []
+
+    def fake_run_json(argv, timeout, env=None):
+        calls.append(env or {})
+        if env and env.get("TPUNODE_BENCH_KERNEL") == "xla":
+            return {"ok": True, "rate": 50000.0, "device": "tpu:v5e",
+                    "kernel": "xla", "point_form": "affine", "batch": 8192}
+        return {"ok": False,
+                "error": "MosaicError: cannot lower mixed_add"}
+
+    monkeypatch.setattr(watcher, "_run_json", fake_run_json)
+    assert watcher.run_affine() is True  # banked via the XLA affine rung
+    assert watcher._affine_pallas_broken is True
+    assert watcher._mosaic_broken is False  # headline ladder unaffected
+    # later affine attempts skip straight to the XLA rung
+    calls.clear()
+    watcher.run_affine()
+    assert len(calls) == 1
+    assert calls[0].get("TPUNODE_BENCH_KERNEL") == "xla"
+
+
+def test_run_affine_fatal_poisons_round(monkeypatch, tmp_path):
+    """An affine/oracle verdict mismatch is a correctness failure like
+    any other: recorded as a fatal row (poisoning bench.py's watcher
+    fallback) and raised."""
+    watcher = _load_watcher()
+    runs = tmp_path / "runs.jsonl"
+    monkeypatch.setattr(watcher, "RUNS_PATH", str(runs))
+    monkeypatch.setattr(watcher, "_bench_running", lambda: False)
+    monkeypatch.setattr(
+        watcher, "_run_json",
+        lambda argv, timeout, env=None: {
+            "ok": False, "fatal": True, "error": "verdict mismatch"},
+    )
+    with pytest.raises(watcher.FatalMismatch):
+        watcher.run_affine()
+    rows = [json.loads(line) for line in open(runs)]
+    assert rows[0]["kind"] == "fatal"
+    import bench
+
+    # a fatal row disables the headline fallback for the round
+    with open(runs, "a") as f:
+        f.write(json.dumps({"kind": "headline", "unix": 10**10,
+                            "ts": "t", "value": 1.0,
+                            "device": "tpu:v5e"}) + "\n")
+    assert bench._freshest_device_run(str(runs)) is None
+
+
+# ---------- bench kernel point-form A/B section (ISSUE 8) -------------------
+
+
+def test_kernel_section_shape_and_labels(monkeypatch):
+    """The BENCH ``kernel`` section: per-batch workers, failure-labeled
+    cells, and the 32768 cell disabled by default with a reasoned
+    label."""
+    import bench
+
+    calls = []
+
+    def fake_run_worker(mode, timeout, env=None):
+        calls.append((mode, timeout, env))
+        if env and env.get("TPUNODE_BENCH_KERNELAB_BATCH") == "1024":
+            return {"ok": True, "batch": 1024, "proxy": "cpu-jax",
+                    "iters": 5,
+                    "forms": {"projective": {"step_ms": 2000.0},
+                              "affine": {"step_ms": 2060.0}},
+                    "affine_vs_projective": 0.03}
+        return {"ok": False, "error": "timed out after 1s"}
+
+    monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
+    out = bench._kernel_section()
+    assert out["batch_1024"]["ok"] is True
+    assert out["batch_1024"]["affine_vs_projective"] == 0.03
+    # 32768 disabled by default: labeled, no worker launched for it
+    assert out["batch_32768"]["ok"] is False
+    assert "disabled by default" in out["batch_32768"]["error"]
+    assert [c[0] for c in calls] == ["--kernel-ab"]
+    assert calls[0][2]["TPUNODE_BENCH_KERNELAB_BATCH"] == "1024"
+
+    # env-enabled big batch: attempted and failure-labeled on timeout
+    monkeypatch.setattr(bench, "T_KERNEL_AB_BIG", 60.0)
+    calls.clear()
+    out = bench._kernel_section()
+    assert [c[2]["TPUNODE_BENCH_KERNELAB_BATCH"] for c in calls] == [
+        "1024", "32768"]
+    assert out["batch_32768"] == {"ok": False,
+                                  "error": "timed out after 1s"}
 
 
 # ---------- cpu baseline median-of-N ---------------------------------------

@@ -254,6 +254,56 @@ def test_pallas_field_iota_scatter_matches_numpy():
     assert (got == np.asarray(F._MUL_SCATTER)).all()
 
 
+@pytest.mark.slow  # a fresh interpret trace (~1 min on CPU): tier-1's
+# 870s budget is seed-saturated; the campaign's zero-mismatch
+# pallas-interpret run (PERF.md) carries the tier-1-external evidence
+def test_pallas_affine_matches_projective_and_oracle():
+    """ISSUE 8 acceptance (pallas-interpret): the affine program variant
+    (batch-normalized 2-coordinate tables + mixed adds) verdicts
+    bit-identically to the projective variant and the oracle on an
+    ECDSA-only batch — via the schnorr_free variants the dispatcher
+    selects for the headline workload (the affine one still runs its
+    batch-inversion Fermat ladder)."""
+    items, expected = _mixed_items(9)
+    prep = prepare_batch(items, pad_to=16)
+    assert prep.schnorr_free
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    aff = verify_blocked(*args, interpret=True, block=8, schnorr_free=True,
+                         point_form="affine")
+    proj = verify_blocked(*args, interpret=True, block=8, schnorr_free=True,
+                          point_form="projective")
+    got = [bool(x) for x in np.asarray(aff)[: prep.count]]
+    assert got == expected
+    assert np.array_equal(np.asarray(aff), np.asarray(proj))
+
+
+@pytest.mark.slow  # a full interpret trace with THREE pow ladders (~2 min)
+def test_pallas_affine_full_variant_with_schnorr_lanes():
+    """The affine variant WITHOUT the schnorr_free pruning: a mixed
+    ECDSA + BCH-Schnorr batch must verdict exactly like the oracle
+    (the batch-inversion ladder composing with the jacobi/parity
+    acceptance pows in one kernel)."""
+    from tpunode.verify.ecdsa_cpu import schnorr_challenge, sign_schnorr
+
+    items, _ = _mixed_items(5)
+    priv = 31415926
+    pub = point_mul(priv, GENERATOR)
+    r, s = sign_schnorr(priv, 66, 2024)
+    items = items[:5] + [
+        (pub, schnorr_challenge(r, pub, 66), r, s, "schnorr"),
+        (pub, schnorr_challenge(r, pub, 66) ^ 1, r, s, "schnorr"),
+    ]
+    expected = verify_batch_cpu(items)
+    assert True in expected and False in expected
+    prep = prepare_batch(items, pad_to=8)
+    assert not prep.schnorr_free
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    out = verify_blocked(*args, interpret=True, block=8,
+                         point_form="affine")
+    got = [bool(x) for x in np.asarray(out)[: prep.count]]
+    assert got == expected
+
+
 @pytest.mark.slow  # a third interpret-mode kernel trace (~1 min on CPU)
 def test_pallas_kernel_interpret_dot_general_matches_oracle():
     """The flagship pallas program under the dot_general formulation:

@@ -1,7 +1,9 @@
 """asyncsan CLI: ``python -m tpunode.analysis [--json] [paths...]``.
 
-With no paths, lints the ``tpunode`` package plus the repo-root
-``bench.py`` (the same closure the tier-1 test pins at zero findings).
+With no paths, lints the ``tpunode`` package, the repo-root
+``bench.py``, and the ``benchmarks/`` harness package (the same closure
+the tier-1 test pins at zero findings — ISSUE 8 extended it over
+benchmarks/, whose async harness scripts carry the same hazard classes).
 Exit status: 0 = clean, 1 = findings, 2 = usage error.
 """
 
@@ -18,9 +20,13 @@ from .core import Analyzer, RULES
 def default_paths() -> list[str]:
     pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     paths = [pkg]
-    bench = os.path.join(os.path.dirname(pkg), "bench.py")
+    repo = os.path.dirname(pkg)
+    bench = os.path.join(repo, "bench.py")
     if os.path.isfile(bench):
         paths.append(bench)
+    marks = os.path.join(repo, "benchmarks")
+    if os.path.isdir(marks):
+        paths.append(marks)
     return paths
 
 
@@ -32,8 +38,8 @@ def main(argv: "list[str] | None" = None) -> int:
     )
     parser.add_argument(
         "paths", nargs="*",
-        help="files/directories to lint (default: the tpunode package "
-        "and bench.py)",
+        help="files/directories to lint (default: the tpunode package, "
+        "bench.py, and benchmarks/)",
     )
     parser.add_argument(
         "--json", action="store_true",

@@ -28,13 +28,57 @@ __all__ = [
     "B3",
     "INFINITY",
     "pt_add",
+    "pt_add_mixed",
     "pt_double",
     "pt_select",
     "make_point",
     "is_infinity",
+    "POINT_FORMS",
+    "point_form",
+    "set_point_form",
 ]
 
 B3 = 21  # 3 * b for y^2 = x^3 + 7
+
+
+# ---------- point-form knob (ISSUE 8) --------------------------------------
+#
+# Like field.py's limb-product formulation knobs: process-global, read at
+# TRACE time, so every jitted program that embeds the MSM keys its jit
+# cache on kernel.kernel_modes() (which includes point_form()) and a flip
+# retraces instead of silently keeping the old formulation.
+#
+# "projective" (default): per-signature Q/λQ window tables stay projective
+# (3 coords), window additions use the full 12M+2 RCB complete add.
+# "affine": the tables are batch-normalized to affine (2 coords) with one
+# Montgomery-trick inversion per lane (kernel._affine_tables), window
+# additions use the cheaper 11M+2 complete MIXED add below, and table
+# selects move a third less data.
+
+POINT_FORMS = ("projective", "affine")
+
+_POINT_FORM = F._env_mode("TPUNODE_POINT_FORM", POINT_FORMS, "projective")
+
+
+def point_form() -> str:
+    """Active MSM point formulation: "projective" | "affine"."""
+    return _POINT_FORM
+
+
+def set_point_form(form: "str | None") -> str:
+    """Select the MSM point form process-wide; returns the previous form
+    (None is a no-op, mirroring field.set_field_modes).  Programs traced
+    before the flip keep their form until their owner re-traces — which
+    every in-repo dispatch site does, because all of them key on
+    :func:`tpunode.verify.kernel.kernel_modes`."""
+    global _POINT_FORM
+    if form is None:
+        return _POINT_FORM
+    if form not in POINT_FORMS:
+        raise ValueError(f"point form {form!r} not in {POINT_FORMS}")
+    prev = _POINT_FORM
+    _POINT_FORM = form
+    return prev
 
 
 def make_point(x: jnp.ndarray, y: jnp.ndarray, z: jnp.ndarray) -> jnp.ndarray:
@@ -87,6 +131,57 @@ def pt_add(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
     z3 = t1 + t2_b3
     t1m = t1 - t2_b3
     y3 = F.mul_small_red(t5, B3)  # reduced: y3 feeds two muls below
+    x3 = mul(t4, y3)
+    t2b = mul(t3, t1m)
+    x3 = t2b - x3
+    y3 = mul(y3, t0_3)
+    t1b = mul(t1m, z3)
+    y3 = t1b + y3
+    t0b = mul(t0_3, t3)
+    z3 = mul(z3, t4)
+    z3 = z3 + t0b
+    return make_point(x3, y3, z3)
+
+
+def pt_add_mixed(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
+    """Complete MIXED addition (RCB'16 Algorithm 8, a = 0): 11 muls + 2
+    reduced scalings — one full mul cheaper than :func:`pt_add` because
+    ``q`` is affine: a 2-coordinate ``(x2, y2)`` stack with Z2 = 1
+    implicit (the ISSUE 8 affine window tables), so t2 = Z1*Z2
+    degenerates to Z1 and the X1*Z2/Y1*Z2 cross terms to X1/Y1.
+
+    Complete in ``p`` (infinity, p = ±q all exact) but ``q`` CANNOT be
+    the point at infinity — affine coordinates can't represent it.  The
+    window loops handle the digit-0 (infinity) table entry by keeping
+    the accumulator unchanged via a branch-free select instead
+    (kernel.py / pallas_kernel.py), so the formula never sees it.
+
+    Limb-bound audit (same contracts as pt_add's): p's coords are <= 2^13
+    (sums of <= 2 mul outputs), q's are mul outputs or canonical table
+    constants (<= 2^12, possibly negated — sign-safe throughout).
+    mul_t legs: X1*x2, Y1*y2, y2*Z1, x2*Z1 all <= 2^13 x 2^12.  The
+    mul legs take sums <= 2^14 (non-top <= 2^19 trivially; pairwise
+    top*top <= 2^27 < 2^30).  mul_small_red on Z1 (limbs <= 2^13):
+    value*21 < 2^271 so non-top <= 2^11 + 2^11*2^7 <= 2^18.1 — z3/t1m
+    sums stay inside mul's |non-top| <= 2^19 input contract.
+    """
+    X1, Y1, Z1 = p[0], p[1], p[2]
+    x2, y2 = q[0], q[1]
+    mul = F.mul
+
+    t0 = F.mul_t(X1, x2)
+    t1 = F.mul_t(Y1, y2)
+    t3 = mul(X1 + Y1, x2 + y2)
+    t3 = t3 - (t0 + t1)  # = X1*y2 + x2*Y1
+    t4 = F.mul_t(y2, Z1)
+    t4 = t4 + Y1  # = Y1*Z2 + Y2*Z1 with Z2 = 1
+    t5 = F.mul_t(x2, Z1)
+    t5 = t5 + X1  # = X1*Z2 + X2*Z1 with Z2 = 1
+    t0_3 = t0 + t0 + t0  # 3*X1*X2
+    t2_b3 = F.mul_small_red(Z1, B3)  # b3*Z1*Z2 with Z2 = 1
+    z3 = t1 + t2_b3
+    t1m = t1 - t2_b3
+    y3 = F.mul_small_red(t5, B3)
     x3 = mul(t4, y3)
     t2b = mul(t3, t1m)
     x3 = t2b - x3

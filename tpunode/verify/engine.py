@@ -371,6 +371,13 @@ class VerifyConfig:
     # the modes, so the first dispatch traces the requested formulation.
     field_mul: Optional[str] = None
     field_sqr: Optional[str] = None
+    # MSM point form (ISSUE 8): None keeps the process-wide mode
+    # (TPUNODE_POINT_FORM env knob); "projective"/"affine" select
+    # explicitly.  Applied process-globally at engine construction like
+    # the field knobs — every device program keys its jit cache on
+    # kernel.kernel_modes(), so the first dispatch traces the requested
+    # formulation.  Verdicts are bit-identical across forms.
+    point_form: Optional[str] = None
 
     def __post_init__(self):
         if self.device_batch < self.batch_size:
@@ -379,6 +386,10 @@ class VerifyConfig:
             from . import field as _field
 
             _field.set_field_modes(mul=self.field_mul, sqr=self.field_sqr)
+        if self.point_form is not None:
+            from . import curve as _curve
+
+            _curve.set_point_form(self.point_form)
 
 
 class VerifyEngine:

@@ -44,7 +44,16 @@ from jax import lax
 
 from ..trace import span
 from . import field as F
-from .curve import B3, INFINITY, make_point, pt_add, pt_double
+from .curve import (
+    B3,
+    INFINITY,
+    make_point,
+    point_form,
+    pt_add,
+    pt_add_mixed,
+    pt_double,
+    pt_select,
+)
 from .ecdsa_cpu import CURVE_N, CURVE_P, GENERATOR, Point
 
 log = logging.getLogger("tpunode.verify")
@@ -55,6 +64,7 @@ __all__ = [
     "LAMBDA",
     "BETA",
     "glv_split",
+    "kernel_modes",
     "prepare_batch",
     "verify_core",
     "verify_device",
@@ -63,6 +73,90 @@ __all__ = [
     "collect_verdicts",
     "PreparedBatch",
 ]
+
+
+# ---------- kernel-structure knobs (ISSUE 8) -------------------------------
+#
+# Same discipline as field.py's formulation knobs: process-global, read at
+# TRACE time, every jit cache keyed on kernel_modes() below.
+#
+# TPUNODE_SELECT16: how a 4-bit digit picks its window-table entry.
+#   "tree"   (default) — balanced 4-level binary select tree: 15 wheres,
+#            half the data movement of the one-hot form and no integer
+#            multiplies.
+#   "onehot" — the r3 original: one-hot einsum (XLA) / 16-way
+#            compare-accumulate (Pallas).
+# TPUNODE_POW_LADDER: the shape of the constant-exponent pow ladders and
+# the on-device table builds.
+#   "scan"   (default) — the r3 lax.scan ladders.  Default by MEASUREMENT
+#            (PERF.md ISSUE 8 section): the de-scanned programs explode
+#            XLA-CPU compile time (81 s -> >500 s at batch 8 on this
+#            box) for a step-time question that only a TPU can answer
+#            (compiles there are server-side; benchmarks/mosaic_diag.py
+#            carries a ``pow_descan`` case for the Mosaic verdict).
+#   "unroll" — de-scanned (ISSUE 8 lever 2): the 64 4-bit windows unroll
+#            with STATIC digits (table entries picked by static index —
+#            the per-digit one-hot selects vanish entirely), and the
+#            16-entry power/Q tables build through log-depth
+#            square/double chains instead of a 14-step sequential scan,
+#            cutting the latency-bound critical path PERF r5 measured.
+
+SELECT_MODES = ("tree", "onehot")
+POW_LADDER_MODES = ("scan", "unroll")
+
+_SELECT_MODE = F._env_mode("TPUNODE_SELECT16", SELECT_MODES, "tree")
+_POW_LADDER_MODE = F._env_mode(
+    "TPUNODE_POW_LADDER", POW_LADDER_MODES, "scan"
+)
+
+
+def select_mode() -> str:
+    """Active table-select formulation: "tree" | "onehot"."""
+    return _SELECT_MODE
+
+
+def pow_ladder_mode() -> str:
+    """Active pow-ladder/table-build shape: "unroll" | "scan"."""
+    return _POW_LADDER_MODE
+
+
+def set_kernel_modes(
+    select: Optional[str] = None, pow_ladder: Optional[str] = None
+) -> tuple:
+    """Select the kernel-structure formulations process-wide; returns the
+    previous (select_mode, pow_ladder_mode).  Validates BOTH before
+    mutating either (field.set_field_modes's contract)."""
+    global _SELECT_MODE, _POW_LADDER_MODE
+    if select is not None and select not in SELECT_MODES:
+        raise ValueError(f"select mode {select!r} not in {SELECT_MODES}")
+    if pow_ladder is not None and pow_ladder not in POW_LADDER_MODES:
+        raise ValueError(
+            f"pow ladder mode {pow_ladder!r} not in {POW_LADDER_MODES}"
+        )
+    prev = (_SELECT_MODE, _POW_LADDER_MODE)
+    if select is not None:
+        _SELECT_MODE = select
+    if pow_ladder is not None:
+        _POW_LADDER_MODE = pow_ladder
+    return prev
+
+
+def kernel_modes() -> tuple:
+    """Hashable static jit-cache key for EVERY program that embeds the
+    MSM: the field formulation (field.field_modes()), the point form
+    (curve.point_form()), and the select/ladder shapes above — all
+    process globals read at trace time, so they must force a retrace."""
+    return F.field_modes() + (point_form(), _SELECT_MODE, _POW_LADDER_MODE)
+
+
+def structure_modes() -> tuple:
+    """:func:`kernel_modes` MINUS the point form — the cache key for jit
+    sites that already carry ``point_form`` as an explicit static
+    argument (pallas ``verify_blocked``): including the global form
+    there too would double-encode it and retrace the identical program
+    under a second key whenever the explicit argument and the global
+    disagree (review r8)."""
+    return F.field_modes() + (_SELECT_MODE, _POW_LADDER_MODE)
 
 WINDOW_BITS = 4
 # GLV half-scalars are bounded by ~2^129 (asserted per-item in
@@ -128,6 +222,14 @@ LG_TABLE = jnp.array(
     _table_np(Point(BETA * GENERATOR.x % CURVE_P, GENERATOR.y))
 )  # table of λG = φ(G)
 
+# Affine (2-coordinate) views for the affine point form (ISSUE 8): every
+# finite constant-table entry already has Z = 1, so dropping the Z plane
+# IS the normalization.  Entry 0 keeps (0, 1) from (0 : 1 : 0) — a
+# placeholder the window loop never adds (digit-0 keeps the accumulator
+# through a branch-free select instead).
+G_TABLE_AFF = G_TABLE[:, :2]  # (16, 2, NLIMBS)
+LG_TABLE_AFF = LG_TABLE[:, :2]
+
 
 # One annotated list drives PreparedBatch.__slots__, the device_args order
 # (== verify_core's signature order), and the 2-D/1-D split shard_map
@@ -183,7 +285,16 @@ class PreparedBatch:
 
 
 def _batch_inverse_mod_n(values: list[int]) -> list[int]:
-    """Montgomery batch inversion mod n: one pow() for the whole batch."""
+    """Montgomery batch inversion mod n: one pow() for the whole batch.
+
+    B == 1 short-circuits to the bare pow (ISSUE 8 bugfix sweep): the
+    general path builds the prefix/suffix machinery around the same
+    single pow, which is pure overhead for the singleton batches the
+    mempool's per-tx admission path submits."""
+    if not values:
+        return []
+    if len(values) == 1:
+        return [pow(values[0], -1, CURVE_N)]
     prefix = []
     run = 1
     for v in values:
@@ -309,7 +420,8 @@ def prepare_batch(
             hv[i] = True
             s_vals.append(s)
             s_idx.append(i)
-    s_inv = _batch_inverse_mod_n(s_vals) if s_vals else []
+    with span("verify.batch_inv"):
+        s_inv = _batch_inverse_mod_n(s_vals) if s_vals else []
     inv_by_idx = dict(zip(s_idx, s_inv))
 
     digit_arrays = (d1a, d1b, d2a, d2b)
@@ -501,16 +613,29 @@ def prepare_batch_raw(raw, pad_to: Optional[int] = None) -> PreparedBatch:
 
 
 def _build_q_table(qx: jnp.ndarray, qy: jnp.ndarray) -> jnp.ndarray:
-    """Per-signature table [O, Q, 2Q, ..., 15Q], shape (16, 3, L, B)."""
+    """Per-signature table [O, Q, 2Q, ..., 15Q], shape (16, 3, L, B).
+
+    Under the ``unroll`` ladder mode the build is a de-scanned log-depth
+    double-and-add chain (ISSUE 8 lever 2): 7 complete doublings + 7
+    complete additions (vs the scan's 14 sequential adds — fewer field
+    muls AND a critical path of depth ~5 instead of 14).  ``scan`` (the
+    default — see the knob comment for the measured why) keeps the r3
+    sequential form.  Both are exact, so verdicts are bit-identical
+    either way."""
     q1 = make_point(qx, qy, jnp.broadcast_to(F.ONE, qx.shape))
     inf = jnp.broadcast_to(INFINITY, q1.shape)
+    if _POW_LADDER_MODE == "scan":
+        def step(acc, _):
+            nxt = pt_add(acc, q1)
+            return nxt, nxt
 
-    def step(acc, _):
-        nxt = pt_add(acc, q1)
-        return nxt, nxt
-
-    _, multiples = lax.scan(step, q1, None, length=14)  # 2Q..15Q, (14, 3, L, B)
-    return jnp.concatenate([inf[None], q1[None], multiples], axis=0)
+        _, multiples = lax.scan(step, q1, None, length=14)  # 2Q..15Q
+        return jnp.concatenate([inf[None], q1[None], multiples], axis=0)
+    ent: list = [None] * 16
+    ent[0], ent[1] = inf, q1
+    for k in range(2, 16):
+        ent[k] = pt_double(ent[k // 2]) if k % 2 == 0 else pt_add(ent[k - 1], q1)
+    return jnp.stack(ent, axis=0)
 
 
 def _lambda_table(q_table: jnp.ndarray) -> jnp.ndarray:
@@ -522,17 +647,103 @@ def _lambda_table(q_table: jnp.ndarray) -> jnp.ndarray:
     return q_table.at[:, 0].set(lxs)
 
 
-def _select_entry(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
-    """One-hot select: table (16, 3, L, B) or (16, 3, L), digits (B,) -> (3, L, B)."""
+def _select_entry_onehot(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
+    """One-hot select: table (16, C, L, B) or (16, C, L), digits (B,) -> (C, L, B)."""
     onehot = jax.nn.one_hot(digits, 16, dtype=jnp.int32).T  # (16, B)
     if table.ndim == 3:
         return jnp.einsum("tb,tcl->clb", onehot, table)
     return jnp.einsum("tb,tclb->clb", onehot, table)
 
 
+def select_tree16(entries: list, digits: jnp.ndarray) -> jnp.ndarray:
+    """THE balanced 4-level binary select-tree fold (ISSUE 8 lever 3):
+    15 wheres, level ``i`` resolving digit bit ``i``.  ``entries`` are
+    the 16 table entries (arrays or VMEM-ref reads), ``digits`` any
+    digit array that broadcasts against them under ``jnp.where``.
+    Shared by the XLA select below AND the Pallas ``_select16`` tree
+    branch so the two device paths cannot diverge (one fold, the same
+    way curve.py's formulas are shared via the ``F=`` namespace)."""
+    level = list(entries)
+    for i in range(4):
+        bit = ((digits >> i) & 1) == 1
+        level = [
+            jnp.where(bit, level[2 * j + 1], level[2 * j])
+            for j in range(len(level) // 2)
+        ]
+    return level[0]
+
+
+def _select_entry_tree(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
+    """Balanced select tree over a stacked table: 15 wheres moving 15
+    entry-volumes of data vs the one-hot form's 16 multiplies + 15 adds
+    over the whole table — and no integer multiplies at all.  Identical
+    output to the one-hot select for digits in [0, 16)."""
+    if table.ndim == 3:  # constant (16, C, L) table: broadcast over lanes
+        table = table[..., None]
+    # digits (B,) broadcasts over each (C, L, B) entry
+    return select_tree16([table[t] for t in range(16)], digits)
+
+
+def _select_entry(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
+    """Digit-indexed window-table select, per the active select mode."""
+    if _SELECT_MODE == "onehot":
+        return _select_entry_onehot(table, digits)
+    return _select_entry_tree(table, digits)
+
+
 def _signed(entry: jnp.ndarray, neg: jnp.ndarray) -> jnp.ndarray:
-    """Negate the point iff ``neg`` (per-lane): -P = (X, -Y, Z)."""
+    """Negate the point iff ``neg`` (per-lane): -P = (X, -Y[, Z]) — works
+    on projective (3, L, B) and affine (2, L, B) entries alike."""
     return entry.at[1].set(jnp.where(neg, -entry[1], entry[1]))
+
+
+def _normalize_q_table(
+    q_table: jnp.ndarray, F=F, pow_const=None
+) -> jnp.ndarray:
+    """Projective Q table (16, 3, L, B) -> affine (16, 2, L, B) via one
+    Montgomery-trick batch inversion per lane (ISSUE 8 lever 1).
+
+    Entries 2..15 carry arbitrary Z; entry 1 is (qx, qy, 1) and entry 0
+    is infinity (gets the (0, 1) placeholder — the window loop's digit-0
+    select never adds it).  One shared Fermat ``Z^(p-2)`` ladder inverts
+    the 14-entry Z product (amortized over the whole table), prefix/
+    suffix products recover each entry's inverse with 2 muls, and 2 more
+    muls normalize (X, Y).  Cost: 13 prefix + 1 ladder + 26 suffix + 28
+    normalize muls ≈ ladder + 67 vs the 14 x 1-full-mul-per-add saving
+    plus a third less select traffic in the window loop (the measured
+    trade is in PERF.md).
+
+    A lane whose table hits Z ≡ 0 beyond entry 0 (impossible for a valid
+    on-curve Q on a prime-order curve; reachable only for garbage/
+    off-curve host inputs) zeroes that LANE's products and produces
+    garbage affine entries — harmless, because such lanes are already
+    masked by host_valid/on_curve in the verdict.
+
+    ``F``/``pow_const`` parameterized like curve.py's formulas so the
+    roofline can count this function by executing it."""
+    if pow_const is None:
+        pow_const = _pow_const
+    zs = [q_table[k, 2] for k in range(2, 16)]  # (L, B) each
+    prefix = [zs[0]]  # prefix[i] = z_2 * ... * z_{i+2}
+    for z in zs[1:]:
+        prefix.append(F.mul(prefix[-1], z))
+    inv = pow_const(prefix[-1], _PM2_DIGITS)  # ONE ladder for all 14
+    ent: list = [None] * 16
+    shape = q_table.shape[-2:]
+    ent[0] = jnp.stack(
+        [jnp.broadcast_to(F.ZERO, shape), jnp.broadcast_to(F.ONE, shape)],
+        axis=0,
+    )
+    ent[1] = q_table[1, :2]  # (qx, qy): affine by construction
+    run = inv  # invariant entering entry k: run = (z_2 ... z_k)^-1
+    for k in range(15, 1, -1):
+        zinv = F.mul(run, prefix[k - 3]) if k > 2 else run
+        ent[k] = jnp.stack(
+            [F.mul(q_table[k, 0], zinv), F.mul(q_table[k, 1], zinv)], axis=0
+        )
+        if k > 2:
+            run = F.mul(run, zs[k - 2])
+    return jnp.stack(ent, axis=0)
 
 
 # Constant-exponent digit tables (64 MSB-first 4-bit digits each) for the
@@ -548,27 +759,60 @@ _PM2_DIGITS = np.array(
 )  # Fermat inverse: z^(p-2)
 
 
+def _pow_table(t: jnp.ndarray) -> list:
+    """[1, t, t^2, ..., t^15] via a log-depth square/multiply chain: same
+    14 muls as the sequential chain (squares where possible — cheaper
+    under the dedicated sqr path) but critical depth 4 instead of 14."""
+    table: list = [None] * 16
+    table[0] = jnp.broadcast_to(F.ONE, t.shape)
+    table[1] = t
+    for k in range(2, 16):
+        table[k] = (
+            F.sqr(table[k // 2]) if k % 2 == 0 else F.mul(table[k - 1], t)
+        )
+    return table
+
+
 def _pow_const(t: jnp.ndarray, digits: np.ndarray) -> jnp.ndarray:
     """Windowed 4-bit pow by a COMPILE-TIME exponent for a (L, B) limb
-    column: 15 table muls + 64×(4 sqr + 1 mul) ≈ 12% of the MSM's cost,
-    paid once per batch for every lane uniformly (branch-free SPMD)."""
-    one = jnp.broadcast_to(F.ONE, t.shape)
+    column, paid once per batch for every lane uniformly (branch-free
+    SPMD).
 
-    def tstep(acc, _):
-        nxt = F.mul(acc, t)
-        return nxt, nxt
+    ``unroll`` mode (ISSUE 8 lever 2): the 64 windows unroll with
+    STATIC digits, so each window's table entry is picked by a plain
+    static index — the scan's 64 one-hot selects (16 muls + 15 adds
+    over the whole table, each) vanish, zero-digit windows skip their
+    mul outright, and the first window seeds the accumulator directly
+    (4 squarings + 1 mul saved).  ``scan`` (the default — the unrolled
+    program's XLA-CPU compile cost is the measured blocker, see the
+    knob comment) keeps the r3 sequential lax.scan ladder
+    (latency-bound, PERF r5).  Exact either way."""
+    if _POW_LADDER_MODE == "scan":
+        one = jnp.broadcast_to(F.ONE, t.shape)
 
-    _, mults = lax.scan(tstep, t, None, length=14)  # t^2 .. t^15
-    table = jnp.concatenate([one[None], t[None], mults], axis=0)  # (16, L, B)
+        def tstep(acc, _):
+            nxt = F.mul(acc, t)
+            return nxt, nxt
 
-    def step(acc, d):
+        _, mults = lax.scan(tstep, t, None, length=14)  # t^2 .. t^15
+        table = jnp.concatenate([one[None], t[None], mults], axis=0)
+
+        def step(acc, d):
+            acc = F.sqr(F.sqr(F.sqr(F.sqr(acc))))
+            sel = jnp.einsum(
+                "t,tlb->lb", jax.nn.one_hot(d, 16, dtype=jnp.int32), table
+            )
+            return F.mul(acc, sel), None
+
+        acc, _ = lax.scan(step, one, jnp.asarray(digits))
+        return acc
+    table = _pow_table(t)
+    ds = [int(d) for d in np.asarray(digits)]
+    acc = table[ds[0]]  # MSB window: skip the leading squarings of 1
+    for d in ds[1:]:
         acc = F.sqr(F.sqr(F.sqr(F.sqr(acc))))
-        sel = jnp.einsum(
-            "t,tlb->lb", jax.nn.one_hot(d, 16, dtype=jnp.int32), table
-        )
-        return F.mul(acc, sel), None
-
-    acc, _ = lax.scan(step, one, jnp.asarray(digits))
+        if d:
+            acc = F.mul(acc, table[d])
     return acc
 
 
@@ -605,20 +849,48 @@ def verify_core(
     ``jacobi(y(R)) = 1``; BIP340 checks ``x(R) = r`` AND ``y(R)`` even
     (host prep already folded ``u1 = s``, ``u2 = n - e`` into the digit
     arrays for both Schnorr variants).
+
+    The MSM's point form is read from ``curve.point_form()`` at TRACE
+    time (ISSUE 8): "projective" keeps 3-coordinate tables + the full
+    RCB add; "affine" batch-normalizes the Q/λQ tables with one
+    Montgomery-trick inversion per lane and runs the window loop on
+    2-coordinate tables with the 11-mul complete MIXED add (digit 0 —
+    the infinity entry, unrepresentable in affine — keeps the
+    accumulator through a branch-free select).  Verdicts are
+    bit-identical across forms (everything downstream is exact mod p).
     """
     q_table = _build_q_table(qx, qy)  # (16, 3, L, B)
-    lq_table = _lambda_table(q_table)
 
     acc0 = jnp.broadcast_to(INFINITY, (3, F.NLIMBS, qx.shape[1]))
 
-    def window_step(acc, digits):
-        da, db, dc, dd = digits
-        acc = pt_double(pt_double(pt_double(pt_double(acc))))
-        acc = pt_add(acc, _signed(_select_entry(G_TABLE, da), n1a))
-        acc = pt_add(acc, _signed(_select_entry(LG_TABLE, db), n1b))
-        acc = pt_add(acc, _signed(_select_entry(q_table, dc), n2a))
-        acc = pt_add(acc, _signed(_select_entry(lq_table, dd), n2b))
-        return acc, None
+    if point_form() == "affine":
+        q_aff = _normalize_q_table(q_table)  # (16, 2, L, B)
+        lq_aff = _lambda_table(q_aff)  # β-scaled X, same trick
+
+        def window_step(acc, digits):
+            da, db, dc, dd = digits
+            acc = pt_double(pt_double(pt_double(pt_double(acc))))
+            for table, d, neg in (
+                (G_TABLE_AFF, da, n1a),
+                (LG_TABLE_AFF, db, n1b),
+                (q_aff, dc, n2a),
+                (lq_aff, dd, n2b),
+            ):
+                sel = _signed(_select_entry(table, d), neg)
+                acc = pt_select(d == 0, acc, pt_add_mixed(acc, sel))
+            return acc, None
+
+    else:
+        lq_table = _lambda_table(q_table)
+
+        def window_step(acc, digits):
+            da, db, dc, dd = digits
+            acc = pt_double(pt_double(pt_double(pt_double(acc))))
+            acc = pt_add(acc, _signed(_select_entry(G_TABLE, da), n1a))
+            acc = pt_add(acc, _signed(_select_entry(LG_TABLE, db), n1b))
+            acc = pt_add(acc, _signed(_select_entry(q_table, dc), n2a))
+            acc = pt_add(acc, _signed(_select_entry(lq_table, dd), n2b))
+            return acc, None
 
     acc, _ = lax.scan(window_step, acc0, (d1a, d1b, d2a, d2b))
 
@@ -659,27 +931,30 @@ def verify_core(
     return host_valid & on_curve & not_inf & algo_ok
 
 
-# Jitted verify_core, one executable per field-formulation mode
-# (TPUNODE_FIELD_MUL / TPUNODE_FIELD_SQR, ISSUE 4): the limb-product
-# formulation is read from process globals at TRACE time, so the modes
-# must be part of the jit cache key — as a static argument.  (Distinct
-# ``jax.jit(verify_core)`` wrapper objects share one underlying trace
-# cache keyed on the wrapped function, so a per-mode dict of wrappers
-# does NOT retrace — measured the hard way.)
+# Jitted verify_core, one executable per formulation-mode tuple
+# (TPUNODE_FIELD_MUL / TPUNODE_FIELD_SQR from ISSUE 4, plus ISSUE 8's
+# TPUNODE_POINT_FORM / TPUNODE_SELECT16 / TPUNODE_POW_LADDER): every
+# formulation is read from process globals at TRACE time, so the full
+# kernel_modes() tuple must be part of the jit cache key — as a static
+# argument.  (Distinct ``jax.jit(verify_core)`` wrapper objects share
+# one underlying trace cache keyed on the wrapped function, so a
+# per-mode dict of wrappers does NOT retrace — measured the hard way.)
 from functools import partial as _partial
 
 
 @_partial(jax.jit, static_argnames=("field_modes",))
 def _verify_device_jit(*args, field_modes=None):
-    del field_modes  # cache key only: forces a retrace per formulation
+    # cache key only (the full kernel_modes() tuple rides in under the
+    # historical "field_modes" name): forces a retrace per formulation
+    del field_modes
     return verify_core(*args)
 
 
 def verify_device(*args) -> jnp.ndarray:
-    """Jitted :func:`verify_core` under the ACTIVE field formulation
-    (field.field_modes()) — a drop-in for the former module-level
-    ``jax.jit(verify_core)``."""
-    return _verify_device_jit(*args, field_modes=F.field_modes())
+    """Jitted :func:`verify_core` under the ACTIVE formulation modes
+    (:func:`kernel_modes` — field + point form + select/ladder shape) —
+    a drop-in for the former module-level ``jax.jit(verify_core)``."""
+    return _verify_device_jit(*args, field_modes=kernel_modes())
 
 
 # Sticky per-process flag: set when a pallas compile fails with a

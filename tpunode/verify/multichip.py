@@ -36,10 +36,10 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from . import field as F
 from .ecdsa_cpu import Point
 from .kernel import (
     ARG_IS_2D,
+    kernel_modes,
     pallas_broken,
     prepare_batch,
     verify_core,
@@ -92,9 +92,9 @@ def sharded_verify_fn(
 
     ``B`` must be a multiple of the mesh size (callers pad; static shapes
     also keep XLA from recompiling across batches).  Cached per mesh,
-    program variant, and field formulation (field.field_modes() — the
-    limb-product formulation is baked in at trace time) so repeated
-    batches reuse the compiled executable.
+    program variant, and formulation-mode tuple (kernel.kernel_modes():
+    field formulation + point form + select/ladder shape — all baked in
+    at trace time) so repeated batches reuse the compiled executable.
     """
     if kernel not in ("auto", "pallas", "xla"):
         raise ValueError(f"unknown kernel {kernel!r}: auto|pallas|xla")
@@ -102,7 +102,11 @@ def sharded_verify_fn(
         kernel == "auto" and _mesh_is_tpu(mesh) and not pallas_broken()
     )
     schnorr_free = bool(schnorr_free) and use_pallas
-    key = (mesh, use_pallas, interpret, block, schnorr_free, F.field_modes())
+    # kernel_modes() carries the field formulation AND the point-form/
+    # select/ladder knobs (ISSUE 8) — all read at trace time, so all part
+    # of the cache key.  The pallas branch additionally pins point_form
+    # explicitly so the impl can't drift from the keyed mode.
+    key = (mesh, use_pallas, interpret, block, schnorr_free, kernel_modes())
     cached = _FN_CACHE.get(key)
     if cached is not None:
         return cached
@@ -116,14 +120,16 @@ def sharded_verify_fn(
 
         from .pallas_kernel import verify_blocked_impl
 
-        kw = {}
+        from .curve import point_form
+
+        kw = {"point_form": point_form()}
         if interpret:
             kw["interpret"] = True
         if block is not None:
             kw["block"] = block
         if schnorr_free:
             kw["schnorr_free"] = True
-        _core = partial(verify_blocked_impl, **kw) if kw else verify_blocked_impl
+        _core = partial(verify_blocked_impl, **kw)
     else:
         _core = verify_core
 

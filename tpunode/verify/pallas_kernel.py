@@ -36,8 +36,20 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import field as F
 from . import pallas_field as PF
-from .curve import pt_add, pt_double
-from .kernel import _EULER_DIGITS, _PM2_DIGITS, BETA, G_TABLE, LG_TABLE, WINDOWS
+from .curve import point_form, pt_add, pt_add_mixed, pt_double
+from .kernel import (
+    _EULER_DIGITS,
+    _PM2_DIGITS,
+    BETA,
+    G_TABLE,
+    G_TABLE_AFF,
+    LG_TABLE,
+    LG_TABLE_AFF,
+    WINDOWS,
+    select_mode,
+    select_tree16,
+    structure_modes,
+)
 
 __all__ = ["verify_blocked", "verify_blocked_impl", "BLOCK"]
 
@@ -46,10 +58,13 @@ BLOCK = 256  # lanes per grid step: 2 tables x 1.2 MB VMEM + headroom
 _BETA_LIMBS = [int(x) for x in F.to_limbs(BETA)]
 _SEVEN_LIMBS = [7] + [0] * (F.NLIMBS - 1)
 
-# Constant G / λG tables as host numpy, shape (16, 3, NLIMBS): broadcast
-# over lanes at trace time (they are compile-time constants in the kernel).
+# Constant G / λG tables as host numpy, shape (16, 3, NLIMBS) — and their
+# 2-coordinate affine views (16, 2, NLIMBS) for the affine point form:
+# broadcast over lanes at trace time (compile-time constants in-kernel).
 _G_NP = np.asarray(G_TABLE)
 _LG_NP = np.asarray(LG_TABLE)
+_G_AFF_NP = np.asarray(G_TABLE_AFF)
+_LG_AFF_NP = np.asarray(LG_TABLE_AFF)
 
 
 def _const_table(tab_np: np.ndarray, b: int) -> jnp.ndarray:
@@ -59,25 +74,41 @@ def _const_table(tab_np: np.ndarray, b: int) -> jnp.ndarray:
 
 
 def _select16(table, digit_row):
-    """Branch-free 16-way select: compare-accumulate over table entries.
+    """Branch-free 16-way select over window-table entries.
 
-    ``table``: (16, 3, L, B) value or VMEM ref; ``digit_row``: (1, B).
-    Entry 0 is the infinity point (0 : 1 : 0) — completeness of the RCB
-    formulas makes adding it a no-op, so zero digits need no special case.
+    ``table``: (16, C, L, B) value or VMEM ref (C = 3 projective / 2
+    affine); ``digit_row``: (1, B).  Two formulations behind the
+    TPUNODE_SELECT16 knob (kernel.select_mode(), read at trace time):
+
+    * ``tree`` (default, ISSUE 8 lever 3): balanced 4-level binary
+      select tree — 15 wheres, each level resolving one digit bit; half
+      the one-hot form's data movement and no accumulate adds.
+    * ``onehot``: the r3 compare-accumulate (16 wheres + 15 adds).
+
+    Entry 0 is the infinity point — under the projective form the
+    complete RCB formulas make adding it a no-op; the affine window loop
+    handles digit 0 with a keep-accumulator select instead.
     """
-    out = None
-    for t in range(16):
-        m = digit_row == t  # (1, B), broadcasts over (3, L, B)
-        e = table[t] if not isinstance(table, jnp.ndarray) else table[t]
-        contrib = jnp.where(m, e, 0)
-        out = contrib if out is None else out + contrib
-    return out
+    if select_mode() == "onehot":
+        out = None
+        for t in range(16):
+            m = digit_row == t  # (1, B), broadcasts over (C, L, B)
+            contrib = jnp.where(m, table[t], 0)
+            out = contrib if out is None else out + contrib
+        return out
+    # the ONE shared fold (kernel.select_tree16): digit_row (1, B)
+    # broadcasts over each (C, L, B) entry exactly like the XLA path's
+    return select_tree16([table[t] for t in range(16)], digit_row)
 
 
 def _signed(entry: jnp.ndarray, neg_row: jnp.ndarray) -> jnp.ndarray:
-    """Negate the point iff ``neg_row`` (1, B): -P = (X, -Y, Z)."""
+    """Negate the point iff ``neg_row`` (1, B): -P = (X, -Y[, Z]) — works
+    on projective (3, L, B) and affine (2, L, B) entries alike."""
     y = jnp.where(neg_row != 0, -entry[1], entry[1])
-    return jnp.concatenate([entry[0:1], y[None], entry[2:3]], axis=0)
+    parts = [entry[0:1], y[None]]
+    if entry.shape[0] == 3:
+        parts.append(entry[2:3])
+    return jnp.concatenate(parts, axis=0)
 
 
 def _kernel(
@@ -95,12 +126,22 @@ def _kernel(
     flags_ref,  # (4, B) int32: [r2_valid, host_valid, schnorr, bip340]
     # remaining refs depend on the STATIC variant (pallas passes inputs,
     # then outputs, then scratch, positionally):
-    #   full:         euler_ref, out_ref, qtab, lqtab, powtab
-    #   schnorr_free: out_ref, qtab, lqtab   (no digits, no pow scratch)
+    #   projective full:         euler_ref, out_ref, qtab, lqtab, powtab
+    #   projective schnorr_free: out_ref, qtab, lqtab  (no digits/pow)
+    #   affine (either):         euler_ref, out_ref, qtab(2-coord),
+    #                            lqtab(2-coord), ztab, ptab, powtab
+    #   (affine always carries the digits + pow scratch: the batch
+    #   inversion's Fermat ladder needs the _PM2 digit row even when the
+    #   acceptance pows are pruned)
     *rest,
     schnorr_free: bool = False,
+    point_form: str = "projective",
 ):
-    if schnorr_free:
+    affine = point_form == "affine"
+    if affine:
+        (euler_ref, out_ref, qtab_ref, lqtab_ref, ztab_ref, ptab_ref,
+         powtab_ref) = rest
+    elif schnorr_free:
         euler_ref = powtab_ref = None
         out_ref, qtab_ref, lqtab_ref = rest
     else:
@@ -116,19 +157,95 @@ def _kernel(
     qx = qx_ref[:]
     qy = qy_ref[:]
 
+    # ---- windowed pow machinery (shared by the affine batch inversion
+    # and the jacobi/parity acceptance pows): 16-entry power table of
+    # ``t`` in powtab, then 64 4-bit windows with digits from SMEM row
+    # ``row`` of euler_ref.  fori_loop bodies (one mul each) instead of
+    # unrolled chains: the straight-line form dominated Mosaic compile
+    # time (the r3 finding; benchmarks/mosaic_diag.py's ``pow_descan``
+    # case probes whether a de-scanned static-digit ladder lowers too).
+    def pow_build_table(t):
+        powtab_ref[0] = one
+        powtab_ref[1] = t
+
+        def pow_build(k, carry):
+            powtab_ref[pl.ds(k, 1)] = PF.mul(
+                powtab_ref[pl.ds(k - 1, 1)][0], t
+            )[None]
+            return carry
+
+        lax.fori_loop(2, 16, pow_build, 0)
+
+    def pow_window_for(row):
+        def pow_window(w, pacc):
+            pacc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(pacc))))
+            d = euler_ref[row, w]
+            sel = None
+            for tv in range(16):
+                contrib = jnp.where(d == tv, powtab_ref[tv], 0)
+                sel = contrib if sel is None else sel + contrib
+            return PF.mul(pacc, sel)
+
+        return pow_window
+
     # ---- per-signature Q table: [O, Q, 2Q, ..., 15Q] ----------------------
     # fori_loop bodies (one pt_add / one mul) instead of unrolled chains:
     # the straight-line table build dominated Mosaic compile time otherwise.
+    # Projective: 3-coordinate entries straight into qtab.  Affine (ISSUE
+    # 8): X/Y into the 2-coordinate qtab, Z into ztab, then one
+    # Montgomery-trick batch inversion per lane (prefix products in ptab,
+    # ONE shared Fermat Z^(p-2) ladder, suffix pass) normalizes every
+    # entry to affine in place.
     q1 = jnp.stack([qx, qy, one], axis=0)
-    qtab_ref[0] = inf
-    qtab_ref[1] = q1
+    if affine:
+        qtab_ref[0] = jnp.stack([zero, one], axis=0)
+        qtab_ref[1] = q1[0:2]
 
-    def build_step(k, acc):
-        nxt = pt_add(acc, q1, F=PF)
-        qtab_ref[pl.ds(k, 1)] = nxt[None]
-        return nxt
+        def build_step(k, acc):
+            nxt = pt_add(acc, q1, F=PF)
+            qtab_ref[pl.ds(k, 1)] = nxt[0:2][None]
+            ztab_ref[pl.ds(k, 1)] = nxt[2][None]
+            return nxt
 
-    lax.fori_loop(2, 16, build_step, q1)
+        lax.fori_loop(2, 16, build_step, q1)
+
+        # prefix products ptab[k] = z_2 * ... * z_k (ptab[1] = 1)
+        ptab_ref[1] = one
+        ptab_ref[2] = ztab_ref[2]
+
+        def prefix_step(k, carry):
+            ptab_ref[pl.ds(k, 1)] = PF.mul(
+                ptab_ref[pl.ds(k - 1, 1)][0], ztab_ref[pl.ds(k, 1)][0]
+            )[None]
+            return carry
+
+        lax.fori_loop(3, 16, prefix_step, 0)
+
+        # one shared Fermat ladder: (z_2 ... z_15)^(p-2)
+        pow_build_table(ptab_ref[15])
+        inv = lax.fori_loop(0, 64, pow_window_for(1), one)
+
+        # suffix pass: entering k, run = (z_2 ... z_k)^-1
+        def suffix_step(i, run):
+            k = 15 - i
+            zinv = PF.mul(run, ptab_ref[pl.ds(k - 1, 1)][0])
+            e = qtab_ref[pl.ds(k, 1)][0]
+            qtab_ref[pl.ds(k, 1)] = jnp.stack(
+                [PF.mul(e[0], zinv), PF.mul(e[1], zinv)], axis=0
+            )[None]
+            return PF.mul(run, ztab_ref[pl.ds(k, 1)][0])
+
+        lax.fori_loop(0, 14, suffix_step, inv)
+    else:
+        qtab_ref[0] = inf
+        qtab_ref[1] = q1
+
+        def build_step(k, acc):
+            nxt = pt_add(acc, q1, F=PF)
+            qtab_ref[pl.ds(k, 1)] = nxt[None]
+            return nxt
+
+        lax.fori_loop(2, 16, build_step, q1)
 
     # ---- λQ table: the endomorphism is additive, so scale each X by β ----
     beta = PF.const_col(_BETA_LIMBS, b)
@@ -152,20 +269,42 @@ def _kernel(
     n2b = negs_ref[3:4]
 
     # ---- Shamir/GLV window loop ------------------------------------------
-    def window(w, acc):
-        acc = pt_double(acc, F=PF)
-        acc = pt_double(acc, F=PF)
-        acc = pt_double(acc, F=PF)
-        acc = pt_double(acc, F=PF)
-        da = d1a_ref[pl.ds(w, 1)]
-        db = d1b_ref[pl.ds(w, 1)]
-        dc = d2a_ref[pl.ds(w, 1)]
-        dd = d2b_ref[pl.ds(w, 1)]
-        acc = pt_add(acc, _signed(_select16(g_tab, da), n1a), F=PF)
-        acc = pt_add(acc, _signed(_select16(lg_tab, db), n1b), F=PF)
-        acc = pt_add(acc, _signed(_select16(qtab_ref, dc), n2a), F=PF)
-        acc = pt_add(acc, _signed(_select16(lqtab_ref, dd), n2b), F=PF)
-        return acc
+    if affine:
+        # mixed additions against 2-coordinate tables; digit 0 (the
+        # infinity entry, unrepresentable in affine) keeps the
+        # accumulator through a branch-free select
+        def window(w, acc):
+            acc = pt_double(acc, F=PF)
+            acc = pt_double(acc, F=PF)
+            acc = pt_double(acc, F=PF)
+            acc = pt_double(acc, F=PF)
+            for tab, dref, neg in (
+                (g_tab, d1a_ref, n1a),
+                (lg_tab, d1b_ref, n1b),
+                (qtab_ref, d2a_ref, n2a),
+                (lqtab_ref, d2b_ref, n2b),
+            ):
+                d = dref[pl.ds(w, 1)]
+                sel = _signed(_select16(tab, d), neg)
+                nxt = pt_add_mixed(acc, sel, F=PF)
+                acc = jnp.where(d == 0, acc, nxt)
+            return acc
+
+    else:
+        def window(w, acc):
+            acc = pt_double(acc, F=PF)
+            acc = pt_double(acc, F=PF)
+            acc = pt_double(acc, F=PF)
+            acc = pt_double(acc, F=PF)
+            da = d1a_ref[pl.ds(w, 1)]
+            db = d1b_ref[pl.ds(w, 1)]
+            dc = d2a_ref[pl.ds(w, 1)]
+            dd = d2b_ref[pl.ds(w, 1)]
+            acc = pt_add(acc, _signed(_select16(g_tab, da), n1a), F=PF)
+            acc = pt_add(acc, _signed(_select16(lg_tab, db), n1b), F=PF)
+            acc = pt_add(acc, _signed(_select16(qtab_ref, dc), n2a), F=PF)
+            acc = pt_add(acc, _signed(_select16(lqtab_ref, dd), n2b), F=PF)
+            return acc
 
     acc = lax.fori_loop(0, WINDOWS, window, inf)
 
@@ -191,45 +330,15 @@ def _kernel(
         jac_ok = jnp.ones((1, b), dtype=jnp.bool_)
         even_ok = jnp.ones((1, b), dtype=jnp.bool_)
     else:
-        t = PF.mul(Y, Z)
-        powtab_ref[0] = one
-        powtab_ref[1] = t
-
-        def pow_build(k, carry):
-            powtab_ref[pl.ds(k, 1)] = PF.mul(
-                powtab_ref[pl.ds(k - 1, 1)][0], t
-            )[None]
-            return carry
-
-        lax.fori_loop(2, 16, pow_build, 0)
-
-        def pow_window_for(row):
-            def pow_window(w, pacc):
-                pacc = PF.sqr(PF.sqr(PF.sqr(PF.sqr(pacc))))
-                d = euler_ref[row, w]
-                sel = None
-                for tv in range(16):
-                    contrib = jnp.where(d == tv, powtab_ref[tv], 0)
-                    sel = contrib if sel is None else sel + contrib
-                return PF.mul(pacc, sel)
-
-            return pow_window
-
+        # jacobi(Y·Z) via the Euler pow (digit row 0), rebuilding the
+        # power table (the affine variant used it for the inversion)
+        pow_build_table(PF.mul(Y, Z))
         pacc = lax.fori_loop(0, 64, pow_window_for(0), one)
         jac_ok = PF.eq(pacc, one)
 
-        # BIP340 evenness: affine y = Y/Z via Fermat inverse Z^(p-2), then
-        # the canonical representative's low bit — reuse the power table
-        # with t=Z
-        powtab_ref[1] = Z
-
-        def pow_build_z(k, carry):
-            powtab_ref[pl.ds(k, 1)] = PF.mul(
-                powtab_ref[pl.ds(k - 1, 1)][0], Z
-            )[None]
-            return carry
-
-        lax.fori_loop(2, 16, pow_build_z, 0)
+        # BIP340 evenness: affine y = Y/Z via Fermat inverse Z^(p-2)
+        # (digit row 1), then the canonical representative's low bit
+        pow_build_table(Z)
         zinv = lax.fori_loop(0, 64, pow_window_for(1), one)
         y_aff = PF.mul(Y, zinv)
         even_ok = (PF.canonical(y_aff)[0:1] & 1) == 0
@@ -264,13 +373,20 @@ def verify_blocked_impl(
     interpret: bool = False,
     block: int = BLOCK,
     schnorr_free: bool = False,
+    point_form: "str | None" = None,
 ) -> jnp.ndarray:
     """Un-jitted kernel body — reused inside shard_map by multichip.py
     (a jitted callee cannot be shard_mapped).  See :func:`verify_blocked`.
 
     ``schnorr_free`` statically prunes the jacobi/parity acceptance pows
     (see _kernel) — only set it when NO lane carries a schnorr/bip340
-    flag; verdicts are bit-identical for such batches."""
+    flag; verdicts are bit-identical for such batches.  ``point_form``
+    selects the projective or affine MSM variant (None = the process
+    global, curve.point_form()); verdicts are bit-identical across
+    forms."""
+    if point_form is None:
+        point_form = _active_point_form()
+    affine = point_form == "affine"
     blk = block
     bsz = qx.shape[-1]
     if bsz % blk != 0:
@@ -293,8 +409,9 @@ def verify_blocked_impl(
     def col(rows):  # BlockSpec for a (rows, B) input walked along lanes
         return pl.BlockSpec((rows, blk), lambda i: (0, i))
 
+    coords = 2 if affine else 3
     tab_spec = pl.BlockSpec(
-        (16, 3, F.NLIMBS, blk), lambda i: (0, 0, 0, 0)
+        (16, coords, F.NLIMBS, blk), lambda i: (0, 0, 0, 0)
     )
     in_specs = [
         tab_spec,
@@ -311,8 +428,8 @@ def verify_blocked_impl(
         col(4),
     ]
     operands = [
-        _const_table(_G_NP, blk),
-        _const_table(_LG_NP, blk),
+        _const_table(_G_AFF_NP if affine else _G_NP, blk),
+        _const_table(_LG_AFF_NP if affine else _LG_NP, blk),
         d1a.astype(jnp.int32),
         d1b.astype(jnp.int32),
         d2a.astype(jnp.int32),
@@ -325,17 +442,18 @@ def verify_blocked_impl(
         flags,
     ]
     scratch = [
-        pltpu.VMEM((16, 3, F.NLIMBS, blk), jnp.int32),
-        pltpu.VMEM((16, 3, F.NLIMBS, blk), jnp.int32),
+        pltpu.VMEM((16, coords, F.NLIMBS, blk), jnp.int32),
+        pltpu.VMEM((16, coords, F.NLIMBS, blk), jnp.int32),
     ]
-    if not schnorr_free:
+    if affine or not schnorr_free:
         # Exponent digits live in SMEM: the kernel reads them with
         # dynamic scalar indices inside the window fori_loop, which is
         # scalar memory's canonical job — a VMEM block read that way
         # is the r5 Mosaic-outage suspect (benchmarks/mosaic_diag.py
-        # probes both placements).  The schnorr_free variant omits the
-        # digits AND the (16, L, blk) pow-table scratch entirely — the
-        # pruned program reclaims that VMEM as headroom.
+        # probes both placements).  The projective schnorr_free variant
+        # omits the digits AND the (16, L, blk) pow-table scratch
+        # entirely; the affine variants always need both (the batch
+        # inversion's Fermat ladder reads the _PM2 digit row).
         in_specs.append(
             pl.BlockSpec((2, 64), lambda i: (0, 0), memory_space=pltpu.SMEM)
         )
@@ -345,9 +463,17 @@ def verify_blocked_impl(
                 axis=0,
             )
         )
+    if affine:
+        # Z column + prefix-product tables for the batch inversion: the
+        # 2-coordinate main tables free exactly 2 x (16, L, blk) planes,
+        # so the affine variant's VMEM high-water stays ~level with the
+        # projective one's.
+        scratch.append(pltpu.VMEM((16, F.NLIMBS, blk), jnp.int32))
+        scratch.append(pltpu.VMEM((16, F.NLIMBS, blk), jnp.int32))
+    if affine or not schnorr_free:
         scratch.append(pltpu.VMEM((16, F.NLIMBS, blk), jnp.int32))
     out = pl.pallas_call(
-        partial(_kernel, schnorr_free=schnorr_free),
+        partial(_kernel, schnorr_free=schnorr_free, point_form=point_form),
         out_shape=jax.ShapeDtypeStruct((1, bsz), jnp.int32),
         grid=(grid,),
         in_specs=in_specs,
@@ -358,22 +484,34 @@ def verify_blocked_impl(
     return out[0].astype(jnp.bool_)
 
 
+def _active_point_form() -> str:
+    return point_form()
+
+
 @partial(
     jax.jit,
-    static_argnames=("interpret", "block", "schnorr_free", "field_modes"),
+    static_argnames=(
+        "interpret", "block", "schnorr_free", "point_form", "field_modes",
+    ),
 )
 def _verify_blocked_jit(*args, interpret: bool = False, block: int = BLOCK,
-                        schnorr_free: bool = False, field_modes=None):
-    # ``field_modes`` is only a jit-cache key: the field formulation knobs
-    # (field.field_modes()) are process globals read at trace time, so a
-    # flip must force a retrace instead of reusing the stale executable.
+                        schnorr_free: bool = False, point_form=None,
+                        field_modes=None):
+    # ``field_modes`` is only a jit-cache key (kernel.structure_modes():
+    # field formulation + select/ladder shape — the point form rides the
+    # EXPLICIT static arg, so including the global form here too would
+    # double-encode it): the knobs are process globals read at trace
+    # time, so a flip must force a retrace instead of reusing the stale
+    # executable.
     del field_modes
     return verify_blocked_impl(*args, interpret=interpret, block=block,
-                               schnorr_free=schnorr_free)
+                               schnorr_free=schnorr_free,
+                               point_form=point_form)
 
 
 def verify_blocked(*args, interpret: bool = False, block: int = BLOCK,
-                   schnorr_free: bool = False):
+                   schnorr_free: bool = False,
+                   point_form: "str | None" = None):
     """Drop-in replacement for :func:`kernel.verify_core` (same argument
     order — PreparedBatch.device_args) running the Pallas kernel over
     lane blocks of ``block`` (default BLOCK; tests use small blocks in
@@ -382,8 +520,12 @@ def verify_blocked(*args, interpret: bool = False, block: int = BLOCK,
     selects the ECDSA-only program variant (acceptance pows pruned at
     trace time) — callers must only set it when no lane carries a
     schnorr/bip340 flag (kernel._dispatch_prep derives it from the
-    prepared batch).  Jit-cached per field formulation
-    (field.field_modes())."""
+    prepared batch).  ``point_form`` selects the projective/affine MSM
+    (None = the process-global curve.point_form()).  Jit-cached per
+    explicit point form + kernel.structure_modes()."""
+    if point_form is None:
+        point_form = _active_point_form()
     return _verify_blocked_jit(*args, interpret=interpret, block=block,
                                schnorr_free=schnorr_free,
-                               field_modes=F.field_modes())
+                               point_form=point_form,
+                               field_modes=structure_modes())
