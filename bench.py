@@ -105,6 +105,10 @@ T_KERNEL_AB = float(os.environ.get("TPUNODE_BENCH_KERNELAB_TIMEOUT", 270))
 T_KERNEL_AB_BIG = float(
     os.environ.get("TPUNODE_BENCH_KERNELAB_BIG_TIMEOUT", 0)
 )
+# Crash-recovery scenario (ISSUE 9): reopen/replay latency vs log size,
+# compaction pause, and a bounded kill-torture sweep (real writer-child
+# subprocesses killed at seeded points).  jax never imported.
+T_RECOVERY = float(os.environ.get("TPUNODE_BENCH_RECOVERY_TIMEOUT", 180))
 # Total ceiling: probe (<=120s) + ladder (<=600s) + fallback (<=210s)
 # + mempool (<=150s) keeps the worst case ~18 min; r03's artifact
 # demonstrated the driver tolerating 810s, and the in-round watcher
@@ -700,6 +704,96 @@ def _worker_chaos() -> None:
         print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
 
 
+def _worker_recovery() -> None:
+    """Crash-recovery scenario worker (ISSUE 9): makes recovery cost a
+    tracked number.  Measures (1) reopen/replay latency at two log sizes
+    (records/s and MB/s of the streamed v2 replay), (2) the compaction
+    pause on the larger store, and (3) a bounded kill-torture sweep —
+    real writer children killed at seeded append/rotate/compact points +
+    bit-flip detection runs, reporting the pass rate.  jax is never
+    imported; the parent watchdog bounds the whole worker."""
+    import shutil
+    import tempfile
+
+    torture_budget = float(
+        os.environ.get("TPUNODE_BENCH_RECOVERY_TORTURE_S", 75)
+    )
+    try:
+        from tpunode.store import LogKV, put_op
+        from tpunode.torture import sweep
+
+        out: dict = {"ok": True, "replay": []}
+        base = tempfile.mkdtemp(prefix="tpunode-recovery-")
+        try:
+            # 1) reopen/replay latency vs log size
+            for label, n_records in (("small", 2_000), ("large", 20_000)):
+                _progress(f"building {label} log ({n_records} records)...")
+                path = os.path.join(base, f"replay-{label}", "kv.log")
+                s = LogKV(path)
+                batch = [
+                    put_op(b"k%08d" % i, (b"v%08d" % i) * 12)
+                    for i in range(n_records)
+                ]
+                for i in range(0, n_records, 500):
+                    s.write_batch(batch[i : i + 500])
+                s.close()
+                size = sum(
+                    os.path.getsize(os.path.join(d, f))
+                    for d, _, fs in os.walk(os.path.dirname(path))
+                    for f in fs
+                )
+                t0 = time.perf_counter()
+                s2 = LogKV(path)
+                open_s = time.perf_counter() - t0
+                row = {
+                    "label": label,
+                    "records": n_records,
+                    "bytes": size,
+                    "open_ms": round(open_s * 1e3, 1),
+                    "records_per_s": round(n_records / open_s),
+                    "mb_per_s": round(size / open_s / 1e6, 1),
+                }
+                # 2) compaction pause on the large store (overwrites first
+                # so compaction has real garbage to drop)
+                if label == "large":
+                    for i in range(0, 5_000, 500):
+                        s2.write_batch(
+                            [put_op(b"k%08d" % j, b"fresh" * 16)
+                             for j in range(i, i + 500)]
+                        )
+                    t0 = time.perf_counter()
+                    s2.compact()
+                    out["compaction_pause_ms"] = round(
+                        (time.perf_counter() - t0) * 1e3, 1
+                    )
+                s2.close()
+                out["replay"].append(row)
+            # 3) bounded kill-torture sweep (real subprocess children)
+            _progress("running kill-torture sweep...")
+            res = sweep(
+                os.path.join(base, "torture"), seeds=(1,), ops=24,
+                seg_bytes=1000, compact_every=10, bit_flips=2,
+                budget_s=torture_budget,
+            )
+            out["torture"] = {
+                "kill_points": res.points,
+                "completed_runs": res.completed,
+                "corruption_detected": res.corruption_detected,
+                "violations": res.violations[:10],
+                "pass": res.ok,
+            }
+            if not res.ok:
+                out["ok"] = False
+                out["error"] = (
+                    f"{len(res.violations)} torture invariant violation(s)"
+                )
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+        print(json.dumps(out))
+    except Exception as e:  # noqa: BLE001 — worker reports, parent decides
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
+
+
 def _worker_kernel_ab() -> None:
     """Kernel point-form A/B worker (ISSUE 8): projective vs affine XLA
     step time at one batch size on cpu-jax, in a bounded subprocess.
@@ -849,6 +943,25 @@ def _resilience_section() -> dict:
         out = {"ok": False, "error": str(res["error"])[:300]}
         for k in ("verdict_conservation", "failovers", "breaker_opens",
                   "breaker_closes", "injections"):
+            if k in res:
+                out[k] = res[k]
+        return out
+    return res
+
+
+def _recovery_section() -> dict:
+    """The BENCH JSON ``recovery`` section (ISSUE 9): reopen/replay
+    latency vs log size, compaction pause, and the kill-torture pass
+    rate, measured in a bounded jax-free worker subprocess.  Always
+    returns a dict — a failed/timed-out scenario is labeled, never
+    masked (and never takes the headline down with it)."""
+    res = _run_worker(
+        "--recovery", T_RECOVERY,
+        {"JAX_PLATFORMS": "cpu"},  # belt-and-braces: worker never imports jax
+    )
+    if not res.get("ok") and "error" in res:
+        out = {"ok": False, "error": str(res["error"])[:300]}
+        for k in ("replay", "compaction_pause_ms", "torture"):
             if k in res:
                 out[k] = res[k]
         return out
@@ -1237,6 +1350,10 @@ def _main_locked() -> None:
     # transitions and recovery latency, failure-labeled like the
     # mempool section so it never masks the headline.
     out["resilience"] = _resilience_section()
+    # Crash-recovery section (ISSUE 9): reopen/replay latency vs log
+    # size, compaction pause, kill-torture pass-rate — recovery cost as
+    # a tracked number, failure-labeled like the sections above.
+    out["recovery"] = _recovery_section()
     # Kernel point-form A/B section (ISSUE 8): projective vs affine step
     # time on cpu-jax, failure-labeled per batch like the sections above.
     # Named "kernel_ab" because the top-level "kernel" key already names
@@ -1263,6 +1380,8 @@ if __name__ == "__main__":
         _worker_mempool()
     elif "--chaos" in sys.argv:
         _worker_chaos()
+    elif "--recovery" in sys.argv:
+        _worker_recovery()
     elif "--kernel-ab" in sys.argv:
         _worker_kernel_ab()
     else:

@@ -4,9 +4,13 @@
 //
 // Design: append-only log + in-memory ordered index (std::map), replayed
 // on open with torn-tail truncation, compacted when dead bytes dominate.
-// The on-disk record format is IDENTICAL to the Python LogKV engine
-// (tpunode/store.py): op(u8) klen(u32le) vlen(u32le) key value — the two
-// engines can open each other's files, which the tests assert.
+// The on-disk record format is the LEGACY v1 log:
+// op(u8) klen(u32le) vlen(u32le) key value.  The Python LogKV engine
+// (tpunode/store.py) now writes the crash-consistent v2 segmented format
+// (CRC32 + sequence numbers + file headers, ISSUE 9); its v2 reader
+// replays v1 files bit-identically, and the Python binding
+// (tpunode/native.py) version-gates this engine — it refuses to open a
+// directory holding v2 artifacts rather than serve a stale subset.
 //
 // Exposed as a C ABI for ctypes (tpunode/native.py).  Single-writer,
 // like the reference's usage of RocksDB (one Chain actor owns the DB).

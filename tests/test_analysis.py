@@ -177,6 +177,27 @@ def test_blocking_call_resolves_import_aliases():
     assert [f.rule for f in analyze_source(src)] == ["blocking-call"]
 
 
+def test_blocking_call_knows_durable_storage_syscalls():
+    """ISSUE 9 satellite: os.fsync/os.replace (and friends) in async
+    scope freeze the loop for an unbounded disk flush — the chain
+    actor's durable commits must route through the group-commit writer
+    thread instead."""
+    src = """\
+import os
+
+async def f(fd, a, b):
+    os.fsync(fd)
+    os.fdatasync(fd)
+    os.replace(a, b)
+    os.rename(a, b)
+
+def sync_is_fine(fd, a, b):
+    os.fsync(fd)
+    os.replace(a, b)
+"""
+    assert [f.rule for f in analyze_source(src)] == ["blocking-call"] * 4
+
+
 def test_blocking_call_ignores_sync_and_threaded_scopes():
     src = """\
 import asyncio

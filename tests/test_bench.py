@@ -51,6 +51,21 @@ _KERNEL_AB_OK = {
     "affine_vs_projective": 0.0292,
 }
 
+# Canned healthy crash-recovery result (ISSUE 9; the real subprocess
+# path is covered by test_recovery_worker_subprocess).
+_RECOVERY_OK = {
+    "ok": True,
+    "replay": [
+        {"label": "small", "records": 2000, "bytes": 268016,
+         "open_ms": 7.5, "records_per_s": 266431, "mb_per_s": 35.7},
+        {"label": "large", "records": 20000, "bytes": 2680016,
+         "open_ms": 58.8, "records_per_s": 340217, "mb_per_s": 45.6},
+    ],
+    "compaction_pause_ms": 41.1,
+    "torture": {"kill_points": 38, "completed_runs": 3,
+                "corruption_detected": 2, "violations": [], "pass": True},
+}
+
 # Canned healthy chaos-resilience result (the real subprocess path is
 # covered by test_chaos_worker_subprocess).
 _CHAOS_OK = {
@@ -89,6 +104,9 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         if mode == "--kernel-ab":
             # likewise for the ride-along kernel A/B section (ISSUE 8)
             return dict(_KERNEL_AB_OK)
+        if mode == "--recovery":
+            # likewise for the ride-along crash-recovery section (ISSUE 9)
+            return dict(_RECOVERY_OK)
         raise AssertionError(f"unexpected worker call: {mode} {env_extra}")
 
     monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
@@ -129,7 +147,8 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
     # part of the probe/ladder/fallback logic the scripted scenarios pin
     # call counts and env shapes on — drop them from the transcript
     calls = [
-        c for c in calls if c[0] not in ("--mempool", "--chaos", "--kernel-ab")
+        c for c in calls
+        if c[0] not in ("--mempool", "--chaos", "--kernel-ab", "--recovery")
     ]
     return line, calls, rc
 
@@ -539,6 +558,74 @@ def test_resilience_section_failure_labeled(monkeypatch):
     assert rs["failovers"] == 2 and rs["breaker_opens"] == 1
 
 
+def _is_recovery(mode, env):
+    return mode == "--recovery"
+
+
+def test_recovery_section_always_present(monkeypatch):
+    """ISSUE 9: the BENCH JSON carries a ``recovery`` section (replay
+    latency vs log size, compaction pause, kill-torture pass rate) on
+    every run."""
+    bench = _load_bench()
+    line, _, _ = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 1.0, "device": "tpu:v5e"}),
+        ],
+    )
+    rs = line["recovery"]
+    assert rs["ok"] is True
+    assert {r["label"] for r in rs["replay"]} == {"small", "large"}
+    assert rs["compaction_pause_ms"] > 0
+    assert rs["torture"]["pass"] is True
+    assert rs["torture"]["kill_points"] > 0
+
+
+def test_recovery_section_worker_env_is_device_free(monkeypatch):
+    """The recovery worker never imports jax; its env pins cpu anyway
+    (belt-and-braces against the axon shim)."""
+    bench = _load_bench()
+    seen = []
+    monkeypatch.setattr(
+        bench, "_run_worker",
+        lambda mode, timeout, env=None: (
+            seen.append((mode, timeout, dict(env or {})))
+            or dict(_RECOVERY_OK)
+        ),
+    )
+    assert bench._recovery_section()["ok"] is True
+    ((mode, timeout, env),) = seen
+    assert mode == "--recovery"
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert timeout == bench.T_RECOVERY
+
+
+def test_recovery_section_failure_labeled(monkeypatch):
+    """A failed/timed-out recovery scenario is labeled — with whatever
+    partial evidence it produced — never masked, and never takes the
+    headline down with it."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_recovery, {"ok": False,
+                            "error": "2 torture invariant violation(s)",
+                            "torture": {"kill_points": 7, "pass": False}}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 9.0  # headline survived
+    rs = line["recovery"]
+    assert rs["ok"] is False
+    assert "violation" in rs["error"]
+    assert rs["torture"]["kill_points"] == 7
+
+
 def test_kernel_section_always_present_and_labeled(monkeypatch):
     """ISSUE 8 satellite: the BENCH JSON carries a ``kernel`` section
     (projective-vs-affine step-time A/B) on every run — the 1024 cell
@@ -633,6 +720,38 @@ def test_kernel_ab_worker_subprocess():
         assert f["step_ms_min"] <= f["step_ms"] <= f["step_ms_max"]
         assert f["compile_s"] > 0
     assert isinstance(line["affine_vs_projective"], float)
+
+
+@pytest.mark.slow
+def test_recovery_worker_subprocess():
+    """The real ``--recovery`` worker end-to-end in a subprocess: replay
+    latency rows at both log sizes, a real compaction pause, and a
+    bounded kill-torture sweep with zero invariant violations."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "bench.py"), "--recovery"],
+        env=dict(
+            os.environ,
+            TPUNODE_BENCH_RECOVERY_TORTURE_S="30",
+            JAX_PLATFORMS="cpu",
+        ),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=170,
+    )
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["ok"] is True, line
+    assert {r["label"] for r in line["replay"]} == {"small", "large"}
+    for row in line["replay"]:
+        assert row["open_ms"] > 0 and row["records_per_s"] > 0
+    assert line["compaction_pause_ms"] > 0
+    t = line["torture"]
+    assert t["pass"] is True and t["violations"] == []
+    assert t["kill_points"] >= 5
+    assert t["corruption_detected"] >= 1
 
 
 def test_chaos_worker_subprocess():

@@ -1,0 +1,199 @@
+"""Kill-torture + restart recovery tests (ISSUE 9).
+
+Tier-1 runs a smoke subset of the torture sweep (a few seeded kill
+points across append/rotate/compact + one bit-flip detection run); the
+``slow`` tier runs the acceptance sweep — **≥200 distinct seeded kill
+points with zero invariant violations** — and the fakenet IBD
+SIGKILL-restart scenario as a real subprocess.  The sweep/verify engine
+itself lives in tpunode/torture.py (shared with ``bench.py --recovery``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpunode.metrics import metrics
+from tpunode.torture import CRASH_EXIT, run_child, sweep, verify_dir
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_child_crashes_at_injected_point_and_recovers(tmp_path):
+    """One precise kill: the child dies with the chaos exit status, the
+    reopened store honors every acked write."""
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    proc = run_child(
+        d, "seed=1;store.append:crash:after=9", ops=24, seg_bytes=900,
+        compact_every=10,
+    )
+    assert proc.returncode == CRASH_EXIT, proc.stderr.decode()[-500:]
+    assert verify_dir(d, "crash") == []
+
+
+@pytest.mark.slow
+def test_torture_smoke_sweep(tmp_path):
+    """Small sweep: first kill points of every path + one bit-flip run,
+    zero violations.  Slow-marked with the ≥200-point acceptance sweep —
+    the tier-1 870s budget is seed-saturated on this box (PR 8 note);
+    tier-1 keeps the single-kill pin above."""
+    res = sweep(
+        str(tmp_path), seeds=(1,), max_after=2, ops=18, seg_bytes=700,
+        compact_every=8, bit_flips=1,
+    )
+    assert res.violations == []
+    assert res.points >= 6  # 2 kills on each of append/rotate/compact
+    assert res.corruption_detected == 1
+
+
+@pytest.mark.slow
+def test_torture_acceptance_200_kill_points(tmp_path):
+    """ISSUE 9 acceptance: ≥200 seeded kill points across the append/
+    rotate/compact paths, ZERO invariant violations — every fsync-acked
+    write durable after reopen, clean kills replay silently, injected
+    bit-flips always detected (never surfaced), watermark monotone."""
+    res = sweep(
+        str(tmp_path), seeds=(1, 2, 3), ops=60, seg_bytes=1600,
+        compact_every=25, bit_flips=2,
+    )
+    assert res.violations == [], res.violations[:20]
+    assert res.points >= 200, (
+        f"only {res.points} kill points exercised (completed="
+        f"{res.completed})"
+    )
+    assert res.corruption_detected == 6  # 2 bit-flip runs x 3 seeds
+
+
+# ---------------------------------------------------------------------------
+# fakenet IBD restart (SIGKILL flavor; the in-process pin is test_utxo.py)
+
+def _restart_child_main(dirpath: str) -> None:
+    """Subprocess body: sync the fakenet chain, connect every block into
+    the UTXO store, then signal readiness and idle until SIGKILLed."""
+    sys.path.insert(0, REPO)
+    from tpunode.compat import install_asyncio_timeout
+
+    install_asyncio_timeout()
+    from tests.fakenet import dummy_peer_connect, poll_until
+    from tests.fixtures import all_blocks
+    from tpunode import BCH_REGTEST, ChainSynced, Node, NodeConfig, Publisher
+    from tpunode.peer import PeerConnected, PeerMessage
+    from tpunode.store import LogKV
+    from tpunode.wire import MsgBlock
+
+    blocks = all_blocks()
+
+    async def main():
+        store = LogKV(os.path.join(dirpath, "node.log"), fsync=True)
+        pub = Publisher(name="restart-child")
+        cfg = NodeConfig(
+            net=BCH_REGTEST, store=store, pub=pub, peers=["[::1]:17486"],
+            discover=False,
+            connect=lambda sa: dummy_peer_connect(BCH_REGTEST, blocks),
+            utxo=True,
+        )
+        async with pub.subscription() as events:
+            async with Node(cfg) as node:
+                peer = None
+                async with asyncio.timeout(20):
+                    while True:
+                        ev = await events.receive()
+                        if isinstance(ev, PeerConnected):
+                            peer = ev.peer
+                        if isinstance(ev, ChainSynced):
+                            break
+                for b in blocks:
+                    node._peer_pub.publish(PeerMessage(peer, MsgBlock(b)))
+                await poll_until(
+                    lambda: node.utxo.height == len(blocks), timeout=20,
+                    what="utxo catch-up",
+                )
+                with open(os.path.join(dirpath, "ready"), "w") as f:
+                    f.write(str(node.chain.get_best().height))
+                await asyncio.sleep(3600)  # parent SIGKILLs us here
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_fakenet_ibd_sigkill_restart(tmp_path):
+    """ISSUE 9 restart scenario: a fakenet IBD child is SIGKILLed after
+    persisting chain + UTXO; the restarted node resumes at the persisted
+    height with the watermark intact, and the re-delivered blocks are
+    skipped — nothing re-downloaded, nothing re-verified."""
+    from tests.fakenet import dummy_peer_connect, poll_until
+    from tests.fixtures import all_blocks
+    from tpunode import BCH_REGTEST, Node, NodeConfig, Publisher
+    from tpunode.peer import PeerMessage
+    from tpunode.store import LogKV
+    from tpunode.wire import MsgBlock
+
+    d = str(tmp_path)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "from tests.test_store_recovery import _restart_child_main; "
+            f"_restart_child_main({d!r})",
+        ],
+        cwd=REPO,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    ready = os.path.join(d, "ready")
+    deadline = time.monotonic() + 60
+    while not os.path.exists(ready):
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"child died rc={proc.returncode}: "
+                f"{proc.stderr.read().decode(errors='replace')[-800:]}"
+            )
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise AssertionError("child never became ready")
+        time.sleep(0.05)
+    synced_height = int(open(ready).read())
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(10)
+
+    blocks = all_blocks()
+    assert synced_height == len(blocks)
+    store = LogKV(os.path.join(d, "node.log"))  # cold replay
+    pub = Publisher(name="restart-parent")
+    cfg = NodeConfig(
+        net=BCH_REGTEST, store=store, pub=pub, peers=["[::1]:17486"],
+        discover=False,
+        connect=lambda sa: dummy_peer_connect(BCH_REGTEST, blocks),
+        utxo=True,
+    )
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            # resumed from the store BEFORE any peer traffic
+            assert node.chain.get_best().height == synced_height
+            assert node.utxo.height == synced_height
+            applied0 = metrics.get("utxo.applied")
+            verify0 = metrics.get("node.verify_txs")
+            skipped0 = metrics.get("node.block_replay_skipped")
+            # the fake remote reconnects and re-serves its whole chain;
+            # re-deliver every block: ALL must be skipped as persisted
+            from tests.test_node import wait_for_peer
+
+            async with asyncio.timeout(15):
+                peer = await wait_for_peer(events)
+            for b in blocks:
+                node._peer_pub.publish(PeerMessage(peer, MsgBlock(b)))
+            await poll_until(
+                lambda: metrics.get("node.block_replay_skipped")
+                >= skipped0 + len(blocks),
+                what="replayed blocks skipped",
+            )
+            assert metrics.get("utxo.applied") == applied0
+            assert metrics.get("node.verify_txs") == verify0
+    store.close()

@@ -1,0 +1,302 @@
+"""Persistent UTXO store (ISSUE 9 / ROADMAP item 5): unit invariants +
+the node wiring — block connect applies atomically behind the watermark,
+the prevout oracle serves confirmed outputs, and a restart resumes from
+the persisted chain + UTXO set without re-applying or re-verifying.
+"""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from tests.fakenet import dummy_peer_connect, poll_until
+from tests.fixtures import all_blocks
+from tpunode import (
+    BCH_REGTEST,
+    ChainSynced,
+    Namespaced,
+    Node,
+    NodeConfig,
+    Publisher,
+    UtxoStore,
+)
+from tpunode.chaos import ChaosFault, ChaosPlan, chaos
+from tpunode.metrics import metrics
+from tpunode.peer import PeerConnected, PeerMessage
+from tpunode.store import LogKV, MemoryKV
+from tpunode.wire import MsgBlock
+
+NET = BCH_REGTEST
+
+
+@pytest.fixture
+def chaos_off():
+    yield
+    chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# unit invariants
+
+def test_apply_lookup_spend_watermark():
+    u = UtxoStore(MemoryKV())
+    assert u.height == -1
+    assert u.lookup(b"\x01" * 32, 0) is None
+    assert u.apply(
+        0, b"h0", spends=[],
+        creates=[(b"\x01" * 32, 0, 5000, b"\x51"), (b"\x01" * 32, 1, 7, b"")],
+    )
+    assert u.height == 0
+    assert u.lookup(b"\x01" * 32, 0) == (5000, b"\x51")
+    assert u.lookup(b"\x01" * 32, 1) == (7, b"")
+    # next block spends one output
+    assert u.apply(
+        1, b"h1", spends=[(b"\x01" * 32, 0)],
+        creates=[(b"\x02" * 32, 0, 9000, b"\x52")],
+    )
+    assert u.lookup(b"\x01" * 32, 0) is None
+    assert u.lookup(b"\x02" * 32, 0) == (9000, b"\x52")
+    assert u.height == 1
+
+
+def test_apply_is_idempotent_below_watermark():
+    u = UtxoStore(MemoryKV())
+    u.apply(3, b"h3", spends=[], creates=[(b"\x03" * 32, 0, 1, b"")])
+    s0 = metrics.get("utxo.skipped")
+    # a crash-replayed (re-delivered) block is refused, state unchanged
+    assert not u.apply(
+        3, b"h3", spends=[(b"\x03" * 32, 0)], creates=[]
+    )
+    assert not u.apply(2, b"h2", spends=[], creates=[])
+    assert metrics.get("utxo.skipped") == s0 + 2
+    assert u.lookup(b"\x03" * 32, 0) == (1, b"")
+    assert u.height == 3
+
+
+def test_watermark_persists_across_reopen(tmp_path):
+    path = str(tmp_path / "kv.log")
+    s = LogKV(path)
+    u = UtxoStore(Namespaced(s, b"u/"))
+    u.apply(7, b"hash7" + b"\x00" * 27, spends=[],
+            creates=[(b"\x07" * 32, 0, 42, b"\x53")])
+    s.close()
+    s2 = LogKV(path)
+    u2 = UtxoStore(Namespaced(s2, b"u/"))
+    assert u2.height == 7
+    assert u2.block_hash == b"hash7" + b"\x00" * 27
+    assert u2.lookup(b"\x07" * 32, 0) == (42, b"\x53")
+    s2.close()
+
+
+def test_apply_atomic_under_chaos(tmp_path, chaos_off):
+    """One write_batch carries spends+creates+watermark: an injected fault
+    applies NOTHING — no half-connected block, watermark unmoved."""
+    path = str(tmp_path / "kv.log")
+    s = LogKV(path)
+    u = UtxoStore(Namespaced(s, b"u/"))
+    u.apply(0, b"h0", spends=[], creates=[(b"\x01" * 32, 0, 1, b"")])
+    chaos.install(ChaosPlan.parse("seed=5;store.write:error:n=1"))
+    with pytest.raises(ChaosFault):
+        u.apply(
+            1, b"h1", spends=[(b"\x01" * 32, 0)],
+            creates=[(b"\x02" * 32, 0, 2, b"")],
+        )
+    chaos.uninstall()
+    assert u.height == 0  # watermark unmoved
+    assert u.lookup(b"\x01" * 32, 0) == (1, b"")  # spend not applied
+    assert u.lookup(b"\x02" * 32, 0) is None  # create not applied
+    s.close()
+    # and the durable state agrees
+    s2 = LogKV(path)
+    u2 = UtxoStore(Namespaced(s2, b"u/"))
+    assert u2.height == 0
+    s2.close()
+
+
+def test_apply_block_from_parsed_txs():
+    """apply_block extracts creates/spends from wire Tx objects, skipping
+    the coinbase's null prevout, and same-block chains net out."""
+    blocks = all_blocks()
+    u = UtxoStore(MemoryKV())
+    for height, b in enumerate(blocks, start=1):
+        assert u.apply_block(height, b.header.hash, list(b.txs))
+    assert u.height == len(blocks)
+    # every block's coinbase output is present with its real amount/script
+    last = blocks[-1]
+    cb = last.txs[0]
+    got = u.lookup(cb.txid, 0)
+    assert got == (cb.outputs[0].value, cb.outputs[0].script)
+
+
+# ---------------------------------------------------------------------------
+# node wiring
+
+@contextlib.asynccontextmanager
+async def utxo_node(store, blocks):
+    pub = Publisher(name="utxo-node-events")
+    cfg = NodeConfig(
+        net=NET,
+        store=store,
+        pub=pub,
+        peers=["[::1]:17486"],
+        discover=False,
+        connect=lambda sa: dummy_peer_connect(NET, blocks),
+        utxo=True,
+    )
+    async with pub.subscription() as events:
+        async with Node(cfg) as node:
+            yield node, events
+
+
+async def _sync_and_connect_blocks(node, events, blocks):
+    async with asyncio.timeout(15):
+        peer = None
+        while True:
+            ev = await events.receive()
+            if isinstance(ev, PeerConnected):
+                peer = ev.peer
+            if isinstance(ev, ChainSynced):
+                break
+        assert peer is not None
+        for b in blocks:
+            node._peer_pub.publish(PeerMessage(peer, MsgBlock(b)))
+    await poll_until(
+        lambda: node.utxo.height == len(blocks), what="utxo catch-up"
+    )
+    return peer
+
+
+@pytest.mark.asyncio
+async def test_node_connects_blocks_and_serves_prevout_oracle(tmp_path):
+    blocks = all_blocks()
+    store = LogKV(str(tmp_path / "node.log"))
+    async with utxo_node(store, blocks) as (node, events):
+        await _sync_and_connect_blocks(node, events, blocks)
+        assert node.utxo.height == len(blocks)
+        cb = blocks[2].txs[0]
+        oracle = node._prevout_oracle()
+        assert oracle is not None
+        assert oracle(cb.txid, 0) == (
+            cb.outputs[0].value, cb.outputs[0].script,
+        )
+        assert node.health()["utxo_height"] == len(blocks)
+        assert node.stats()["utxo"]["enabled"] is True
+    store.close()
+
+
+@pytest.mark.asyncio
+async def test_restart_resumes_from_persisted_chain_and_utxo(tmp_path):
+    """The ISSUE 9 restart pin (in-process flavor; the SIGKILL subprocess
+    variant lives in test_store_recovery.py): a node reopened over the
+    same store starts at the persisted best height BEFORE any peer
+    traffic, keeps the UTXO watermark, and re-delivered blocks are
+    skipped — no re-apply, no re-verification."""
+    blocks = all_blocks()
+    path = str(tmp_path / "node.log")
+    store = LogKV(path)
+    async with utxo_node(store, blocks) as (node, events):
+        await _sync_and_connect_blocks(node, events, blocks)
+        best = node.chain.get_best()
+        assert best.height == len(blocks)
+    store.close()
+
+    store2 = LogKV(path)  # a real cold replay of the segmented log
+    pub = Publisher(name="utxo-restart")
+    cfg = NodeConfig(
+        net=NET, store=store2, pub=pub, peers=[], discover=False,
+        connect=lambda sa: dummy_peer_connect(NET, blocks), utxo=True,
+    )
+    async with pub.subscription():
+        async with Node(cfg) as node2:
+            # resumed BEFORE any peer traffic: nothing to re-download
+            assert node2.chain.get_best().height == len(blocks)
+            assert node2.utxo.height == len(blocks)
+            applied0 = metrics.get("utxo.applied")
+            skipped0 = metrics.get("node.block_replay_skipped")
+
+            class P:  # minimal peer surface for the router
+                label = "replay:0"
+
+            node2._peer_pub.publish(PeerMessage(P(), MsgBlock(blocks[9])))
+            await poll_until(
+                lambda: metrics.get("node.block_replay_skipped")
+                == skipped0 + 1,
+                what="replayed block skipped",
+            )
+            assert metrics.get("utxo.applied") == applied0  # no re-apply
+    store2.close()
+
+
+@pytest.mark.asyncio
+async def test_out_of_order_block_parks_until_predecessor(tmp_path):
+    """Review pin: applying height N+2 over a watermark of N would strand
+    N+1's delta below the watermark forever.  An early arrival PARKS
+    (utxo.deferred) without advancing the watermark; once its
+    predecessor lands, the parked chain drains contiguously."""
+    blocks = all_blocks()
+    store = LogKV(str(tmp_path / "node.log"))
+    async with utxo_node(store, blocks) as (node, events):
+        async with asyncio.timeout(15):
+            peer = None
+            while True:
+                ev = await events.receive()
+                if isinstance(ev, PeerConnected):
+                    peer = ev.peer
+                if isinstance(ev, ChainSynced):
+                    break
+        d0 = metrics.get("utxo.deferred")
+        # deliver heights 3 and 2 FIRST: parked, watermark stays -1
+        node._peer_pub.publish(PeerMessage(peer, MsgBlock(blocks[2])))
+        node._peer_pub.publish(PeerMessage(peer, MsgBlock(blocks[1])))
+        await poll_until(
+            lambda: metrics.get("utxo.deferred") == d0 + 2,
+            what="gaps parked",
+        )
+        assert node.utxo.height == -1
+        # height 1 lands: the parked chain drains to 3 without re-delivery
+        node._peer_pub.publish(PeerMessage(peer, MsgBlock(blocks[0])))
+        await poll_until(lambda: node.utxo.height == 3, what="parked drain")
+        cb = blocks[1].txs[0]
+        assert node.utxo.lookup(cb.txid, 0) == (
+            cb.outputs[0].value, cb.outputs[0].script,
+        )
+    store.close()
+
+
+@pytest.mark.asyncio
+async def test_reorg_beneath_watermark_goes_loudly_stale(tmp_path):
+    """Review pin: a watermark on a branch the chain no longer follows
+    must not silently absorb the new branch's deltas — the next connect
+    fails the hash-chain check, emits utxo.reorg_stale, and the
+    watermark never advances (no undo log: rebuild is the remedy)."""
+    from tpunode.utxo import UTXO_NAMESPACE
+
+    blocks = all_blocks()
+    store = LogKV(str(tmp_path / "node.log"))
+    # seed a height-1 watermark pointing at a block hash that is NOT on
+    # (or even known to) the canned chain — an orphaned branch's tip
+    UtxoStore(Namespaced(store, UTXO_NAMESPACE)).apply(
+        1, b"\xab" * 32, spends=[], creates=[]
+    )
+    r0 = metrics.get("utxo.reorg_stale")
+    async with utxo_node(store, blocks) as (node, events):
+        async with asyncio.timeout(15):
+            peer = None
+            while True:
+                ev = await events.receive()
+                if isinstance(ev, PeerConnected):
+                    peer = ev.peer
+                if isinstance(ev, ChainSynced):
+                    break
+        # height 1 is NOT treated as persisted (watermark block unknown
+        # to the header store -> re-verify) ...
+        assert node._persisted_height(MsgBlock(blocks[0]).block) is None
+        for b in blocks:
+            node._peer_pub.publish(PeerMessage(peer, MsgBlock(b)))
+        # ... and height 2 refuses to stack onto the foreign watermark
+        await poll_until(
+            lambda: metrics.get("utxo.reorg_stale") > r0,
+            what="stale reorg detected",
+        )
+        assert node.utxo.height == 1  # never advanced onto wrong state
+    store.close()

@@ -72,7 +72,14 @@ from .peermgr import (
     to_host_service,
     to_sock_addr,
 )
-from .store import LogKV, MemoryKV, Namespaced, open_store
+from .store import (
+    LogKV,
+    MemoryKV,
+    Namespaced,
+    StoreVersionError,
+    open_store,
+)
+from .utxo import UtxoStore
 from .sighash import bip143_sighash, bip341_sighash, legacy_sighash
 from .txverify import (
     ExtractStats,

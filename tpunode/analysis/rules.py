@@ -25,6 +25,14 @@ _BLOCKING_CALLS = {
     "os.popen",
     "os.wait",
     "os.waitpid",
+    # durable-storage syscalls (ISSUE 9): an fsync is milliseconds on a
+    # good day and unbounded on a bad one, and a cross-filesystem replace
+    # degrades to a copy — the chain actor's durable commits route them
+    # through LogKV's group-commit writer thread instead
+    "os.fsync",
+    "os.fdatasync",
+    "os.replace",
+    "os.rename",
     "subprocess.run",
     "subprocess.call",
     "subprocess.check_call",
@@ -380,6 +388,7 @@ KNOWN_LAYERS = frozenset({
     "peermgr",    # fleet manager (tpunode/peermgr.py)
     "store",      # KV store (tpunode/store.py)
     "trace",      # tracing internals (tpunode/tracectx.py)
+    "utxo",       # persistent UTXO store (tpunode/utxo.py, ISSUE 9)
     "verify",     # batch verify engine (tpunode/verify/)
     "watchdog",   # stall watchdog (tpunode/watchdog.py)
 })

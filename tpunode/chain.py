@@ -118,10 +118,31 @@ class ChainDB:
     def put_headers(self, nodes: list[BlockNode], best: Optional[BlockNode]) -> None:
         """Atomic batch write of nodes (+ best pointer), the analog of
         ``addBlockHeaders``/``writeBatch`` (Chain.hs:256-263)."""
+        self._kv.write_batch(self._header_ops(nodes, best))
+
+    async def put_headers_durable(
+        self, nodes: list[BlockNode], best: Optional[BlockNode]
+    ) -> None:
+        """:meth:`put_headers` with the fsync off the event loop: stores
+        exposing ``write_batch_async`` (LogKV's group-commit writer thread)
+        do the physical append + fsync there, and this coroutine resumes
+        only once the batch is durable — the chain actor keeps its
+        acked ⇒ durable contract (the continuation ``getheaders`` is only
+        sent after this returns) without ever blocking the loop inside
+        ``os.fsync`` (asyncsan blocking-call clean, ISSUE 9)."""
+        ops = self._header_ops(nodes, best)
+        submit = getattr(self._kv, "write_batch_async", None)
+        if submit is None:
+            self._kv.write_batch(ops)  # memory/native engines: no fsync cost
+            return
+        await asyncio.wrap_future(submit(ops))
+
+    @staticmethod
+    def _header_ops(nodes: list[BlockNode], best: Optional[BlockNode]):
         ops = [put_op(_KEY_HEADER + n.hash, n.serialize()) for n in nodes]
         if best is not None:
             ops.append(put_op(_KEY_BEST, best.serialize()))
-        self._kv.write_batch(ops)
+        return ops
 
     def get_version(self) -> Optional[int]:
         raw = self._kv.get(_KEY_VERSION)
@@ -224,7 +245,7 @@ class Chain:
         while True:
             msg = await self.mailbox.receive()
             if isinstance(msg, _Headers):
-                self._process_headers(msg.peer, msg.headers)
+                await self._process_headers(msg.peer, msg.headers)
                 # a headers message's pipeline trace (started in the peer
                 # wire loop, carried here by the mailbox) ends at import
                 _finish_active_trace()
@@ -249,9 +270,15 @@ class Chain:
 
     # -- sync state machine (single-threaded: runs inside the actor loop) ----
 
-    def _process_headers(self, p: Peer, headers: list[BlockHeader]) -> None:
+    async def _process_headers(self, p: Peer, headers: list[BlockHeader]) -> None:
         """Validate/persist one batch (reference ``processHeaders``
-        Chain.hs:323-350 + ``importHeaders`` Chain.hs:496-520)."""
+        Chain.hs:323-350 + ``importHeaders`` Chain.hs:496-520).  The
+        persist is awaited DURABLE before any downstream signal (events,
+        the continuation ``getheaders``): an acked import survives a crash.
+        The await runs on the group-commit writer thread for stores that
+        have one, so the actor loop is never inside an fsync; the mailbox
+        simply queues the next messages until the commit lands (the actor
+        is single-threaded, so no state can interleave mid-import)."""
         prev_best = self.db.get_best()
         with span("chain.import_headers"):
             try:
@@ -267,7 +294,9 @@ class Chain:
                 # here too would double-count the incident
                 p.kill(PeerSentBadHeaders(str(e)))
                 return
-            self.db.put_headers(nodes, best if best.hash != prev_best.hash else None)
+            await self.db.put_headers_durable(
+                nodes, best if best.hash != prev_best.hash else None
+            )
         metrics.inc("chain.headers", len(nodes))
         if nodes:
             log.debug(

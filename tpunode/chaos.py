@@ -18,6 +18,17 @@ point                     actions
 ``mailbox.send``          ``delay`` (deliver after ``dur``), ``reorder``
                           (jump the queue head)
 ``store.write``           ``error`` (raise ChaosFault from the write)
+``store.append``          ``error``, ``torn_write`` (write a prefix of the
+                          record blob, then hard-exit — a torn page),
+                          ``bit_flip`` (flip one bit in the blob before it
+                          hits disk; the process continues — simulated
+                          media corruption the CRC must catch on reopen),
+                          ``crash`` (``os._exit(CRASH_EXIT)`` at the
+                          injection point, before the write)
+``store.rotate``          ``error``, ``crash`` (at segment-rotation steps)
+``store.compact``         ``error``, ``crash`` (at compaction sub-steps;
+                          ``match`` selects the window: ``snapshot``,
+                          ``pre_replace``, ``post_replace``, ``cleanup``)
 ``engine.dispatch``       ``error`` (batch failure), ``device_loss``
                           (raise ChaosDeviceLoss — the breaker's signal)
 ``engine.warmup``         ``error`` (device warmup/compile failure)
@@ -63,6 +74,7 @@ from .events import events
 from .metrics import metrics
 
 __all__ = [
+    "CRASH_EXIT",
     "POINTS",
     "ChaosDeviceLoss",
     "ChaosFault",
@@ -70,6 +82,11 @@ __all__ = [
     "FaultSpec",
     "chaos",
 ]
+
+#: Exit status of an injected ``crash``/``torn_write`` hard-exit: the
+#: kill-torture harness (tpunode/torture.py) asserts on it to tell an
+#: injected death apart from an ordinary child failure.
+CRASH_EXIT = 86
 
 log = logging.getLogger("tpunode.chaos")
 
@@ -90,6 +107,9 @@ POINTS: dict[str, tuple[str, ...]] = {
     "peer.send": ("drop", "stall", "garbage"),
     "mailbox.send": ("delay", "reorder"),
     "store.write": ("error",),
+    "store.append": ("error", "torn_write", "bit_flip", "crash"),
+    "store.rotate": ("error", "crash"),
+    "store.compact": ("error", "crash"),
     "engine.dispatch": ("error", "device_loss"),
     "engine.warmup": ("error",),
 }
@@ -304,6 +324,41 @@ class Chaos:
         with self._lock:
             rng = self._rng or random.Random(0)
             return rng.randbytes(n)
+
+    def maybe_crash(self, point: str, label: str = "") -> None:
+        """Structural storage point (rotate/compact sub-steps): ``crash``
+        hard-exits the process at the injection point; ``error`` raises
+        ChaosFault; no-op when nothing fires."""
+        spec = self.decide(point, label)
+        if spec is None:
+            return
+        if spec.action == "crash":
+            self.hard_exit()
+        raise ChaosFault(f"chaos[{spec.describe()}] at {label or point}")
+
+    def mutate_blob(self, spec: FaultSpec, blob: bytes) -> bytes:
+        """Apply a physical-write fault to ``blob``: ``bit_flip`` flips one
+        deterministic bit, ``torn_write`` keeps a deterministic strict
+        prefix (the caller writes it, then hard-exits).  Draws come from
+        the plan RNG so the damage is part of the reproducible seed."""
+        if not blob:
+            return blob
+        with self._lock:
+            rng = self._rng or random.Random(0)
+            if spec.action == "bit_flip":
+                mutated = bytearray(blob)
+                mutated[rng.randrange(len(blob))] ^= 1 << rng.randrange(8)
+                return bytes(mutated)
+            if spec.action == "torn_write":
+                return blob[: rng.randrange(1, len(blob))] if len(blob) > 1 else b""
+        return blob
+
+    @staticmethod
+    def hard_exit() -> None:
+        """Die like ``kill -9``: no atexit, no finally blocks, no buffer
+        flushing beyond what the caller already forced.  The distinctive
+        status lets the torture harness assert the death was injected."""
+        os._exit(CRASH_EXIT)
 
     # -- transport wrapper ---------------------------------------------------
 

@@ -1,11 +1,16 @@
 """ctypes binding to the native C++ KV store (native/kvstore).
 
 Implements the same :class:`tpunode.store.KVStore` protocol as the Python
-engines; ``open_store(path)`` prefers this engine when the shared library
-builds.  The on-disk format is shared with :class:`tpunode.store.LogKV`,
-so either engine can open a store written by the other (the reference's
-analogous component is RocksDB behind rocksdb-haskell-jprupp,
-package.yaml:32-33).
+engines; ``open_store(path)`` uses this engine for **existing v1 logs**
+when the shared library builds.  The on-disk format is the legacy v1
+single-file log (the reference's analogous component is RocksDB behind
+rocksdb-haskell-jprupp, package.yaml:32-33); the Python ``LogKV`` now
+writes the crash-consistent v2 segmented format (ISSUE 9), which its v2
+reader can mix with v1 but this engine cannot — ``NativeKV`` is
+version-gated and raises :class:`tpunode.store.StoreVersionError` on a
+v2 directory instead of silently serving a stale subset.  A v1 log
+written here replays bit-identically under the v2 reader (pinned by
+tests/test_store.py).
 """
 
 from __future__ import annotations
@@ -19,7 +24,7 @@ import time
 from typing import Iterator, Optional, Sequence
 
 from .metrics import metrics
-from .store import BatchOp, delete_op, put_op
+from .store import BatchOp, StoreVersionError, delete_op, put_op, v2_artifacts
 
 __all__ = ["NativeKV", "load_kvstore_lib", "ensure_native_lib"]
 
@@ -131,6 +136,16 @@ class NativeKV:
         self.path = path
         self.fsync = fsync
         self._read_tick = 0
+        self._h = None  # __del__ must survive a version-gate refusal
+        # Version gate (ISSUE 9): the C++ engine speaks the v1 single-file
+        # format only.  Opening a directory holding v2 artifacts (CRC'd
+        # segments / a v2 snapshot base) would silently serve a stale
+        # subset of the data — refuse loudly instead of mixing engines.
+        if v2_artifacts(path):
+            raise StoreVersionError(
+                f"{path}: log format v2 (segments/snapshot present); the "
+                "native engine reads v1 only — open with the LogKV engine"
+            )
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._lib = load_kvstore_lib()
         self._h = self._lib.kv_open(path.encode())

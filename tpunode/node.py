@@ -64,7 +64,8 @@ from .peer import (
     WithConnection,
 )
 from .peermgr import PeerMgr, PeerMgrConfig, SockAddr
-from .store import KVStore
+from .store import KVStore, Namespaced
+from .utxo import UTXO_NAMESPACE, UtxoStore
 from .wire import (
     InvType,
     MsgAddr,
@@ -197,6 +198,14 @@ class NodeConfig:
     prevout_lookup: Optional[
         Callable[[bytes, int], "Optional[int | tuple[int, bytes]]"]
     ] = None
+    # persistent UTXO store (tpunode/utxo.py, ISSUE 9 / ROADMAP item 5):
+    # when True the node maintains a durable UTXO set over a namespaced
+    # view of ``store`` — block connect applies spends/creates + a
+    # block-height watermark in ONE atomic write_batch (idempotent
+    # crash-replay), the set serves the prevout oracle between the
+    # mempool and ``prevout_lookup``, and blocks at or below the
+    # watermark skip re-verification entirely on restart.
+    utxo: bool = False
 
     def __post_init__(self):
         if self.connect is None:
@@ -254,6 +263,21 @@ class Node:
         self.verify_engine: Optional[VerifyEngine] = (
             VerifyEngine(cfg.verify) if cfg.verify is not None else None
         )
+        # persistent UTXO set over the main store (NodeConfig.utxo); the
+        # watermark survives restarts, so it must be read before ingest
+        self.utxo: Optional[UtxoStore] = (
+            UtxoStore(Namespaced(cfg.store, UTXO_NAMESPACE))
+            if cfg.utxo
+            else None
+        )
+        # block connects serialize here: applies are atomic per block, but
+        # the watermark check-then-apply across concurrent ingest tasks
+        # must not interleave
+        self._utxo_lock = asyncio.Lock()
+        # out-of-order completions parked until their predecessor lands
+        # (concurrent block verification finishes in any order); bounded —
+        # beyond the cap a block is dropped and re-delivery heals
+        self._utxo_pending: dict[int, object] = {}
         self.mempool: Optional[Mempool] = (
             Mempool(
                 cfg.mempool,
@@ -337,6 +361,8 @@ class Node:
         )
         if self.verify_engine is not None:
             await self._stack.enter_async_context(self.verify_engine)
+        if self.verify_engine is not None or self.utxo is not None:
+            # utxo-only nodes still spawn supervised block-connect tasks
             await self._stack.enter_async_context(self._verify_tasks)
         if self.mempool is not None:
             await self._stack.enter_async_context(self.mempool)
@@ -418,6 +444,8 @@ class Node:
         if self.mempool is not None:
             extra["mempool_size"] = self.mempool.size()
             extra["mempool_orphans"] = self.mempool.orphan_count()
+        if self.utxo is not None:
+            extra["utxo_height"] = self.utxo.height
         return extra
 
     def _uptime(self) -> float:
@@ -456,6 +484,11 @@ class Node:
                 self.verify_engine.breaker_state
                 if self.verify_engine is not None
                 else None
+            ),
+            # persistent UTXO watermark (ISSUE 9): the height below which
+            # a restart resumes without re-verifying anything
+            "utxo_height": (
+                self.utxo.height if self.utxo is not None else None
             ),
         }
 
@@ -516,6 +549,11 @@ class Node:
                 if self.mempool is not None
                 else {"enabled": False}
             ),
+            "utxo": (
+                self.utxo.stats()
+                if self.utxo is not None
+                else {"enabled": False}
+            ),
             "events": events.counts(),
         }
 
@@ -563,24 +601,171 @@ class Node:
         )
 
     def _prevout_oracle(self):
-        """The prevout lookup the verify paths consult: the mempool's
-        unconfirmed outputs FIRST (a child spending an in-mempool parent
-        extracts with full prevout data), then the embedder's
-        ``cfg.prevout_lookup``.  None when neither exists."""
-        if self.mempool is None:
-            return self.cfg.prevout_lookup
-        if self.cfg.prevout_lookup is None:
-            # empty mempool + no embedder oracle: every lookup would
-            # miss — None lets block ingest skip the whole
-            # scan_prevouts + per-input lookup pass (hot path)
-            return self.mempool.lookup_prevout if self.mempool.size() else None
-        mp, ext = self.mempool.lookup_prevout, self.cfg.prevout_lookup
+        """The prevout lookup the verify paths consult, in precedence
+        order: the mempool's unconfirmed outputs (a child spending an
+        in-mempool parent extracts with full prevout data), then the
+        persistent UTXO store's confirmed outputs (ISSUE 9), then the
+        embedder's ``cfg.prevout_lookup``.  None when nothing can answer
+        — block ingest then skips the whole scan_prevouts + per-input
+        lookup pass (hot path)."""
+        sources = []
+        if self.mempool is not None and self.mempool.size():
+            # an empty mempool misses every lookup: skip it entirely
+            sources.append(self.mempool.lookup_prevout)
+        if self.utxo is not None:
+            sources.append(self.utxo.lookup)
+        if self.cfg.prevout_lookup is not None:
+            sources.append(self.cfg.prevout_lookup)
+        if not sources:
+            return None
+        if len(sources) == 1:
+            return sources[0]
 
         def combined(txid: bytes, vout: int):
-            res = mp(txid, vout)
-            return res if res is not None else ext(txid, vout)
+            for lookup in sources:
+                res = lookup(txid, vout)
+                if res is not None:
+                    return res
+            return None
 
         return combined
+
+    # -- persistent UTXO block connect (ISSUE 9) ----------------------------
+
+    def _persisted_height(self, block) -> Optional[int]:
+        """Height of ``block`` if it is already covered by the UTXO
+        watermark (fully verified + applied before a restart), else None.
+        Height alone is NOT enough after a reorg: the delivered block
+        must BE the watermark branch's block at that height (ancestor
+        hash check) — a new-branch block at an old height was never
+        verified and must not be skipped (review pin)."""
+        if self.utxo is None:
+            return None
+        bn = self.chain.get_block(block.header.hash)
+        if bn is None or bn.height > self.utxo.height:
+            return None
+        if self.utxo.block_hash is not None:
+            wm = self.chain.get_block(self.utxo.block_hash)
+            if wm is None:
+                return None  # watermark block unknown here: re-verify
+            anc = self.chain.get_ancestor(bn.height, wm)
+            if anc is None or anc.hash != bn.hash:
+                return None  # different branch: not covered
+        return bn.height
+
+    def _connect_block_utxo(self, block) -> None:
+        """Schedule the persistent UTXO connect for an ingested block
+        (supervised; ordering enforced by ``_utxo_lock``)."""
+        if self.utxo is None:
+            return
+        self._verify_tasks.add_child(
+            self._apply_block_utxo(block), name="utxo-connect"
+        )
+
+    async def _apply_block_utxo(self, block) -> None:
+        """Apply one block's spends/creates + watermark atomically.  The
+        tx parse and the store write both run off-loop; failures are loud
+        (``utxo.error``) but never kill ingest — the UTXO set degrades to
+        a stale oracle, not a crashed node."""
+        bn = self.chain.get_block(block.header.hash)
+        if bn is None:
+            # headers-first sync means this is rare: a block whose header
+            # the chain has not accepted cannot be assigned a height
+            metrics.inc("utxo.no_header")
+            return
+        assert self.utxo is not None
+        async with self._utxo_lock:
+            if bn.height <= self.utxo.height:
+                metrics.inc("utxo.skipped")
+                return
+            # CONTIGUOUS connects only: applying height N+2 over a
+            # watermark of N would silently drop N+1's whole delta (its
+            # later re-delivery lands below the watermark and is skipped
+            # forever).  Concurrent verification completes in any order,
+            # so an early arrival PARKS (bounded) until its predecessor
+            # lands; past the cap it is dropped — re-delivery heals.
+            expected = max(self.utxo.height + 1, 1)
+            if bn.height < expected:
+                # below the first applicable height (a delivered genesis
+                # block on a fresh store): nothing to park for — the
+                # drain loop could never reach it
+                metrics.inc("utxo.skipped")
+                return
+            if bn.height > expected:
+                if len(self._utxo_pending) < self.MAX_UTXO_PENDING:
+                    self._utxo_pending[bn.height] = block
+                    metrics.inc("utxo.deferred")
+                else:
+                    metrics.inc("utxo.out_of_order")
+                    events.emit(
+                        "utxo.out_of_order", height=bn.height,
+                        watermark=self.utxo.height,
+                    )
+                return
+            await self._utxo_apply_one(bn.height, block)
+            # drain parked successors now contiguous with the watermark
+            while True:
+                nxt = self._utxo_pending.pop(self.utxo.height + 1, None)
+                if nxt is None:
+                    break
+                await self._utxo_apply_one(self.utxo.height + 1, nxt)
+
+    # Bound on parked out-of-order block connects (blocks are held alive
+    # while parked; MAX_VERIFY_PENDING already bounds how many can be in
+    # flight at once, this is belt-and-braces above it).
+    MAX_UTXO_PENDING = 128
+
+    async def _utxo_apply_one(self, height: int, block) -> None:
+        """One atomic connect (caller holds ``_utxo_lock`` and guarantees
+        ``height`` is the first applicable one, ``max(watermark+1, 1)``);
+        parse + write both off-loop.
+
+        HASH-chain contiguity, not just height: after a reorg beneath the
+        watermark, the new branch's block at watermark+1 does not extend
+        the watermark block — applying it would stack the new branch's
+        deltas on the orphaned branch's UTXO state.  The set has no undo
+        log (ROADMAP), so it goes loudly STALE (``utxo.reorg_stale``)
+        and refuses further connects until the embedder rebuilds it
+        (delete the ``u/`` namespace and re-sync).
+
+        Note the watermark gates on the block's verdicts having been
+        *published*, not on every signature being valid: this node is a
+        verification service reporting verdicts, not a consensus
+        validator rejecting blocks (the reference has no script
+        validation at all, SURVEY.md §3.3) — gating on all-valid would
+        wedge the watermark forever on one hostile signature."""
+        assert self.utxo is not None
+        if (
+            self.utxo.block_hash is not None
+            and block.header.prev != self.utxo.block_hash
+        ):
+            metrics.inc("utxo.reorg_stale")
+            events.emit(
+                "utxo.reorg_stale", height=height,
+                watermark=self.utxo.height,
+            )
+            log.error(
+                "[Node] UTXO set is STALE: block %d does not extend the "
+                "watermark block (reorg beneath height %d); rebuild the "
+                "UTXO namespace to resume",
+                height, self.utxo.height,
+            )
+            return
+        try:
+            txs = await asyncio.to_thread(lambda: list(block.txs))
+            await asyncio.to_thread(
+                self.utxo.apply_block, height, block.header.hash, txs
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            metrics.inc("utxo.errors")
+            events.emit(
+                "utxo.error", height=height, error=str(e)[:300]
+            )
+            log.warning(
+                "[Node] utxo connect failed at height %d: %r", height, e
+            )
 
     def _count_unhandled(self, msg) -> None:
         """A peer message the event router has no handler for: count it
@@ -662,9 +847,17 @@ class Node:
                     # eviction rides the ingest path (txids are computed
                     # there, natively when possible).
                     self._submit_verify(p, block=msg.block)
-                elif self.mempool is not None and isinstance(msg, MsgBlock):
-                    # no verify engine: still evict confirmed txs
-                    self.mempool.block_connected(msg.block)
+                elif isinstance(msg, MsgBlock) and (
+                    self.mempool is not None or self.utxo is not None
+                ):
+                    # no verify engine: still evict confirmed txs and
+                    # connect the persistent UTXO set
+                    if self.mempool is not None:
+                        self.mempool.block_connected(msg.block)
+                    if self._persisted_height(msg.block) is None:
+                        self._connect_block_utxo(msg.block)
+                    else:
+                        metrics.inc("node.block_replay_skipped")
                 else:
                     self._count_unhandled(msg)
                 # every message refreshes liveness (reference Node.hs:173)
@@ -885,6 +1078,15 @@ class Node:
         native extractor builds on this box, extraction runs in C++
         straight from wire bytes (~13x the Python path; PERF.md) — the
         Python path remains the reference and the fallback."""
+        if block is not None and self._persisted_height(block) is not None:
+            # restart replay (ISSUE 9): this block is at or below the
+            # persistent UTXO watermark — it was fully verified AND its
+            # UTXO delta durably applied before a crash/restart, so
+            # re-delivery costs nothing: no extract, no engine batch,
+            # no re-apply.
+            metrics.inc("node.block_replay_skipped")
+            _discard_active_trace()
+            return
         n_txs = block.tx_count if block is not None else len(txs)
         if self._verify_pending >= self.MAX_VERIFY_PENDING:
             metrics.inc("node.verify_dropped", n_txs)
@@ -919,7 +1121,7 @@ class Node:
             if block is not None and self.mempool is not None:
                 # python-path block connect: txs parsed above anyway
                 self.mempool.confirmed([tx.txid for tx in txs])
-            coro = self._verify_txs(peer, txs)
+            coro = self._verify_txs(peer, txs, block=block)
         self._verify_tasks.add_child(coro, name="verify-txs")
 
     async def _verify_txs_native(
@@ -1029,6 +1231,13 @@ class Node:
                         TxVerdict(peer, items.txid(ti), all(vs), vs,
                                   items.stats(ti))
                     )
+            if block is not None:
+                # persistent UTXO connect only AFTER the block's verdicts
+                # are published: the watermark means "verified AND
+                # applied", so a crash mid-verify must leave the block
+                # unpersisted for its re-delivery to re-verify (extract/
+                # engine failure paths return before reaching here)
+                self._connect_block_utxo(block)
         finally:
             if region is not None:
                 region.close()
@@ -1037,11 +1246,12 @@ class Node:
             # the item's pipeline trace (if any) ends with its verdicts
             _finish_active_trace()
 
-    async def _verify_txs(self, peer, txs: list[Tx]) -> None:
+    async def _verify_txs(self, peer, txs: list[Tx], block=None) -> None:
         """Verify every tx of one message.  All txs' signatures are submitted
         to the engine CONCURRENTLY so a whole block coalesces into full
         device batches (awaiting per tx would degrade a 150k-sig block into
-        sequential tiny batches)."""
+        sequential tiny batches).  ``block``: the originating block, UTXO-
+        connected only after every verdict published without an error."""
         assert self.verify_engine is not None
         # Intra-block prevouts: a block message carries the funding tx for
         # every in-block spend — exactly what BIP143 (amount) and BIP341
@@ -1050,6 +1260,7 @@ class Node:
         block_outs = intra_block_prevouts(txs) if len(txs) > 1 else {}
         oracle = self._prevout_oracle()
         per_tx: list[tuple[Tx, ExtractStats, list, Optional[asyncio.Task]]] = []
+        clean = True  # no extract/engine error verdicts published
         try:
             with span("node.extract"):
                 for tx in txs:
@@ -1089,6 +1300,7 @@ class Node:
                             prevout_scripts=scripts or None,
                         )
                     except Exception as e:
+                        clean = False
                         self._verify_failure("extract", e)
                         try:
                             txid = tx.txid
@@ -1127,6 +1339,7 @@ class Node:
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
+                    clean = False
                     self._verify_failure("engine", e)
                     self._publish_verdict(
                         TxVerdict(peer, tx.txid, False, (), stats,
@@ -1140,6 +1353,12 @@ class Node:
                         TxVerdict(peer, tx.txid, all(per_sig), per_sig,
                                   stats)
                     )
+            if block is not None and clean:
+                # persistent UTXO connect only AFTER every verdict landed
+                # cleanly (mirrors the native path): the watermark means
+                # "verified AND applied" — an error-verdict block stays
+                # unpersisted so its re-delivery re-verifies
+                self._connect_block_utxo(block)
         finally:
             self._verify_pending -= 1
             for _, _, _, task in per_tx:

@@ -1,0 +1,426 @@
+"""Kill-torture harness: prove the storage layer's crash invariants
+(ISSUE 9).
+
+A *writer child* process runs a deterministic workload against a
+:class:`~tpunode.store.LogKV` (fsync on) + :class:`~tpunode.utxo.UtxoStore`
+and records every **acked** write — a write is acked only after
+``write_batch`` returned, i.e. after the fsync — to a sidecar ack log.
+A seeded chaos plan (``TPUNODE_CHAOS``) kills the child with
+``os._exit`` at one precise injection point (``store.append`` /
+``store.rotate`` / ``store.compact`` × ``after=N``), or damages the
+bytes in flight (``torn_write``, ``bit_flip``).  The parent then reopens
+the store and asserts the recovery invariants:
+
+* **acked ⇒ durable** — every acked write is present with its exact
+  value (crash mode; a ``bit_flip`` run simulates media corruption, the
+  one case where acked bytes may be legitimately lost — *detected and
+  quarantined*, below);
+* **no corrupt bytes as data** — every value the reopened store returns
+  parses and digest-validates; injected corruption must raise the
+  ``store.corruption`` count, never leak through ``get``;
+* **watermark monotone** — the UTXO watermark after reopen is at least
+  the last acked height and never moves backward across reopens;
+* a clean kill (no byte damage) must replay **silently**: a crash that
+  produces a ``store.corruption`` event is itself a violation (a torn
+  tail is not corruption).
+
+The sweep walks ``after=0,1,2,...`` per point until a child run
+completes without crashing (the point's hit space is exhausted), giving
+a dense set of *distinct* seeded kill points across the append, rotate
+and compact paths.  tests/test_store_recovery.py runs the acceptance
+sweep (≥200 kill points, slow tier) and a smoke subset in tier-1;
+``bench.py --recovery`` reports the pass-rate as a tracked number.
+
+Child entry point::
+
+    python -m tpunode.torture --child --dir D --ops N --seg-bytes B \
+        --compact-every C --seed S     # plan via TPUNODE_CHAOS
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import struct
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .chaos import CRASH_EXIT, chaos
+from .metrics import metrics
+from .store import LogKV, Namespaced, put_op
+from .utxo import UtxoStore
+
+__all__ = [
+    "CRASH_EXIT",
+    "TortureResult",
+    "child_workload",
+    "run_child",
+    "sweep",
+    "verify_dir",
+]
+
+_DATA_NS = b"d/"
+_UTXO_NS = b"u/"
+_ACK_FILE = "acks.log"
+_STORE_FILE = "kv.log"
+_DIGEST_LEN = 12
+_VER = struct.Struct("<I")
+
+
+# ---------------------------------------------------------------------------
+# deterministic workload values
+
+def make_value(key: bytes, ver: int) -> bytes:
+    """Self-validating value: version + keyed digest + deterministic pad.
+    Any byte damage that survives into a returned value fails
+    :func:`check_value` — 'never corrupt bytes as data' is checkable."""
+    d = hashlib.sha256(key + _VER.pack(ver)).digest()
+    pad = (ver * 7919 + len(key)) % 160
+    return _VER.pack(ver) + d[:_DIGEST_LEN] + (d * 6)[:pad]
+
+
+def check_value(key: bytes, raw: bytes) -> Optional[int]:
+    """The version ``raw`` encodes for ``key``, or None if it is not a
+    value this workload could ever have written (i.e. corrupt)."""
+    if len(raw) < _VER.size + _DIGEST_LEN:
+        return None
+    ver = _VER.unpack_from(raw)[0]
+    return ver if raw == make_value(key, ver) else None
+
+
+def _fake_txid(height: int) -> bytes:
+    return hashlib.sha256(b"blk" + _VER.pack(height)).digest()
+
+
+# ---------------------------------------------------------------------------
+# the writer child
+
+def child_workload(
+    dirpath: str,
+    ops: int = 60,
+    seg_bytes: int = 1600,
+    compact_every: int = 25,
+    seed: int = 1,
+) -> dict:
+    """The deterministic writer: puts/overwrites/deletes on a small key
+    set (dead bytes accrue → compaction is real), periodic explicit
+    compactions, and UTXO block applies with an advancing watermark.
+    Every completed (= fsynced) write is acked to the sidecar log BEFORE
+    the next operation, so the parent knows exactly what the store
+    promised.  Returns a summary dict (only reached when no fault
+    killed the process)."""
+    store = LogKV(
+        os.path.join(dirpath, _STORE_FILE),
+        fsync=True,
+        segment_bytes=seg_bytes,
+    )
+    utxo = UtxoStore(Namespaced(store, _UTXO_NS))
+    data = Namespaced(store, _DATA_NS)
+    ack_fd = os.open(
+        os.path.join(dirpath, _ACK_FILE),
+        os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+        0o644,
+    )
+
+    def ack(line: str) -> None:
+        # one write syscall per line: survives os._exit (page cache), and
+        # a torn final line is ignored by the parser
+        os.write(ack_fd, (line + "\n").encode())
+
+    rng = random.Random(seed)
+    versions: dict[bytes, int] = {}
+    height = utxo.height
+    acked = 0
+    for n in range(ops):
+        roll = rng.random()
+        if roll < 0.10 and versions:
+            key = rng.choice(sorted(versions))
+            ver = versions[key] + 1
+            versions[key] = ver
+            data.delete(key)
+            ack(f"D {key.decode()} {ver}")
+        elif roll < 0.25:
+            height += 1
+            txid = _fake_txid(height)
+            utxo.apply(
+                height,
+                txid,
+                spends=[(_fake_txid(height - 1), 0)] if height > 0 else [],
+                creates=[(txid, 0, 5000 + height, b"\x51" * 4)],
+            )
+            ack(f"W {height}")
+        else:
+            key = f"k{rng.randrange(12)}".encode()
+            ver = versions.get(key, 0) + 1
+            versions[key] = ver
+            data.put(key, make_value(key, ver))
+            ack(f"P {key.decode()} {ver}")
+        acked += 1
+        if compact_every and (n + 1) % compact_every == 0:
+            store.compact()
+            ack("C")
+    store.close()
+    os.close(ack_fd)
+    return {"acked": acked, "chaos": chaos.stats()["faults"]}
+
+
+def parse_acks(dirpath: str) -> dict:
+    """Parse the ack log (ignoring a torn final line): per-key last acked
+    (op, version), plus the last acked UTXO height."""
+    last: dict[bytes, tuple[str, int]] = {}
+    wm = -1
+    path = os.path.join(dirpath, _ACK_FILE)
+    if not os.path.exists(path):
+        return {"keys": last, "watermark": wm}
+    with open(path, "rb") as f:
+        raw = f.read()
+    for line in raw.split(b"\n")[:-1]:  # last element: torn or empty
+        parts = line.decode("latin-1").split()
+        if not parts:
+            continue
+        if parts[0] in ("P", "D") and len(parts) == 3:
+            last[parts[1].encode()] = (parts[0], int(parts[2]))
+        elif parts[0] == "W" and len(parts) == 2:
+            wm = int(parts[1])
+    return {"keys": last, "watermark": wm}
+
+
+# ---------------------------------------------------------------------------
+# the verifying parent
+
+def verify_dir(dirpath: str, mode: str = "crash") -> list[str]:
+    """Reopen the store and check every invariant; returns violations
+    (empty = pass).  ``mode='crash'`` (kill only, bytes intact) demands
+    acked ⇒ present and a silent replay; ``mode='bitflip'`` (simulated
+    media corruption) demands detection instead of presence."""
+    violations: list[str] = []
+    acks = parse_acks(dirpath)
+    corrupt0 = metrics.get("store.corruption")
+    try:
+        store = LogKV(os.path.join(dirpath, _STORE_FILE))
+    except Exception as e:  # a reopen that cannot complete is a violation
+        return [f"reopen failed: {type(e).__name__}: {e}"]
+    corrupt_delta = metrics.get("store.corruption") - corrupt0
+    try:
+        data = Namespaced(store, _DATA_NS)
+        utxo = UtxoStore(Namespaced(store, _UTXO_NS))
+        # 1) no corrupt bytes as data — every surviving value validates
+        for key, raw in data.scan_prefix(b"k"):
+            if check_value(key, raw) is None:
+                violations.append(f"corrupt value surfaced for {key!r}")
+        # 2) acked ⇒ durable (crash mode only: bit_flip may legitimately
+        #    lose acked records — but loudly, see 4)
+        if mode == "crash":
+            for key, (op, ver) in acks["keys"].items():
+                raw = data.get(key)
+                if raw is not None:
+                    got = check_value(key, raw)
+                    if got is None:
+                        violations.append(f"corrupt value for {key!r}")
+                        continue
+                if op == "P":
+                    if raw is None:
+                        violations.append(
+                            f"acked put lost: {key!r} v{ver}"
+                        )
+                    elif got < ver:
+                        violations.append(
+                            f"stale value for {key!r}: v{got} < acked v{ver}"
+                        )
+                elif op == "D" and raw is not None and got <= ver:
+                    violations.append(
+                        f"acked delete lost: {key!r} resurfaced v{got}"
+                    )
+            if corrupt_delta:
+                violations.append(
+                    "clean kill replayed as corruption "
+                    f"({int(corrupt_delta)} store.corruption events)"
+                )
+        # 3) watermark monotone and never behind the ack
+        if mode == "crash" and utxo.height < acks["watermark"]:
+            violations.append(
+                f"watermark {utxo.height} < acked {acks['watermark']}"
+            )
+        wm_first = utxo.height
+        store.close()
+        store = LogKV(os.path.join(dirpath, _STORE_FILE))
+        utxo2 = UtxoStore(Namespaced(store, _UTXO_NS))
+        if utxo2.height < wm_first:
+            violations.append(
+                f"watermark moved backward: {wm_first} -> {utxo2.height}"
+            )
+    finally:
+        store.close()
+    return violations
+
+
+@dataclass
+class TortureResult:
+    points: int = 0  # distinct seeded kill points that actually fired
+    completed: int = 0  # runs where the fault space was exhausted
+    corruption_detected: int = 0  # bit_flip runs caught by the CRC
+    violations: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def run_child(
+    dirpath: str,
+    plan: str,
+    *,
+    ops: int = 60,
+    seg_bytes: int = 1600,
+    compact_every: int = 25,
+    seed: int = 1,
+    timeout: float = 120.0,
+) -> "subprocess.CompletedProcess":
+    """One writer-child run under ``plan`` (a real subprocess: the kill is
+    a real process death, the reopen a real cold start)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["TPUNODE_CHAOS"] = plan
+    env.pop("TPUNODE_EVENTS", None)  # no event-sink files from children
+    return subprocess.run(
+        [
+            sys.executable, "-m", "tpunode.torture", "--child",
+            "--dir", dirpath, "--ops", str(ops),
+            "--seg-bytes", str(seg_bytes),
+            "--compact-every", str(compact_every), "--seed", str(seed),
+        ],
+        cwd=repo,
+        env=env,
+        capture_output=True,
+        timeout=timeout,
+    )
+
+
+def sweep(
+    base_dir: str,
+    *,
+    seeds=(1,),
+    points=("store.append", "store.rotate", "store.compact"),
+    max_after: int = 10_000,
+    ops: int = 60,
+    seg_bytes: int = 1600,
+    compact_every: int = 25,
+    budget_s: Optional[float] = None,
+    bit_flips: int = 2,
+) -> TortureResult:
+    """The full torture sweep: for every (seed, point), kill the child at
+    ``after=0,1,2,...`` until a run survives (fault space exhausted),
+    verifying the reopened store after EVERY run; then ``bit_flips``
+    byte-damage runs per seed that must be *detected*.  ``budget_s``
+    bounds wall clock (the bench worker's watchdog discipline) — the
+    result reports how far it got, never silently caps coverage."""
+    res = TortureResult()
+    t0 = time.monotonic()
+    run_i = 0
+
+    def out_of_budget() -> bool:
+        return budget_s is not None and time.monotonic() - t0 > budget_s
+
+    for seed in seeds:
+        # bit-flip detection FIRST: under a wall-clock budget, breadth of
+        # evidence (corruption is detected at all) beats depth of the
+        # kill-point walk — the walk reports how far it got either way
+        for i in range(bit_flips):
+            if out_of_budget():
+                return res
+            run_i += 1
+            d = os.path.join(base_dir, f"run{run_i:05d}")
+            os.makedirs(d, exist_ok=True)
+            # Early flip + NO compaction: the damaged segment must still
+            # be on disk at reopen (compaction would rewrite it from the
+            # intact in-memory index), and must be SEALED by later
+            # rotations — damage behind the active tail is always loud
+            # (the tail itself is the one spot physically indistinguishable
+            # from a torn write, which replay drops quietly by design).
+            after = max(1, ops // 6) + i * max(1, ops // 8)
+            plan = f"seed={seed};store.append:bit_flip:after={after},n=1"
+            proc = run_child(
+                d, plan, ops=ops, seg_bytes=seg_bytes,
+                compact_every=0, seed=seed,
+            )
+            if proc.returncode != 0:
+                res.violations.append(
+                    f"[{plan}] bit_flip child rc={proc.returncode}"
+                )
+                continue
+            fired = any(
+                f["fired"] for f in json.loads(proc.stdout)["chaos"]
+            )
+            c0 = metrics.get("store.corruption")
+            vs = verify_dir(d, "bitflip")
+            detected = metrics.get("store.corruption") - c0
+            res.violations.extend(f"[{plan}] {v}" for v in vs)
+            if fired and not detected:
+                res.violations.append(
+                    f"[{plan}] flipped bit NOT detected on reopen"
+                )
+            if fired and detected:
+                res.corruption_detected += 1
+        for point in points:
+            for after in range(max_after):
+                if out_of_budget():
+                    return res
+                run_i += 1
+                d = os.path.join(base_dir, f"run{run_i:05d}")
+                os.makedirs(d, exist_ok=True)
+                plan = f"seed={seed};{point}:crash:after={after}"
+                proc = run_child(
+                    d, plan, ops=ops, seg_bytes=seg_bytes,
+                    compact_every=compact_every, seed=seed,
+                )
+                if proc.returncode == CRASH_EXIT:
+                    res.points += 1
+                    res.violations.extend(
+                        f"[{plan}] {v}" for v in verify_dir(d, "crash")
+                    )
+                elif proc.returncode == 0:
+                    res.completed += 1
+                    res.violations.extend(
+                        f"[{plan}] {v}" for v in verify_dir(d, "crash")
+                    )
+                    break  # point exhausted for this seed
+                else:
+                    res.violations.append(
+                        f"[{plan}] child died rc={proc.returncode}: "
+                        f"{proc.stderr.decode(errors='replace')[-300:]}"
+                    )
+                    break
+    return res
+
+
+# ---------------------------------------------------------------------------
+# child entry point
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--child", action="store_true", required=True)
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--ops", type=int, default=60)
+    ap.add_argument("--seg-bytes", type=int, default=1600)
+    ap.add_argument("--compact-every", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=1)
+    args = ap.parse_args(argv)
+    summary = child_workload(
+        args.dir,
+        ops=args.ops,
+        seg_bytes=args.seg_bytes,
+        compact_every=args.compact_every,
+        seed=args.seed,
+    )
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""Persistent UTXO store behind the prevout-oracle seam (ISSUE 9 /
+ROADMAP item 5).
+
+The node's verify paths need prevout data — satoshi amount and
+scriptPubKey — for BIP143 (P2WPKH / BCH FORKID) and BIP341 (taproot)
+digests.  Intra-block spends resolve from the block itself and unconfirmed
+parents from the mempool; everything *confirmed* used to require the
+embedder's ``NodeConfig.prevout_lookup``.  :class:`UtxoStore` fills that
+gap with a durable UTXO set over any :class:`~tpunode.store.KVStore`
+(the node wires it over a ``Namespaced`` view of its main store, so one
+crash-consistent LogKV holds headers and UTXOs side by side).
+
+Crash consistency contract:
+
+* block connect applies every spend + create **and** the block-height
+  watermark in ONE atomic ``write_batch`` — a record-level-atomic log
+  (LogKV v2) therefore never persists half a block;
+* the watermark is monotone: :meth:`apply` refuses heights at or below it,
+  so a crash-then-replay of the same block stream is idempotent (the
+  re-delivered blocks are skipped, counted in ``utxo.skipped``);
+* lookups never see a partially-connected block: the in-memory index the
+  store serves reads from is only mutated by the same atomic batch.
+
+Schema (within the namespaced view): ``b"o" + txid + vout_le32`` ->
+``amount_le64 + scriptPubKey``; ``b"!wm"`` -> ``height_le64 + block_hash``.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, Optional, Sequence
+
+from .events import events
+from .metrics import metrics
+from .store import BatchOp, KVStore, delete_op, put_op
+
+__all__ = ["UtxoStore", "UTXO_NAMESPACE"]
+
+#: The namespace the node mounts the UTXO set under on its main store.
+UTXO_NAMESPACE = b"u/"
+
+_WM_KEY = b"!wm"
+_OUT_PREFIX = b"o"
+_AMOUNT = struct.Struct("<q")
+_WM = struct.Struct("<q")
+_ZERO_TXID = b"\x00" * 32
+
+
+def _okey(txid: bytes, vout: int) -> bytes:
+    return _OUT_PREFIX + txid + vout.to_bytes(4, "little")
+
+
+class UtxoStore:
+    """A persistent UTXO set + block-height watermark over a KV store."""
+
+    def __init__(self, kv: KVStore):
+        self._kv = kv
+        wm = kv.get(_WM_KEY)
+        if wm is None:
+            self._height, self._block_hash = -1, None
+        else:
+            self._height = _WM.unpack_from(wm)[0]
+            self._block_hash = wm[_WM.size :] or None
+        if self._height >= 0:
+            metrics.set_gauge("utxo.height", float(self._height))
+
+    # -- prevout oracle ------------------------------------------------------
+
+    @property
+    def height(self) -> int:
+        """The watermark: every block at or below this height is fully
+        applied (−1 = empty store)."""
+        return self._height
+
+    @property
+    def block_hash(self) -> Optional[bytes]:
+        return self._block_hash
+
+    def lookup(self, txid: bytes, vout: int) -> Optional[tuple[int, bytes]]:
+        """The prevout-oracle callable (``NodeConfig.prevout_lookup``
+        shape): ``(amount, scriptPubKey)`` or None when unspent output is
+        unknown/spent."""
+        raw = self._kv.get(_okey(txid, vout))
+        if raw is None:
+            return None
+        return _AMOUNT.unpack_from(raw)[0], raw[_AMOUNT.size :]
+
+    # -- block connect -------------------------------------------------------
+
+    def apply(
+        self,
+        height: int,
+        block_hash: bytes,
+        spends: Iterable[tuple[bytes, int]],
+        creates: Iterable[tuple[bytes, int, int, bytes]],
+    ) -> bool:
+        """Connect one block's UTXO delta atomically.
+
+        ``spends`` are ``(txid, vout)`` outpoints consumed; ``creates`` are
+        ``(txid, vout, amount, script)`` outputs born.  Everything lands in
+        ONE ``write_batch`` together with the advanced watermark, so the
+        store can never hold half a block.  Heights at or below the
+        watermark are refused (idempotent crash-replay); contiguity is
+        the CALLER's job — skipping a height would strand that block's
+        delta below the watermark forever (the node enforces
+        watermark+1-only connects, ``node._apply_block_utxo``).
+
+        Returns True when applied, False when skipped as already-persisted.
+        """
+        if height <= self._height:
+            metrics.inc("utxo.skipped")
+            return False
+        ops: list[BatchOp] = []
+        created = spent = 0
+        for txid, vout, amount, script in creates:
+            ops.append(
+                put_op(_okey(txid, vout), _AMOUNT.pack(amount) + script)
+            )
+            created += 1
+        for txid, vout in spends:
+            ops.append(delete_op(_okey(txid, vout)))
+            spent += 1
+        ops.append(put_op(_WM_KEY, _WM.pack(height) + block_hash))
+        self._kv.write_batch(ops)
+        self._height, self._block_hash = height, block_hash
+        metrics.set_gauge("utxo.height", float(height))
+        metrics.inc("utxo.applied")
+        metrics.inc("utxo.created", created)
+        metrics.inc("utxo.spent", spent)
+        return True
+
+    def apply_block(self, height: int, block_hash: bytes, txs: Sequence) -> bool:
+        """Connect a block from parsed tx objects (wire.Tx/LazyTx shape:
+        ``.txid``, ``.inputs[].prevout.{txid,index}``,
+        ``.outputs[].{value,script}``).  Creates are emitted before spends
+        *per the whole block*, and write_batch applies ops in order, so a
+        same-block child spending a parent's output nets out correctly."""
+        if height <= self._height:
+            metrics.inc("utxo.skipped")
+            return False
+        creates: list[tuple[bytes, int, int, bytes]] = []
+        spends: list[tuple[bytes, int]] = []
+        for tx in txs:
+            txid = tx.txid
+            for vout, out in enumerate(tx.outputs):
+                creates.append((txid, vout, out.value, out.script))
+            for txin in tx.inputs:
+                prev = txin.prevout
+                if prev.txid == _ZERO_TXID:
+                    continue  # coinbase input spends nothing
+                spends.append((prev.txid, prev.index))
+        applied = self.apply(height, block_hash, spends, creates)
+        if applied:
+            events.emit(
+                "utxo.block", height=height, created=len(creates),
+                spent=len(spends),
+            )
+        return applied
+
+    def stats(self) -> dict:
+        return {
+            "enabled": True,
+            "height": self._height,
+            "applied": metrics.get("utxo.applied"),
+            "skipped": metrics.get("utxo.skipped"),
+            "created": metrics.get("utxo.created"),
+            "spent": metrics.get("utxo.spent"),
+        }
