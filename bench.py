@@ -109,6 +109,14 @@ T_KERNEL_AB_BIG = float(
 # compaction pause, and a bounded kill-torture sweep (real writer-child
 # subprocesses killed at seeded points).  jax never imported.
 T_RECOVERY = float(os.environ.get("TPUNODE_BENCH_RECOVERY_TIMEOUT", 180))
+# Streaming-pipeline A/B (ISSUE 10): the duplicate-heavy mempool
+# firehose against a full Node on the cpu proxy (native CPU verify
+# engine — the tunnel is never touched), run serial
+# (pipeline_depth=1, extract_workers=1) then pipelined (depth 2,
+# min(4, cpu) extract workers), plus an extraction-only worker scaling
+# curve.  jax is never imported (backend="cpu" loads only the native
+# verifier).
+T_PIPELINE = float(os.environ.get("TPUNODE_BENCH_PIPELINE_TIMEOUT", 240))
 # Total ceiling: probe (<=120s) + ladder (<=600s) + fallback (<=210s)
 # + mempool (<=150s) keeps the worst case ~18 min; r03's artifact
 # demonstrated the driver tolerating 810s, and the in-round watcher
@@ -794,6 +802,198 @@ def _worker_recovery() -> None:
         print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
 
 
+def _worker_pipeline() -> None:
+    """Streaming-pipeline A/B (ISSUE 10): e2e ingest throughput of the
+    duplicate-heavy mempool firehose through a full Node on the cpu
+    proxy, SERIAL (``pipeline_depth=1, extract_workers=1`` — the
+    pre-pipeline dispatch) vs PIPELINED (depth 2, pooled extraction).
+
+    The workload is signature-bound by construction (2-input signed txs,
+    every tx pushed twice so the mempool's dedup admission sees the
+    duplicate-heavy shape): the A/B isolates what the lane packer +
+    overlapped dispatch + parallel extraction buy on identical traffic.
+    Reports e2e sigs/s both ways, the speedup, mean lane occupancy
+    (pack efficiency) under saturation, host stage busy fractions
+    (extract/dispatch/commit span time over wall), and an
+    extraction-only worker scaling curve 1→4.  Prints one JSON line;
+    the parent watchdog bounds it.
+    """
+    import asyncio
+
+    n_txs = int(os.environ.get("TPUNODE_BENCH_PIPELINE_TXS", 2500))
+    try:
+        from benchmarks.txgen import gen_signed_txs
+        from tpunode import BCH_REGTEST, Node, NodeConfig, Publisher, TxVerdict
+        from tpunode.mempool import MempoolConfig
+        from tpunode.metrics import metrics
+        from tpunode.peer import PeerMessage
+        from tpunode.store import MemoryKV
+        from tpunode.verify.engine import VerifyConfig
+        from tpunode.wire import LazyTx, MsgTx
+
+        import tpunode.node as node_mod
+
+        if not node_mod._native_extract_available():
+            print(json.dumps(
+                {"ok": False, "error": "native extractor unavailable"}
+            ))
+            return
+        net = BCH_REGTEST
+        _progress(f"generating {n_txs} signed txs (2 inputs each)...")
+        signed = gen_signed_txs(n_txs, inputs_per_tx=2, seed=0x919E)
+        # wire form (LazyTx with raw bytes, exactly what MsgTx decodes
+        # to): the accumulator/native-extract fast path requires raw
+        txs = [LazyTx(t.serialize()) for t in signed]
+        n_sigs = sum(len(t.inputs) for t in signed)
+        unique = {t.txid for t in signed}
+
+        class _Pusher:  # minimal peer surface for the router/mempool
+            def __init__(self, label):
+                self.label = label
+
+            def kill(self, exc):  # pragma: no cover - healthy traffic
+                pass
+
+        async def run_once(depth: int, workers: int) -> dict:
+            metrics.reset()
+            pub = Publisher(name="bench-pipeline", maxsize=None)
+            cfg = NodeConfig(
+                net=net,
+                store=MemoryKV(),
+                pub=pub,
+                peers=[],  # traffic is injected directly on the router
+                discover=False,
+                verify=VerifyConfig(
+                    backend="cpu", max_wait=0.005, batch_size=256,
+                    pipeline_depth=depth,
+                ),
+                mempool=MempoolConfig(tick_interval=0.05),
+                extract_workers=workers,
+            )
+            p1, p2 = _Pusher("fire:1"), _Pusher("fire:2")
+            verdicts: set = set()
+            timed_out = False
+            async with pub.subscription() as events:
+                async with Node(cfg) as node:
+                    t0 = time.perf_counter()
+                    for t in txs:  # firehose + full duplicate push
+                        node._peer_pub.publish(
+                            PeerMessage(p1, MsgTx(t))
+                        )
+                        node._peer_pub.publish(
+                            PeerMessage(p2, MsgTx(t))
+                        )
+                    while unique - verdicts:
+                        try:
+                            ev = await asyncio.wait_for(
+                                events.receive(), 30.0
+                            )
+                        except asyncio.TimeoutError:
+                            timed_out = True
+                            break
+                        if isinstance(ev, TxVerdict):
+                            verdicts.add(ev.txid)
+                    dt = time.perf_counter() - t0
+            out = {
+                "pipeline_depth": depth,
+                "extract_workers": workers,
+                "verdicts": len(verdicts),
+                "wall_s": round(dt, 3),
+                "sigs_per_s": round(n_sigs / dt, 1) if dt else 0.0,
+                "dedup_hits": int(metrics.get("mempool.dedup_hits")),
+            }
+            pack = metrics.histogram("sched.pack_efficiency")
+            if pack is not None and pack.count:
+                out["lanes"] = pack.count
+                out["pack_efficiency_mean"] = round(pack.mean, 4)
+                out["lane_occupancy_p50"] = round(
+                    pack.quantile(0.5) or 0.0, 4
+                )
+            busy = {}
+            for stage, name in (
+                ("extract", "span.node.extract"),
+                ("dispatch", "span.verify.dispatch"),
+                ("commit", "span.node.commit"),
+            ):
+                h = metrics.histogram(name)
+                if h is not None and h.count and dt:
+                    busy[stage] = round(h.total / dt, 4)
+            out["stage_busy"] = busy
+            if timed_out:
+                out["error"] = (
+                    f"timed out with {len(unique - verdicts)} verdicts "
+                    "outstanding"
+                )
+            return out
+
+        def extract_scaling() -> dict:
+            """Extraction-only scaling curve: one shard per worker over
+            the same tx region, pure native extract (no engine)."""
+            from concurrent.futures import ThreadPoolExecutor
+
+            from tpunode.txextract import ParsedTxRegion
+
+            raws = [t.serialize() for t in txs]
+            curve: dict = {}
+            for w in (1, 2, 4):
+                shard_sz = (len(raws) + w - 1) // w
+                shards = [
+                    (b"".join(raws[i : i + shard_sz]),
+                     len(raws[i : i + shard_sz]))
+                    for i in range(0, len(raws), shard_sz)
+                ]
+
+                def one(shard):
+                    data, n = shard
+                    with ParsedTxRegion(data, n) as region:
+                        return region.extract(intra_amounts=False).count
+
+                best = None
+                with ThreadPoolExecutor(max_workers=w) as pool:
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        total = sum(pool.map(one, shards))
+                        dt = time.perf_counter() - t0
+                        assert total > 0
+                        best = dt if best is None else min(best, dt)
+                curve[str(w)] = round(len(raws) / best, 1)
+            return curve
+
+        async def run() -> dict:
+            import os as _os
+
+            workers = min(4, _os.cpu_count() or 1)
+            _progress("serial baseline (depth 1, 1 extract worker)...")
+            serial = await run_once(1, 1)
+            _progress(f"pipelined (depth 2, {workers} extract workers)...")
+            pipelined = await run_once(2, workers)
+            out = {
+                "ok": (
+                    "error" not in serial and "error" not in pipelined
+                ),
+                "proxy": "cpu-native",
+                "unique_txs": len(unique),
+                "sigs": n_sigs,
+                "serial": serial,
+                "pipelined": pipelined,
+            }
+            if serial.get("sigs_per_s") and pipelined.get("sigs_per_s"):
+                out["speedup"] = round(
+                    pipelined["sigs_per_s"] / serial["sigs_per_s"], 3
+                )
+            _progress("extract-worker scaling curve...")
+            out["extract_scaling_txs_per_s"] = extract_scaling()
+            for side in ("serial", "pipelined"):
+                if "error" in out[side]:
+                    out["error"] = f"{side}: {out[side]['error']}"
+                    break
+            return out
+
+        print(json.dumps(asyncio.run(run())))
+    except Exception as e:  # noqa: BLE001 — worker reports, parent decides
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
+
+
 def _worker_kernel_ab() -> None:
     """Kernel point-form A/B worker (ISSUE 8): projective vs affine XLA
     step time at one batch size on cpu-jax, in a bounded subprocess.
@@ -962,6 +1162,28 @@ def _recovery_section() -> dict:
     if not res.get("ok") and "error" in res:
         out = {"ok": False, "error": str(res["error"])[:300]}
         for k in ("replay", "compaction_pause_ms", "torture"):
+            if k in res:
+                out[k] = res[k]
+        return out
+    return res
+
+
+def _pipeline_section() -> dict:
+    """The BENCH JSON ``pipeline`` section (ISSUE 10): serial-vs-
+    pipelined e2e throughput A/B, pack efficiency (mean lane occupancy),
+    per-stage busy fractions and the extract-worker scaling curve, from
+    a bounded worker subprocess on the cpu proxy.  Always returns a
+    dict — a failed/timed-out scenario is labeled, never masked."""
+    res = _run_worker(
+        "--pipeline", T_PIPELINE,
+        # cpu proxy by construction: backend="cpu" never imports jax;
+        # the pin is belt-and-braces against future drift
+        {"JAX_PLATFORMS": "cpu"},
+    )
+    if not res.get("ok") and "error" in res:
+        out = {"ok": False, "error": str(res["error"])[:300]}
+        for k in ("serial", "pipelined", "speedup",
+                  "extract_scaling_txs_per_s"):
             if k in res:
                 out[k] = res[k]
         return out
@@ -1345,6 +1567,11 @@ def _main_locked() -> None:
     # fan-in scenario, so the trajectory tracks what the node does with
     # redundant gossip — not just raw kernel sigs/s.
     out["mempool"] = _mempool_section()
+    # Streaming-pipeline section (ISSUE 10): serial-vs-pipelined e2e
+    # sigs/s, pack efficiency, stage busy fractions and the
+    # extract-worker scaling curve on the cpu proxy — failure-labeled
+    # like the sections below so it never masks the headline.
+    out["pipeline"] = _pipeline_section()
     # Resilience section (ISSUE 7): failover/breaker behavior under a
     # seeded fault plan — verdict conservation, breaker open/close
     # transitions and recovery latency, failure-labeled like the
@@ -1384,5 +1611,7 @@ if __name__ == "__main__":
         _worker_recovery()
     elif "--kernel-ab" in sys.argv:
         _worker_kernel_ab()
+    elif "--pipeline" in sys.argv:
+        _worker_pipeline()
     else:
         main()

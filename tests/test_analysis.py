@@ -110,6 +110,12 @@ def pump(fut):
 def start(fut):
     threading.Thread(target=pump, args=(fut,)).start()
 """,
+    "pool-shutdown": """\
+from concurrent.futures import ThreadPoolExecutor
+
+def start():
+    return ThreadPoolExecutor(max_workers=2)
+""",
     "metric-name": """\
 from tpunode.metrics import metrics
 
@@ -170,6 +176,81 @@ def test_suppression_is_rule_specific():
 
 
 # --- rule-specific edges -----------------------------------------------------
+
+
+def test_pool_shutdown_with_block_is_fine():
+    """A pool created as a `with` target manages its own lifetime."""
+    assert analyze_source(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def run(fn):\n"
+        "    with ThreadPoolExecutor(2) as pool:\n"
+        "        return pool.submit(fn)\n"
+    ) == []
+
+
+def test_pool_shutdown_teardown_elsewhere_is_fine():
+    """A .shutdown() anywhere in the file is the shutdown path (the
+    file-scope heuristic, like thread-loop-affinity) — the Node pattern:
+    pool built in _start, shut down in __aexit__."""
+    assert analyze_source(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "class Owner:\n"
+        "    def start(self):\n"
+        "        self.pool = ThreadPoolExecutor(2)\n"
+        "    def stop(self):\n"
+        "        self.pool.shutdown(wait=False)\n"
+    ) == []
+
+
+def test_pool_shutdown_stored_then_with_is_fine():
+    """A pool stored first and entered later via `with pool:` is
+    context-managed — no finding (review edge)."""
+    assert analyze_source(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def run(fn):\n"
+        "    pool = ThreadPoolExecutor(2)\n"
+        "    with pool:\n"
+        "        return pool.submit(fn)\n"
+    ) == []
+
+
+def test_pool_shutdown_close_join_is_fine():
+    """multiprocessing's canonical close()+join() graceful teardown is a
+    shutdown path (review edge)."""
+    assert analyze_source(
+        "import multiprocessing\n"
+        "def run():\n"
+        "    p = multiprocessing.Pool(4)\n"
+        "    p.close()\n"
+        "    p.join()\n"
+    ) == []
+
+
+def test_pool_shutdown_flags_multiprocessing_too():
+    findings = analyze_source(
+        "import multiprocessing\n"
+        "def start():\n"
+        "    return multiprocessing.Pool(4)\n"
+    )
+    assert [f.rule for f in findings] == ["pool-shutdown"]
+
+
+def test_pool_shutdown_unrelated_teardown_does_not_suppress():
+    """Review edge: an unrelated file.close(), a `with lock:` block, and
+    string .join(parts) plumbing must NOT count as the pool's shutdown
+    path — the rule would be near-vacuous otherwise."""
+    findings = analyze_source(
+        "import threading\n"
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "_lock = threading.Lock()\n"
+        "def start(path, parts):\n"
+        "    f = open(path)\n"
+        "    f.close()\n"
+        "    with _lock:\n"
+        "        s = ','.join(parts)\n"
+        "    return ThreadPoolExecutor(2)\n"
+    )
+    assert [f.rule for f in findings] == ["pool-shutdown"]
 
 
 def test_blocking_call_resolves_import_aliases():

@@ -56,6 +56,57 @@ def test_sharded_submesh():
     assert verify_batch_sharded(items, mesh=mesh) == expect
 
 
+@pytest.mark.slow  # a full XLA shard_map compile (~90s on this box): the
+# tier-1 870s budget is seed-saturated, so the mesh-rung parity evidence
+# lives in the slow tier (ran green this session; the cheap gating pins
+# are in test_sched.py)
+def test_dispatch_raw_sharded_matches_oracle():
+    """ISSUE 10: the engine's mesh rung — async raw-batch dispatch over
+    a mesh (dispatch_raw_sharded + collect_verdicts) is bit-identical to
+    the oracle, including the mesh-quantum padding of a ragged batch."""
+    from tpunode.verify.kernel import collect_verdicts
+    from tpunode.verify.multichip import dispatch_raw_sharded
+    from tpunode.verify.raw import pack_items
+
+    items, expect = make_items(22)  # NOT a multiple of the 8-wide mesh
+    raw = pack_items(items)
+    mesh = make_mesh()
+    got = collect_verdicts(*dispatch_raw_sharded(raw, mesh))
+    assert got == expect
+    # pad_to below the batch is ignored; above it aligns up
+    got2 = collect_verdicts(*dispatch_raw_sharded(raw, mesh, pad_to=64))
+    assert got2 == expect
+
+
+@pytest.mark.slow  # same budget discipline as the raw-sharded pin above
+def test_engine_mesh_rung_serves_packed_lanes():
+    """ISSUE 10 engine wiring: with mesh_devices set, the tpu rung
+    shards packed lanes over the CPU-mesh dryrun and verdicts match the
+    per-item expectations (device path simulated as in test_engine's
+    affine pin: state forced ready, cpu-jax IS the device)."""
+    import asyncio
+
+    from tpunode.verify.engine import VerifyConfig, VerifyEngine
+
+    items, expect = make_items(20)
+
+    async def run() -> list:
+        cfg = VerifyConfig(
+            backend="auto", batch_size=8, device_batch=8, min_tpu_batch=1,
+            max_wait=0.02, warmup=False, mesh_devices=4, pipeline_depth=2,
+        )
+        eng = VerifyEngine(cfg)
+        eng._device_state = "ready"  # cpu-jax is the device
+        async with eng:
+            f1 = asyncio.ensure_future(eng.verify(items[:11]))
+            f2 = asyncio.ensure_future(eng.verify(items[11:]))
+            g1, g2 = await asyncio.gather(f1, f2)
+        assert eng._mesh_state == "ready"
+        return g1 + g2
+
+    assert asyncio.run(run()) == expect
+
+
 def test_pallas_kernel_inside_shard_map_interpret():
     """Pin the Pallas-inside-shard_map path (VERDICT r3 item 7): the Mosaic
     kernel in interpret mode, small block, on a 2-shard CPU mesh — so the

@@ -1,0 +1,579 @@
+"""Streaming verify scheduler (ISSUE 10).
+
+Three tiers:
+
+* packer units — priority ordering (block > mempool > bulk), slicing
+  across submission boundaries, verdict-conservation bookkeeping on
+  Submission, the telemetry surface.
+* engine pipeline — verdict conservation through sliced/packed lanes at
+  ``pipeline_depth`` 1 and 2, priority ordering at dispatch, lane-failure
+  isolation, and the oldest-inflight watchdog contract
+  (``dispatch_inflight_seconds`` reports the OLDEST in-flight dispatch;
+  the watchdog stall signal keeps firing on it).
+* acceptance — the fakenet scenario: peers pushing interleaved blocks +
+  mempool txs through parallel extraction and the packed pipelined
+  dispatch, asserting verdict conservation, per-lane priority ordering,
+  a monotone UTXO watermark, and zero task leaks; plus the chaos
+  variant (device_loss mid-pipeline → ladder failover drains every
+  in-flight lane, breaker recovers).
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from tpunode.actors import Publisher, task_registry
+from tpunode.metrics import metrics
+from tpunode.verify.engine import VerifyConfig, VerifyEngine
+from tpunode.verify.sched import (
+    LanePacker,
+    PRIORITIES,
+    Submission,
+    slice_payload,
+)
+from tpunode.watchdog import Watchdog, WatchdogConfig
+
+from tests.test_engine import make_items
+
+
+def _sub(n: int, priority: str = "bulk", payload=None) -> Submission:
+    fut: asyncio.Future = asyncio.get_running_loop().create_future()
+    return Submission(
+        payload if payload is not None else list(range(n)), fut, None,
+        priority,
+    )
+
+
+# --- packer units ------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_packer_priority_ordering():
+    """Under saturation, block items claim lane space before mempool
+    before bulk, regardless of arrival order."""
+    p = LanePacker()
+    bulk = _sub(3, "bulk")
+    mem = _sub(2, "mempool")
+    blk = _sub(4, "block")
+    for s in (bulk, mem, blk):  # arrival order is worst-case
+        p.push(s)
+    lane = p.pop_lane(16)
+    assert [s.priority for s, _, _ in lane.slices] == [
+        "block", "mempool", "bulk"
+    ]
+    assert lane.total == 9 and p.pending() == 0
+
+
+@pytest.mark.asyncio
+async def test_packer_slices_across_submission_boundaries():
+    """Lanes are cut at exactly ``target`` items: one submission spans
+    lanes, several submissions share one."""
+    p = LanePacker()
+    a = _sub(3)
+    b = _sub(5)
+    p.push(a)
+    p.push(b)
+    assert p.pending() == 8
+    lane1 = p.pop_lane(4)
+    assert [(s is a, lo, hi) for s, lo, hi in lane1.slices] == [
+        (True, 0, 3), (False, 0, 1)
+    ]
+    assert lane1.total == 4 and lane1.occupancy == 1.0
+    assert p.pending() == 4
+    lane2 = p.pop_lane(4)
+    assert [(s is b, lo, hi) for s, lo, hi in lane2.slices] == [
+        (True, 1, 5)
+    ]
+    assert p.pop_lane(4) is None
+
+
+@pytest.mark.asyncio
+async def test_packer_depths_metrics_and_drain():
+    metrics.reset()
+    p = LanePacker()
+    p.push(_sub(5, "mempool"))
+    p.push(_sub(2, "block"))
+    assert p.depths() == {"block": 2, "mempool": 5, "bulk": 0}
+    assert p.batches() == 2
+    assert metrics.get(
+        "sched.queue_depth", labels={"priority": "mempool"}
+    ) == 5.0
+    lane = p.pop_lane(4)  # block(2) + mempool(2)
+    assert lane.total == 4
+    assert metrics.get("sched.lanes") == 1
+    assert metrics.get("sched.packed_submissions") == 2
+    h = metrics.histogram("sched.pack_efficiency")
+    assert h is not None and h.count == 1 and h.max == 1.0
+    drained = p.drain()
+    assert len(drained) == 1 and p.pending() == 0  # the residual mempool sub
+    assert metrics.get(
+        "sched.queue_depth", labels={"priority": "mempool"}
+    ) == 0.0
+
+
+@pytest.mark.asyncio
+async def test_submission_delivery_out_of_order_and_failure():
+    """Verdict conservation bookkeeping: slices land in any order, the
+    future resolves exactly once with per-item results; a lane failure
+    fails the whole submission and later deliveries are ignored."""
+    s = _sub(5)
+    s.deliver(3, [True, False])  # tail lane first
+    assert not s.fut.done()
+    s.deliver(0, [False, True, True])
+    assert await s.fut == [False, True, True, True, False]
+
+    f = _sub(4)
+    f.deliver(0, [True, True])
+    f.fail(RuntimeError("all rungs down"))
+    with pytest.raises(RuntimeError, match="all rungs down"):
+        await f.fut
+    f.deliver(2, [True, True])  # late slice of a failed submission: no-op
+    assert f.failed
+
+    with pytest.raises(ValueError, match="unknown priority"):
+        _sub(1, "urgent")
+
+
+@pytest.mark.asyncio
+async def test_packer_skips_failed_submission_remainder():
+    """Review pin: once a lane failure fails a submission's waiter, its
+    still-queued remainder is dropped at the next pop — whole device
+    lanes must not be burned on verdicts nobody can observe."""
+    p = LanePacker()
+    big = _sub(10)
+    tail = _sub(2)
+    p.push(big)
+    p.push(tail)
+    lane1 = p.pop_lane(4)  # claims big[0:4]
+    assert lane1.total == 4
+    big.fail(RuntimeError("lane down"))
+    with pytest.raises(RuntimeError):
+        await big.fut
+    lane2 = p.pop_lane(4)  # big's remaining 6 dropped, tail survives
+    assert [(s is tail, lo, hi) for s, lo, hi in lane2.slices] == [
+        (True, 0, 2)
+    ]
+    assert p.pending() == 0 and p.depths() == {
+        "block": 0, "mempool": 0, "bulk": 0
+    }
+
+
+def test_slice_payload_list_and_raw():
+    from tpunode.verify.raw import pack_items
+
+    items, _ = make_items(6)
+    assert slice_payload(items, 1, 4) == items[1:4]
+    assert slice_payload(items, 0, 6) is items  # whole payload: no copy
+    raw = pack_items(items)
+    part = slice_payload(raw, 2, 5)
+    assert len(part) == 3
+    assert part.to_tuples() == raw.to_tuples()[2:5]
+
+
+# --- engine pipeline ---------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_pipeline_verdict_conservation_across_lanes():
+    """Odd-sized submissions slice across batch_size-8 lanes with two in
+    flight: every waiter gets exactly its own items' verdicts."""
+    metrics.reset()
+    sizes = [3, 9, 1, 7, 5, 2]
+    batches = [make_items(n, tamper_every=3) for n in sizes]
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=8, max_wait=0.02, pipeline_depth=2,
+        )
+    ) as eng:
+        futs = [
+            asyncio.ensure_future(eng.verify(items))
+            for items, _ in batches
+        ]
+        got = await asyncio.gather(*futs)
+    for (items, expected), out in zip(batches, got):
+        assert out == expected
+    assert metrics.get("sched.lanes") >= 2  # really packed into lanes
+    assert metrics.get("verify.items") == sum(sizes)
+
+
+@pytest.mark.asyncio
+async def test_pipeline_depth_one_is_serial_and_identical():
+    """The A/B baseline: pipeline_depth=1 dispatches one lane at a time
+    and produces the same verdicts."""
+    items, expected = make_items(20, tamper_every=4)
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=8, max_wait=0.0, pipeline_depth=1,
+        )
+    ) as eng:
+        seen_conc = []
+        orig = eng._dispatch_multi
+
+        def spy(payloads, target=None):
+            seen_conc.append(eng.dispatch_inflight())
+            return orig(payloads, target)
+
+        eng._dispatch_multi = spy
+        assert await eng.verify(items) == expected
+    assert seen_conc and max(seen_conc) == 1
+
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        VerifyConfig(backend="cpu", warmup=False, pipeline_depth=0)
+
+
+@pytest.mark.asyncio
+async def test_block_priority_dispatches_before_bulk():
+    """A block submission enqueued AFTER a bulk one still leads the next
+    packed lane (the saturation ordering the acceptance test observes
+    end-to-end)."""
+    bulk_items, bulk_exp = make_items(2)
+    blk_items, blk_exp = make_items(3, tamper_every=2)
+    lanes: list = []
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=1024, max_wait=0.1, pipeline_depth=1,
+        )
+    ) as eng:
+        orig = eng._dispatch_multi
+
+        def spy(payloads, target=None):
+            lanes.append([len(p) for p in payloads])
+            return orig(payloads, target)
+
+        eng._dispatch_multi = spy
+        f1 = asyncio.ensure_future(eng.verify(bulk_items))  # bulk first
+        await asyncio.sleep(0)
+        f2 = asyncio.ensure_future(eng.verify(blk_items, priority="block"))
+        assert await f1 == bulk_exp
+        assert await f2 == blk_exp
+    # both lingered into ONE lane, block slice leading
+    assert lanes == [[3, 2]]
+
+
+@pytest.mark.asyncio
+async def test_lane_failure_fails_only_carried_submissions():
+    """A lane that fails on every rung fails exactly the submissions
+    holding slices in it; the pipeline keeps serving."""
+    a_items, _ = make_items(6)
+    b_items, b_exp = make_items(2, tamper_every=1)
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="oracle", batch_size=4, max_wait=0.01, pipeline_depth=1,
+        )
+    ) as eng:
+        calls = {"n": 0}
+        orig = eng._dispatch_multi
+
+        def flaky(payloads, target=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("all rungs down")
+            return orig(payloads, target)
+
+        eng._dispatch_multi = flaky
+        # A spans two lanes (4 + 2); the first fails -> A's waiter fails,
+        # the second delivers into the dead buffer without resurrecting it
+        with pytest.raises(RuntimeError, match="all rungs down"):
+            await eng.verify(a_items)
+        assert await eng.verify(b_items) == b_exp
+    assert calls["n"] >= 2
+
+
+@pytest.mark.asyncio
+async def test_oldest_inflight_drives_watchdog_stall(monkeypatch):
+    """ISSUE 10 watchdog satellite: with two lanes in flight the engine
+    reports the OLDEST dispatch age (a single scalar would have lost it
+    when the younger lane started), and the watchdog's dispatch-stall
+    signal fires on that age and clears when the pipeline drains."""
+    gate = threading.Event()
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=2, max_wait=0.0, pipeline_depth=2,
+        )
+    ) as eng:
+        orig = eng._dispatch_multi
+
+        def blocked(payloads, target=None):
+            gate.wait(10)
+            return orig(payloads, target)
+
+        eng._dispatch_multi = blocked
+        items1, exp1 = make_items(2)
+        items2, exp2 = make_items(2, tamper_every=1)
+        f1 = asyncio.ensure_future(eng.verify(items1))
+        t0 = time.monotonic()
+        while eng.dispatch_inflight() < 1:
+            await asyncio.sleep(0.005)
+        await asyncio.sleep(0.2)  # age the first dispatch
+        f2 = asyncio.ensure_future(eng.verify(items2))
+        while eng.dispatch_inflight() < 2:
+            await asyncio.sleep(0.005)
+        oldest = eng.dispatch_inflight_seconds()
+        assert oldest >= 0.2  # the FIRST dispatch's age, not the second's
+        assert eng.dispatch_inflight() == 2
+        wd = Watchdog(
+            WatchdogConfig(dispatch_stall_threshold=0.05), engine=eng
+        )
+        emitted = wd.check()
+        assert [e["kind"] for e in emitted] == ["verify_dispatch"]
+        assert emitted[0]["age_seconds"] >= 0.2
+        assert emitted[0]["inflight"] == 2
+        gate.set()
+        assert await f1 == exp1
+        assert await f2 == exp2
+        while eng.dispatch_inflight():
+            await asyncio.sleep(0.005)
+        assert eng.dispatch_inflight_seconds() == 0.0
+        assert wd.check() == []  # episode cleared
+        assert time.monotonic() - t0 < 10
+
+
+@pytest.mark.asyncio
+async def test_campaign_pool_clean_through_packed_path():
+    """ISSUE 10 acceptance: the adversarial campaign pool (valid +
+    mutated + degenerate ECDSA/Schnorr/BIP340 shapes) driven through the
+    packed pipelined dispatch as many odd-sized concurrent submissions
+    — every shape keeps its required verdict across the lane slicing."""
+    import random
+
+    from benchmarks.campaign import build_pool
+
+    items, shapes, expects = build_pool(24, random.Random(0xCA4))
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=64, max_wait=0.01, pipeline_depth=2,
+        )
+    ) as eng:
+        futs, k, i = [], 0, 0
+        sizes = [37, 53, 11, 97, 5]
+        while k < len(items):
+            n = sizes[i % len(sizes)]
+            i += 1
+            futs.append(asyncio.ensure_future(eng.verify(items[k : k + n])))
+            k += n
+        got = [v for f in futs for v in await f]
+    mism = [
+        (j, shapes[j])
+        for j, (g, e) in enumerate(zip(got, expects))
+        if g != e
+    ]
+    assert not mism, mism[:5]
+    assert metrics.get("sched.lanes") >= 2
+
+
+def test_engine_mesh_gating(monkeypatch):
+    """VerifyConfig.mesh_devices: off by default; a usable mesh is built
+    lazily (and only once); an unusable topology fails soft — the
+    single-chip rung keeps serving (the compile-parity pin for the
+    sharded program itself lives in test_multichip's heavy tier)."""
+    jax = pytest.importorskip("jax")
+
+    eng = VerifyEngine(VerifyConfig(backend="cpu", warmup=False))
+    assert eng._mesh() is None  # default: mesh dispatch off
+
+    eng2 = VerifyEngine(
+        VerifyConfig(backend="cpu", warmup=False, mesh_devices=2)
+    )
+    mesh = eng2._mesh()
+    assert mesh is not None and mesh.devices.size == 2
+    assert eng2._mesh() is mesh  # cached, not rebuilt
+
+    eng3 = VerifyEngine(
+        VerifyConfig(backend="cpu", warmup=False, mesh_devices=4)
+    )
+    devs = jax.devices()
+    monkeypatch.setattr(jax, "devices", lambda *a: devs[:1])
+    assert eng3._mesh() is None  # 1 visible device: soft-off
+    assert eng3._mesh_state == "failed"  # tried once, never again
+
+
+# --- acceptance: fakenet node through the full pipeline ----------------------
+
+
+def _lane_recorder(eng):
+    """Wrap the engine's packer to record each dispatched lane's slice
+    priorities (in lane order)."""
+    recorded: list[list[str]] = []
+    orig = eng._packer.pop_lane
+
+    def spy(target):
+        lane = orig(target)
+        if lane is not None:
+            recorded.append([s.priority for s, _, _ in lane.slices])
+        return lane
+
+    eng._packer.pop_lane = spy
+    return recorded
+
+
+@pytest.mark.asyncio
+async def test_streaming_pipeline_fakenet_acceptance():
+    """ISSUE 10 acceptance: peers pushing interleaved blocks + mempool
+    txs through parallel extraction and packed pipelined dispatch —
+    every unique tx exactly one clean verdict, per-lane priority
+    ordering holds, the UTXO watermark only ever advances, zero task
+    leaks."""
+    import tpunode.node as node_mod
+    from benchmarks.txgen import gen_signed_txs
+    from tests.fakenet import TxRelay, dummy_peer_connect, poll_until
+    from tests.fixtures import all_blocks
+    from tpunode import BCH_REGTEST, ChainSynced, Node, NodeConfig, TxVerdict
+    from tpunode.mempool import MempoolConfig
+    from tpunode.peer import PeerConnected, PeerMessage
+    from tpunode.store import MemoryKV
+    from tpunode.util import Reader
+    from tpunode.wire import Block, BlockHeader, MsgBlock
+
+    if not node_mod._native_extract_available():
+        pytest.skip("native extractor unavailable")
+    net = BCH_REGTEST
+    txs = gen_signed_txs(48, inputs_per_tx=1, seed=0x10AC)
+    blocks = all_blocks()
+    # a SIGNED block (wire-round-tripped so it carries raw bytes and
+    # takes the native extract path): its sig items ride block-priority
+    # lanes; the coinbase-only chain blocks drive the UTXO watermark
+    blk_txs = gen_signed_txs(24, inputs_per_tx=1, seed=0xB10C)
+    hdr = BlockHeader(1, b"\x00" * 32, b"\x00" * 32, 0, 0x207FFFFF, 0)
+    signed_block = Block.deserialize(
+        Reader(Block(hdr, tuple(blk_txs)).serialize())
+    )
+    assert signed_block.raw_txs is not None
+    unique = (
+        {t.txid for t in txs}
+        | {t.txid for t in blk_txs}
+        | {t.txid for b in blocks for t in b.txs}
+    )
+    relays = {
+        18801: TxRelay(txs, announce=True, mode="serve"),
+        18802: TxRelay(announce=False, push=txs),
+        18803: TxRelay(announce=False, push=txs),
+    }
+    pub = Publisher(name="pipeline-acceptance", maxsize=None)
+    cfg = NodeConfig(
+        net=net,
+        store=MemoryKV(),
+        pub=pub,
+        peers=[f"[::1]:{port}" for port in relays],
+        discover=False,
+        max_peers=len(relays),
+        connect=lambda sa: dummy_peer_connect(
+            net, blocks, relay=relays.get(sa[1])
+        ),
+        verify=VerifyConfig(
+            backend="cpu", max_wait=0.01, batch_size=64, pipeline_depth=2,
+        ),
+        mempool=MempoolConfig(tick_interval=0.05),
+        extract_workers=2,
+        utxo=True,
+    )
+    verdict_counts: dict = {}
+    watermarks: list[int] = []
+    async with pub.subscription() as sub:
+        async with Node(cfg) as node:
+            lanes = _lane_recorder(node.verify_engine)
+            async with asyncio.timeout(60):
+                peer = None
+                while True:
+                    ev = await sub.receive()
+                    if isinstance(ev, PeerConnected) and peer is None:
+                        peer = ev.peer
+                    if isinstance(ev, ChainSynced):
+                        break
+                assert peer is not None
+                # interleave block delivery with the ongoing tx firehose
+                for b in blocks:
+                    node._peer_pub.publish(PeerMessage(peer, MsgBlock(b)))
+                node._peer_pub.publish(
+                    PeerMessage(peer, MsgBlock(signed_block))
+                )
+                while unique - set(verdict_counts):
+                    ev = await sub.receive()
+                    watermarks.append(node.utxo.height)
+                    if isinstance(ev, TxVerdict):
+                        assert ev.error is None, f"faulted verdict: {ev}"
+                        verdict_counts[ev.txid] = (
+                            verdict_counts.get(ev.txid, 0) + 1
+                        )
+            # -- verdict conservation: exactly one verdict per unique tx
+            dupes = {k: v for k, v in verdict_counts.items() if v != 1}
+            assert not dupes, f"non-singular verdicts: {len(dupes)}"
+            # -- UTXO watermark monotone, and it caught up
+            assert watermarks == sorted(watermarks)
+            await poll_until(
+                lambda: node.utxo.height == len(blocks),
+                what="utxo watermark catch-up",
+            )
+            # -- parallel extraction actually engaged
+            assert node._extract_pool is not None
+            st = node.stats()["verify"]
+            assert st["extract_workers"] == 2
+            assert st["pipeline_depth"] == 2
+    # -- per-lane priority ordering: within every packed lane, block
+    # slices lead mempool slices lead bulk slices
+    assert lanes, "no lanes dispatched?"
+    rank = {p: i for i, p in enumerate(PRIORITIES)}
+    for lane in lanes:
+        order = [rank[p] for p in lane]
+        assert order == sorted(order), f"priority inversion in lane: {lane}"
+    assert any("block" in lane for lane in lanes)
+    assert any("mempool" in lane for lane in lanes)
+    # -- zero task leaks
+    assert task_registry.report_leaks() == []
+
+
+@pytest.mark.asyncio
+async def test_pipeline_chaos_device_loss_drains_inflight(monkeypatch):
+    """Chaos variant: device_loss faults landing mid-pipeline (two lanes
+    in flight) fail over down the ladder — every waiter gets verdicts,
+    the breaker opens on the repeated loss and recovers to ready once
+    the fault plan is exhausted."""
+    from tests.test_chaos import _fake_device
+    from tpunode.chaos import ChaosPlan, chaos
+
+    _fake_device(monkeypatch)
+    chaos.install(ChaosPlan.parse(
+        "seed=77;engine.dispatch:device_loss:match=tpu,after=1,n=3"
+    ))
+    try:
+        cfg = VerifyConfig(
+            backend="auto", max_wait=0.005, batch_size=16, device_batch=16,
+            min_tpu_batch=1, pipeline_depth=2, breaker_threshold=2,
+            breaker_cooldown=0.2,
+        )
+        async with VerifyEngine(cfg) as eng:
+            eng._warmup_done.wait(5)
+            assert eng.device_state == "ready"
+            batches = [make_items(6, tamper_every=3) for _ in range(10)]
+            # concurrent submissions keep both pipeline slots busy while
+            # the injected losses fire (60 items over 16-wide lanes = 4
+            # lanes through a depth-2 pipeline)
+            results = await asyncio.gather(
+                *(eng.verify(items) for items, _ in batches)
+            )
+            for (items, expected), got in zip(batches, results):
+                assert got == expected  # failover: verdicts, never faults
+            # keep concurrent traffic flowing until every injected loss
+            # fired and the breaker opened
+            deadline = time.monotonic() + 20.0
+            while eng.breaker.opens < 1 and time.monotonic() < deadline:
+                more = [make_items(6, tamper_every=2) for _ in range(4)]
+                got = await asyncio.gather(
+                    *(eng.verify(items) for items, _ in more)
+                )
+                for (items, expected), out in zip(more, got):
+                    assert out == expected
+            assert eng.breaker.opens >= 1, chaos.stats()
+            # keep traffic flowing until the canary closes the breaker
+            items, expected = make_items(4, tamper_every=2)
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                assert await eng.verify(items) == expected
+                if eng.breaker.state == "ready":
+                    break
+                await asyncio.sleep(0.02)
+            assert eng.breaker.state == "ready"
+            assert eng.dispatch_inflight() == 0  # nothing stranded
+    finally:
+        chaos.uninstall()

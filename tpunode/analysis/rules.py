@@ -365,6 +365,98 @@ def _thread_loop_affinity(ctx: FileContext) -> None:
                 )
 
 
+# --- pool-shutdown -----------------------------------------------------------
+
+# Worker-pool constructors whose threads/processes outlive their owner
+# unless someone shuts them down: a pool created per-request (or per
+# node restart) without a shutdown path leaks OS threads until the
+# process dies — invisible to the asyncio task-leak sweep, which only
+# sees loop tasks.  ISSUE 10's parallel-extraction pool is the in-tree
+# instance (Node.__aexit__ shuts it down).
+_POOL_QUALS = {
+    "concurrent.futures.ThreadPoolExecutor",
+    "concurrent.futures.ProcessPoolExecutor",
+    "multiprocessing.Pool",
+    "multiprocessing.pool.ThreadPool",
+}
+_POOL_ATTRS = {"ThreadPoolExecutor", "ProcessPoolExecutor", "ThreadPool"}
+
+
+def _is_pool_call(ctx: FileContext, call: ast.Call) -> bool:
+    qual = ctx.resolve(call.func)
+    return qual in _POOL_QUALS or (
+        qual is not None and qual.split(".")[-1] in _POOL_ATTRS
+    )
+
+
+@rule(
+    "pool-shutdown",
+    "executor/worker pool created without a shutdown path in this file: "
+    "its threads outlive the owner and leak per restart (call .shutdown()/"
+    ".terminate()/.close()+.join(), or create it in a `with` block)",
+)
+def _pool_shutdown(ctx: FileContext) -> None:
+    # A `with ThreadPoolExecutor(...) as p:` item manages its own
+    # lifetime; so does entering a STORED pool later (`pool = ...;
+    # with pool:`) — but only names actually assigned from a pool
+    # constructor count, or any `with lock:` in the file would
+    # suppress the rule (review finding: near-vacuous heuristics).
+    managed: set[int] = set()
+    pool_names: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ) and _is_pool_call(ctx, node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    pool_names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    pool_names.add(t.attr)  # self.pool = ...
+    with_pool_context = False
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call):
+                    managed.add(id(ce))
+                elif isinstance(ce, ast.Name) and ce.id in pool_names:
+                    with_pool_context = True
+                elif (
+                    isinstance(ce, ast.Attribute) and ce.attr in pool_names
+                ):
+                    with_pool_context = True
+    # File-scope teardown (like thread-loop-affinity's heuristic):
+    # .shutdown()/.terminate() anywhere; a bare .close() only counts
+    # alongside a .join() (multiprocessing's canonical close()+join() —
+    # an unrelated file.close() alone must not suppress the rule).
+    attrs = {
+        n.func.attr
+        for n in ast.walk(ctx.tree)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        # `sep.join(parts)` always takes a positional arg; a pool's
+        # join() never does — don't let string plumbing count
+        and (n.func.attr != "join" or not n.args)
+    }
+    has_shutdown = (
+        with_pool_context
+        or "shutdown" in attrs
+        or "terminate" in attrs
+        or ("close" in attrs and "join" in attrs)
+    )
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or id(node) in managed:
+            continue
+        if _is_pool_call(ctx, node) and not has_shutdown:
+            qual = ctx.resolve(node.func)
+            name = (qual or "").split(".")[-1] or "pool"
+            ctx.report(
+                "pool-shutdown", node,
+                f"{name}(...) created but this file never calls "
+                ".shutdown()/.terminate()/.close()+.join() (and it is "
+                "not a `with` target)",
+            )
+
+
 # --- metric-name / event-name ------------------------------------------------
 
 _METRIC_ATTRS = {"inc", "observe", "set_gauge"}
@@ -386,6 +478,8 @@ KNOWN_LAYERS = frozenset({
     "node",       # node composition/ingest (tpunode/node.py)
     "peer",       # wire sessions (tpunode/peer.py)
     "peermgr",    # fleet manager (tpunode/peermgr.py)
+    "sched",      # lane-packing verify scheduler (tpunode/verify/sched.py,
+                  # ISSUE 10; incl. the node-side extract ring gauges)
     "store",      # KV store (tpunode/store.py)
     "trace",      # tracing internals (tpunode/tracectx.py)
     "utxo",       # persistent UTXO store (tpunode/utxo.py, ISSUE 9)

@@ -16,8 +16,11 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import functools
 import logging
+import os
 import time as _time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -198,6 +201,13 @@ class NodeConfig:
     prevout_lookup: Optional[
         Callable[[bytes, int], "Optional[int | tuple[int, bytes]]"]
     ] = None
+    # Parallel host extraction (ISSUE 10 / ROADMAP item 5): how many
+    # worker threads shard native ``ParsedTxRegion`` construction +
+    # extraction over tx ranges.  0 = auto (``min(4, cpu_count)``);
+    # 1 = serial (the pre-pipeline behavior, the A/B baseline — also
+    # disables the extract→verify overlap ring).  The native extractor
+    # releases the GIL, so threads scale on real cores.
+    extract_workers: int = 0
     # persistent UTXO store (tpunode/utxo.py, ISSUE 9 / ROADMAP item 5):
     # when True the node maintains a durable UTXO set over a namespaced
     # view of ``store`` — block connect applies spends/creates + a
@@ -297,6 +307,16 @@ class Node:
         # mempool-tx batch accumulator (see _submit_verify_tx)
         self._tx_accum: list = []
         self._tx_drain: Optional[asyncio.Task] = None
+        # Parallel extraction (ISSUE 10): worker pool for native
+        # ParsedTxRegion construction/extraction (built in _start when
+        # >1 worker resolves; shut down in __aexit__), plus the bounded
+        # ring that lets extraction of drain batch K+1 overlap
+        # verification of K (sched.ring_occupancy gauge).
+        w = cfg.extract_workers
+        self._extract_workers = w if w > 0 else min(4, os.cpu_count() or 1)
+        self._extract_pool: Optional[ThreadPoolExecutor] = None
+        self._extract_ring = asyncio.Semaphore(self.EXTRACT_RING)
+        self._ring_busy = 0
         # shed-event aggregation (a flood must not also flood the bus),
         # keyed by peer: drops must be attributed to the peer that caused
         # them — an embedder doing per-peer DoS banning acts on this
@@ -361,6 +381,14 @@ class Node:
         )
         if self.verify_engine is not None:
             await self._stack.enter_async_context(self.verify_engine)
+            # Always a pool (1 worker = serial): close-ownership transfer
+            # (_run_extract_owned) needs the CONCURRENT future, which
+            # only executor.submit exposes — to_thread hides it behind a
+            # wrapper whose cancelled() lies about a still-running job.
+            self._extract_pool = ThreadPoolExecutor(
+                max_workers=self._extract_workers,
+                thread_name_prefix="extract",
+            )
         if self.verify_engine is not None or self.utxo is not None:
             # utxo-only nodes still spawn supervised block-connect tasks
             await self._stack.enter_async_context(self._verify_tasks)
@@ -415,6 +443,16 @@ class Node:
             try:
                 await self._stack.__aexit__(exc_type, exc, tb)
             finally:
+                if self._extract_pool is not None:
+                    # non-blocking: queued jobs are cancelled; a job
+                    # already RUNNING finishes on its daemonless thread
+                    # (it owns its region handle — _extract_and_close —
+                    # so nothing the loop side still references is freed
+                    # under it)
+                    self._extract_pool.shutdown(
+                        wait=False, cancel_futures=True
+                    )
+                    self._extract_pool = None
                 if self._attributor is not None:
                     self._attributor.stop()
                     self._attributor = None
@@ -531,6 +569,8 @@ class Node:
             verify.update(
                 pending_ingest=self._verify_pending,
                 accumulated_txs=len(self._tx_accum),
+                extract_workers=self._extract_workers,
+                ring_busy=self._ring_busy,
             )
         return {
             "uptime_seconds": self._uptime(),
@@ -960,21 +1000,142 @@ class Node:
                 self._drain_tx_accum(), name="verify-tx-drain"
             )
 
-    async def _drain_tx_accum(self) -> None:
-        """Drain the mempool accumulator in batches: one C++ extract over
-        the concatenated raw txs (``intra_amounts`` off — mempool txs are
-        independent, exactly like the old per-message path), one engine
-        batch, per-tx TxVerdicts.  A malformed tx poisons only itself: on
-        batch extract failure each tx retries individually
-        (:meth:`_verify_txs_native`), so one hostile peer cannot fail
-        other peers' verdicts."""
+    # Extract→verify overlap ring (ISSUE 10): how many drain batches may
+    # sit between extraction start and verdict publish at once.  2 =
+    # extraction of batch K+1 overlaps verification of K; the drain loop
+    # blocks when the ring is full, which backpressures into MAX_TX_ACCUM.
+    EXTRACT_RING = 2
+    # Minimum txs per extraction shard: below this the per-shard native
+    # call overhead beats the parallelism.
+    MIN_SHARD_TXS = 64
+
+    async def _run_extract(self, fn, *args, **kw):
+        """Run one native-extraction step off-loop: in the shared worker
+        pool when parallel extraction is on, via ``to_thread`` otherwise."""
+        if self._extract_pool is not None:
+            return await asyncio.get_running_loop().run_in_executor(
+                self._extract_pool, functools.partial(fn, *args, **kw)
+            )
+        return await asyncio.to_thread(fn, *args, **kw)
+
+    def _shard_batch(self, batch: list) -> list[list]:
+        """Split a drain batch into contiguous per-worker tx ranges
+        (mempool txs are independent: ``intra_amounts`` is off, so the
+        shards share nothing but the prevout oracle)."""
+        if self._extract_workers <= 1 or len(batch) < 2 * self.MIN_SHARD_TXS:
+            return [batch]
+        n = min(self._extract_workers, len(batch) // self.MIN_SHARD_TXS)
+        size = (len(batch) + n - 1) // n
+        return [batch[i : i + size] for i in range(0, len(batch), size)]
+
+    @staticmethod
+    def _begin_tx_spans(batch: list, name: str) -> list:
+        """Open one ``name`` span in EACH traced message's own trace
+        (ISSUE 10 trace satellite: the drain used to record batch spans
+        into the FIRST message's trace only)."""
+        recs = []
+        for _, _, _, act in batch:
+            if act is not None:
+                recs.append((act[0], act[0].begin(name, act[1])))
+        return recs
+
+    @staticmethod
+    def _end_tx_spans(recs: list) -> None:
+        for tr, rec in recs:
+            tr.end(rec)
+
+    @staticmethod
+    def _extract_and_close(region, **kw):
+        """Worker-thread tail of a shard extract: the thread that runs
+        the native extract also frees the handle.  Closing from the loop
+        side would race a cancelled-but-still-running extract (awaiting
+        an executor future stops WAITING on cancellation, it does not
+        stop the thread) — txx_parse_free under a live txx_extract_h2 is
+        a native use-after-free (review finding)."""
+        try:
+            return region.extract(**kw)
+        finally:
+            region.close()
+
+    async def _run_extract_owned(self, region, **kw):
+        """Submit the extract with close-ownership attached: the worker
+        thread closes the region when the job RUNS (`_extract_and_close`);
+        a job cancelled while still QUEUED (node teardown, pool
+        `cancel_futures`) never runs, so the done-callback closes it.
+
+        The callback MUST watch the CONCURRENT future: it reports
+        cancelled only when the cancel beat the job (no thread attached,
+        close is safe).  The asyncio wrapper would report cancelled even
+        while the job is still running (task cancellation cancels the
+        wrapper regardless of ``concurrent.Future.cancel()`` failing) —
+        closing on that signal is the very use-after-free this path
+        exists to avoid (review finding)."""
+        assert self._extract_pool is not None  # built with the engine
+        cfut = self._extract_pool.submit(
+            self._extract_and_close, region, **kw
+        )
+        cfut.add_done_callback(
+            lambda f: region.close() if f.cancelled() else None
+        )
+        return await asyncio.wrap_future(cfut)
+
+    async def _extract_shard(self, shard: list, bch: bool):
+        """One C++ extract over a contiguous run of accumulated txs
+        (``intra_amounts`` off — mempool txs are independent, exactly
+        like the old per-message path).  Returns RawSigItems, or None on
+        failure (the caller isolates the offender per tx)."""
         from .txextract import ParsedTxRegion
 
+        concat = b"".join(r for _, _, r, _ in shard)
+        region = None
+        submitted = False
+        try:
+            region = await self._run_extract(
+                ParsedTxRegion, concat, len(shard)
+            )
+            # oracle lookups stay on the loop thread (they read
+            # mempool/utxo state owned by it)
+            ext, ext_scripts = self._resolve_ext_rows(region, bch)
+            submitted = True  # from here the job owns close
+            return await self._run_extract_owned(
+                region,
+                bch=bch,
+                intra_amounts=False,
+                ext_amounts=ext,
+                ext_scripts=ext_scripts,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return None
+        finally:
+            if region is not None and not submitted:
+                region.close()
+
+    async def _ring_acquire(self) -> None:
+        await self._extract_ring.acquire()
+        self._ring_busy += 1
+        metrics.set_gauge("sched.ring_occupancy", float(self._ring_busy))
+
+    def _ring_release(self) -> None:
+        self._ring_busy -= 1
+        metrics.set_gauge("sched.ring_occupancy", float(self._ring_busy))
+        self._extract_ring.release()
+
+    async def _drain_tx_accum(self) -> None:
+        """Drain the mempool accumulator in batches: C++ extraction
+        sharded over the worker pool (``NodeConfig.extract_workers``
+        contiguous tx ranges in parallel), each shard one engine
+        submission (the lane packer re-bins them into full device lanes),
+        verdict publication through a bounded ring so extraction of
+        batch K+1 overlaps verification of K.  A malformed tx poisons
+        only itself: on shard extract failure each of its txs retries
+        individually (:meth:`_verify_txs_native`), so one hostile peer
+        cannot fail other peers' verdicts."""
         bch = self.cfg.net.bch
         # The drain task inherited the FIRST accumulated message's trace
-        # context at creation and outlives it by many batches: clear it so
-        # batch-level spans attach to the current batch's own trace below,
-        # never to a finished (already retained/exported) one.
+        # context at creation and outlives it by many batches: clear it —
+        # per-tx spans are recorded into each tx's OWN trace below.
         _clear_active_trace()
         # Bounded drain batches: one giant extract+verify would add seconds
         # of verdict latency under flood; ~2k txs keeps the engine fed in
@@ -983,75 +1144,106 @@ class Node:
         while self._tx_accum:
             batch = self._tx_accum[:DRAIN_BATCH]
             del self._tx_accum[:DRAIN_BATCH]
-            concat = b"".join(r for _, _, r, _ in batch)
-            # batch-level spans (extract, engine wait, commit) land in the
-            # first traced submitter's tree — that trace is part of THIS
-            # batch and still open (best-effort for the coalesced rest)
-            act0 = next((a for _, _, _, a in batch if a is not None), None)
-            with _activate_trace(act0):
-                try:
-                    with span("node.extract"):
-                        region = await asyncio.to_thread(
-                            ParsedTxRegion, concat, len(batch)
-                        )
-                        try:
-                            ext, ext_scripts = self._resolve_ext_rows(
-                                region, bch
-                            )
-                            items = await asyncio.to_thread(
-                                region.extract,
-                                bch=bch,
-                                intra_amounts=False,
-                                ext_amounts=ext,
-                                ext_scripts=ext_scripts,
-                            )
-                        finally:
-                            region.close()
-                except asyncio.CancelledError:
-                    raise
-                except Exception:
+            shards = self._shard_batch(batch)
+            # per-tx extract spans in each tx's own trace (they bound the
+            # whole sharded extraction: begin before, end when all shards
+            # land — exact per shard, conservative across shards)
+            recs = self._begin_tx_spans(batch, "node.extract")
+            try:
+                # span(): the metrics histogram (stage busy fractions in
+                # BENCH); the per-tx trace records are the recs above
+                with span("node.extract"):
+                    extracted = await asyncio.gather(
+                        *(self._extract_shard(s, bch) for s in shards)
+                    )
+            finally:
+                self._end_tx_spans(recs)
+            pairs = []
+            for shard, items in zip(shards, extracted):
+                if items is None:
                     # isolate the offender: each tx goes through the
                     # single-tx native path on its own (error verdicts +
                     # peer kill there; finishes each tx's trace too)
-                    for peer, tx, raw, act in batch:
+                    for peer, tx, raw, act in shard:
                         with _activate_trace(act):
                             await self._verify_txs_native(
                                 peer, raw, 1, txs=[tx], tracked=False
                             )
                     continue
-                metrics.inc("node.verify_txs", len(batch))
-                metrics.inc(
-                    "node.verify_inputs", int(items.tx_n_inputs.sum())
+                pairs.append((shard, items))
+            if not pairs:
+                continue
+            if self._extract_workers > 1:
+                # ring stage: ONE slot per drain batch (a slot per shard
+                # would let 2 of N shards stall the loop and shrink the
+                # K+1/K overlap to a fraction of a batch — review
+                # finding); all shards' verdicts publish in a supervised
+                # child while this loop extracts the next batch
+                await self._ring_acquire()
+                self._verify_tasks.add_child(
+                    self._commit_batch(pairs, ring=True),
+                    name="verify-drain-commit",
                 )
-                verdicts: list[bool] = []
-                if items.count:
-                    try:
-                        assert self.verify_engine is not None
-                        verdicts = await self.verify_engine.verify_raw(items)
-                    except asyncio.CancelledError:
-                        raise
-                    except Exception as e:
-                        self._verify_failure("engine", e)
-                        for ti, (peer, _, _, _) in enumerate(batch):
-                            self._publish_verdict(
-                                TxVerdict(peer, items.txid(ti), False, (),
-                                          items.stats(ti),
-                                          error=f"engine: {e}")
-                            )
-                        self._finish_batch_traces(batch)
-                        continue
-                with span("node.commit"):
-                    per_sig = items.combine(verdicts)
-                    sig_slices = items.sig_slices()
-                    for ti, (peer, _, _, _) in enumerate(batch):
-                        vs = tuple(per_sig[sig_slices[ti]])
+            else:
+                # serial A/B baseline: extract → verify → publish
+                await self._commit_batch(pairs, ring=False)
+
+    async def _commit_batch(self, pairs: list, ring: bool) -> None:
+        """Commit one drain batch's extracted shards: all shards submit
+        to the engine concurrently (the packer coalesces them into full
+        lanes) and the ring slot frees when the whole batch published."""
+        try:
+            await asyncio.gather(
+                *(self._commit_drained(shard, items)
+                  for shard, items in pairs)
+            )
+        finally:
+            if ring:
+                self._ring_release()
+
+    async def _commit_drained(self, shard: list, items) -> None:
+        """Await one extracted shard's verdicts and publish per-tx
+        TxVerdicts (each into its own trace)."""
+        act0 = next((a for _, _, _, a in shard if a is not None), None)
+        try:
+            metrics.inc("node.verify_txs", len(shard))
+            metrics.inc("node.verify_inputs", int(items.tx_n_inputs.sum()))
+            verdicts: list[bool] = []
+            if items.count:
+                try:
+                    assert self.verify_engine is not None
+                    # the verify.queue span lands in the first traced
+                    # submitter's tree (the packer's act0 convention)
+                    with _activate_trace(act0):
+                        verdicts = await self.verify_engine.verify_raw(
+                            items, priority="mempool"
+                        )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    self._verify_failure("engine", e)
+                    for ti, (peer, _, _, _) in enumerate(shard):
+                        self._publish_verdict(
+                            TxVerdict(peer, items.txid(ti), False, (),
+                                      items.stats(ti),
+                                      error=f"engine: {e}")
+                        )
+                    return
+            per_sig = items.combine(verdicts)
+            sig_slices = items.sig_slices()
+            for ti, (peer, _, _, act) in enumerate(shard):
+                vs = tuple(per_sig[sig_slices[ti]])
+                # per-tx commit span in the tx's OWN trace (ISSUE 10)
+                with _activate_trace(act):
+                    with span("node.commit"):
                         self._publish_verdict(
                             TxVerdict(peer, items.txid(ti), all(vs), vs,
                                       items.stats(ti))
                         )
-            # traces end AFTER the batch spans close, so a finished trace
-            # is never mutated (retention/export reads it immediately)
-            self._finish_batch_traces(batch)
+        finally:
+            # traces end AFTER the spans close, so a finished trace is
+            # never mutated (retention/export reads it immediately)
+            self._finish_batch_traces(shard)
 
     @staticmethod
     def _finish_batch_traces(batch) -> None:
@@ -1165,13 +1357,20 @@ class Node:
                 )
 
         region: Optional[ParsedTxRegion] = None
+        submitted = False  # once the extract job is in a worker thread,
+        # that thread owns region.close (see _extract_and_close)
         try:
             # ONE native parse feeds both the prevout listing and the
             # extraction (ParsedTxRegion; the amount-oracle path used to
             # parse the region twice more).
             with span("node.extract"):
                 try:
-                    region = await asyncio.to_thread(
+                    # shared worker pool (ISSUE 10): several blocks'
+                    # regions parse/extract in parallel (each block keeps
+                    # ONE region — the intra-block prevout map is
+                    # whole-region by construction, so tx-range sharding
+                    # applies to the independent mempool batches only)
+                    region = await self._run_extract(
                         ParsedTxRegion, raw, n_txs
                     )
                 except asyncio.CancelledError:
@@ -1187,8 +1386,9 @@ class Node:
                 # shadows whatever the oracle would have said).
                 ext, ext_scripts = self._resolve_ext_rows(region, bch)
                 try:
-                    items = await asyncio.to_thread(
-                        region.extract,
+                    submitted = True
+                    items = await self._run_extract_owned(
+                        region,
                         bch=bch,
                         intra_amounts=n_txs > 1,
                         ext_amounts=ext,
@@ -1211,7 +1411,11 @@ class Node:
             verdicts: list[bool] = []
             if items.count:
                 try:
-                    verdicts = await self.verify_engine.verify_raw(items)
+                    # block ingest outranks mempool relay in the packer
+                    verdicts = await self.verify_engine.verify_raw(
+                        items,
+                        priority="block" if block is not None else "mempool",
+                    )
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
@@ -1239,7 +1443,7 @@ class Node:
                 # engine failure paths return before reaching here)
                 self._connect_block_utxo(block)
         finally:
-            if region is not None:
+            if region is not None and not submitted:
                 region.close()
             if tracked:
                 self._verify_pending -= 1
@@ -1318,7 +1522,11 @@ class Node:
                     if items:
                         task = spawn_supervised(
                             self.verify_engine.verify(
-                                [i.verify_item for i in items]
+                                [i.verify_item for i in items],
+                                priority=(
+                                    "block" if block is not None
+                                    else "mempool"
+                                ),
                             ),
                             name="verify-sigbatch",
                             owner=self._verify_tasks,

@@ -36,17 +36,24 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from ..trace import span
 from .ecdsa_cpu import Point
 from .kernel import (
     ARG_IS_2D,
     kernel_modes,
     pallas_broken,
     prepare_batch,
+    prepare_batch_raw,
     verify_core,
     with_mosaic_fallback,
 )
 
-__all__ = ["make_mesh", "sharded_verify_fn", "verify_batch_sharded"]
+__all__ = [
+    "make_mesh",
+    "sharded_verify_fn",
+    "verify_batch_sharded",
+    "dispatch_raw_sharded",
+]
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -162,6 +169,55 @@ def sharded_verify_fn(
     return fn
 
 
+def _mesh_quantum(mesh: Mesh) -> int:
+    """Per-batch size quantum: Pallas shards need BLOCK-aligned per-shard
+    batches; the XLA program just needs a multiple of the mesh size."""
+    n = mesh.devices.size
+    if _mesh_is_tpu(mesh) and not pallas_broken():
+        from .pallas_kernel import BLOCK
+
+        return n * BLOCK
+    return n
+
+
+def dispatch_raw_sharded(
+    raw, mesh: Mesh, pad_to: Optional[int] = None, kernel: str = "auto"
+) -> tuple:
+    """ASYNC sharded dispatch of a packed RawBatch (ISSUE 10): host prep
+    at a mesh-aligned shape, per-chip ``device_put`` (the host→device
+    transfer is split per chip), sharded program enqueue.  Returns the
+    ``(ok device array, count)`` handle — collect with
+    :func:`kernel.collect_verdicts`; JAX async dispatch means the caller
+    can prep the next lane while this one computes, exactly like the
+    single-chip :func:`kernel.dispatch_batch_tpu_raw`.
+
+    This is the engine's mesh rung (``VerifyConfig.mesh_devices``): a
+    packed full lane shards across chips with zero inter-chip traffic in
+    the hot loop.  The CPU-mesh dryrun path (conftest's 8 virtual host
+    devices) pins it without TPU hardware; the device verdict is banked
+    by the watcher when a TPU window opens.
+    """
+    from .raw import as_raw_batch
+
+    raw = as_raw_batch(raw)
+    quantum = _mesh_quantum(mesh)
+    size = max(pad_to or 0, len(raw), 1)
+    size = (size + quantum - 1) // quantum * quantum
+    with span("verify.prepare"):
+        prep = prepare_batch_raw(raw, pad_to=size)
+    shard_2d = NamedSharding(mesh, P(None, "batch"))
+    shard_1d = NamedSharding(mesh, P("batch"))
+    with span("verify.transfer"):
+        args = [
+            jax.device_put(np.asarray(a), shard_2d if is2d else shard_1d)
+            for a, is2d in zip(prep.device_args, ARG_IS_2D)
+        ]
+    fn = sharded_verify_fn(mesh, kernel, schnorr_free=prep.schnorr_free)
+    with span("verify.kernel"):
+        ok, _total = fn(*args)
+    return ok, prep.count
+
+
 def verify_batch_sharded(
     items: Sequence[tuple[Optional[Point], int, int, int]],
     mesh: Optional[Mesh] = None,
@@ -175,15 +231,7 @@ def verify_batch_sharded(
     if not items:
         return []
     mesh = mesh or make_mesh()
-    n = mesh.devices.size
-    # Pallas shards need BLOCK-aligned per-shard batches; XLA just needs a
-    # multiple of the mesh size.
-    if _mesh_is_tpu(mesh) and not pallas_broken():
-        from .pallas_kernel import BLOCK
-
-        quantum = n * BLOCK
-    else:
-        quantum = n
+    quantum = _mesh_quantum(mesh)
     size = pad_to or len(items)
     size = max(size, len(items))
     size = (size + quantum - 1) // quantum * quantum

@@ -111,13 +111,22 @@ class Watchdog:
             else:
                 self._clear(key)
         if self.engine is not None:
-            inflight = self.engine.dispatch_inflight_seconds()
-            if inflight > self.cfg.dispatch_stall_threshold:
-                emitted += self._stall(
-                    "verify_dispatch", kind="verify_dispatch",
-                    age_seconds=round(inflight, 3),
+            # Oldest-inflight age (ISSUE 10): with a dispatch pipeline
+            # the engine tracks per-lane start times and reports the
+            # OLDEST — a single wedged lane is visible even while
+            # younger lanes keep completing.  The contract is unchanged:
+            # 0.0 when idle, one stall event per episode.
+            age = self.engine.dispatch_inflight_seconds()
+            if age > self.cfg.dispatch_stall_threshold:
+                fields = dict(
+                    kind="verify_dispatch",
+                    age_seconds=round(age, 3),
                     threshold=self.cfg.dispatch_stall_threshold,
                 )
+                depth = getattr(self.engine, "dispatch_inflight", None)
+                if depth is not None:
+                    fields["inflight"] = depth()
+                emitted += self._stall("verify_dispatch", **fields)
             else:
                 self._clear("verify_dispatch")
         return emitted
