@@ -209,19 +209,22 @@ def config2() -> None:
 
 def config3() -> None:
     """IBD replay through the FULL node stack (BASELINE.md config 3;
-    VERDICT r3 item 2): a fake wire-speaking peer serves a 1000-block
-    mixed-script chain; the chain actor syncs headers (real consensus
-    connect), then the embedder fetches block bodies in windows over the
-    peer-session API and every block rides the lazy-block native ingest —
+    VERDICT r3 item 2, rewired for ISSUE 11): a fake wire-speaking peer
+    serves a 1000-block mixed-script chain; the chain actor syncs headers
+    (real consensus connect), then the node's OWN fetch planner
+    (``NodeConfig.ibd``, tpunode/ibd.py) schedules the getdata block
+    batches from the UTXO watermark — no embedder pushes or fetch loops
+    anywhere — and every block rides the lazy-block native ingest:
     LazyBlock raw bytes -> C++ txx_prevouts (amount oracle rows) ->
-    C++ txx_extract -> engine.verify_raw -> TxVerdict events.  No Python
-    tx parsing anywhere on the hot path."""
+    C++ txx_extract (tx-range sharded across the worker pool) ->
+    engine.verify_raw -> TxVerdict events -> C++ one-pass UTXO connect.
+    No Python tx parsing anywhere on the hot path."""
     import contextlib
 
     from tpunode.actors import Publisher
+    from tpunode.ibd import IbdConfig
     from tpunode.node import Node, NodeConfig, TxVerdict, VerifyShed
     from tpunode.params import BCH_REGTEST
-    from tpunode.peer import get_blocks
     from tpunode.wire import (
         HEADER_SIZE,
         InvType,
@@ -329,6 +332,11 @@ def config3() -> None:
             connect=connect_factory,
             verify=_verify_cfg(max_wait=0.004),
             prevout_lookup=synth_prevout,
+            utxo=True,
+            # the real fetch path (ISSUE 11): the planner walks the chain
+            # from the UTXO watermark and paces itself against ingest
+            # pressure — the embedder's windowed get_blocks loop is gone
+            ibd=IbdConfig(batch_blocks=window, tick_interval=0.02),
         )
         stats = {
             "verdicts": 0, "sigs": 0, "extracted": 0, "noncb_inputs": 0,
@@ -354,7 +362,7 @@ def config3() -> None:
         async with pub.subscription() as events:
             async with Node(cfg) as node:
                 t0 = time.perf_counter()
-                peer = await asyncio.wait_for(
+                await asyncio.wait_for(
                     events.receive_match(
                         lambda ev: ev.peer if isinstance(ev, PeerConnected) else None
                     ),
@@ -372,21 +380,21 @@ def config3() -> None:
                     count_events(events)
                 )
                 try:
+                    # the planner is already fetching (it chases the
+                    # header tip as headers land); the clock covers the
+                    # whole block phase: fetch -> verify -> connect
                     t0 = time.perf_counter()
-                    hashes = [b.header.hash for b in blocks]
-                    for off in range(0, len(hashes), window):
-                        got = await get_blocks(
-                            net, 60, peer, hashes[off : off + window]
-                        )
-                        assert got is not None, f"block window {off} failed"
-                        # soft backpressure: stay under the node's shed bound
-                        while (
-                            stats["verdicts"]
-                            < (off + window - 40) * (txs_per_block + 1)
-                        ):
-                            await asyncio.sleep(0.001)
                     await asyncio.wait_for(done.wait(), 600)
+
+                    async def _wm_catchup():
+                        # verdicts all published; the last UTXO connects
+                        # trail by one batch
+                        while node.utxo.height < n_blocks:
+                            await asyncio.sleep(0.005)
+
+                    await asyncio.wait_for(_wm_catchup(), 60)
                     block_s = time.perf_counter() - t0
+                    assert node.ibd.stats()["refetches"] == 0
                 finally:
                     counter.cancel()
         return header_s, block_s, stats
@@ -409,8 +417,10 @@ def config3() -> None:
             "header_sync_s": round(header_s, 3),
             "block_phase_s": round(block_s, 3),
             "coverage": round(coverage, 4),
-            "note": "end-to-end through the full node: wire framing, "
-                    "lazy blocks, C++ extract, batch engine, TxVerdict bus",
+            "note": "end-to-end through the full node: fetch planner "
+                    "(NodeConfig.ibd), wire framing, lazy blocks, sharded "
+                    "C++ extract, batch engine, TxVerdict bus, C++ UTXO "
+                    "connect",
             "device": _device_kind(),
             **_kernel_provenance(),
         }

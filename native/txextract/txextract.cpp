@@ -1329,6 +1329,33 @@ struct OutpointHash {
   }
 };
 
+// Intra-block prevout (amount, scriptPubKey) value; the map lives on the
+// parse handle so tx-range shard extractions share ONE build (read-only
+// after txx_build_intra_h) instead of each rebuilding it per range.
+struct PrevoutInfo {
+  int64_t value;
+  const uint8_t *script;
+  uint32_t script_len;
+};
+using PrevoutMap = std::unordered_map<OutpointKey, PrevoutInfo, OutpointHash>;
+
+void build_prevout_map(const std::vector<TxSpan> &txs, PrevoutMap &map) {
+  size_t total_outs = 0;
+  for (const TxSpan &tx : txs) total_outs += tx.outs.size();
+  map.reserve(total_outs * 2);
+  for (const TxSpan &tx : txs) {
+    for (size_t vout = 0; vout < tx.outs.size(); ++vout) {
+      OutpointKey key;
+      memcpy(key.b, tx.txid, 32);
+      uint32_t v32 = uint32_t(vout);
+      memcpy(key.b + 32, &v32, 4);
+      PrevoutInfo info{tx.outs[vout].value, nullptr, 0};
+      out_script(tx.outs[vout], &info.script, &info.script_len);
+      map[key] = info;
+    }
+  }
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -1449,6 +1476,11 @@ struct TxxHandle {
   std::vector<TxSpan> txs;
   long capacity = 0;  // candidate item bound
   long inputs = 0;    // total input count (ext_amounts row count)
+  // Whole-region intra-block prevout map, built at most once
+  // (txx_build_intra_h) and read-only afterwards — the seam that lets
+  // tx-range shard extractions run concurrently on worker threads.
+  PrevoutMap intra;
+  bool intra_built = false;
 };
 
 void *txx_parse(const uint8_t *data, long len, long tx_count) {
@@ -1586,39 +1618,41 @@ long txx_extract_h(void *hp, int flags, const int64_t *ext_amounts,
 // script is ext_scripts[off[i]:off[i+1]], empty = unknown.  Rows align
 // with ext_amounts (flat input order).  NULL = no scripts (no taproot
 // extraction).
-long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
-                    long n_ext, const uint8_t *ext_scripts,
-                    const int64_t *ext_script_off, long capacity, uint8_t *z,
-                    uint8_t *px, uint8_t *py, uint8_t *r, uint8_t *s,
-                    uint8_t *present, int32_t *item_tx, int32_t *item_input,
-                    int32_t *item_sig, int32_t *item_key, int32_t *item_nsigs,
-                    int32_t *item_nkeys, uint8_t *txids,
-                    int32_t *tx_n_inputs, int32_t *tx_extracted,
-                    int32_t *tx_items, int32_t *tx_sigs, int32_t *tx_coinbase,
-                    int32_t *tx_unsupported) {
-  std::vector<TxSpan> &txs = static_cast<TxxHandle *>(hp)->txs;
+// Extraction body over a parsed handle, restricted to txs [tx_lo, tx_hi).
+//
+// The ext_amounts/ext_scripts oracle rows are RANGE-RELATIVE: row 0 is the
+// first input of tx_lo, in flat parse order (the Python binding slices the
+// whole-region rows with the tx-layout offsets).  Per-tx output arrays are
+// sized/indexed for the range (row 0 = tx_lo) and item_tx is range-relative
+// too, so a shard's RawSigItems is self-contained.
+//
+// Intra-map precedence: the handle's shared map (txx_build_intra_h) when
+// built, else — one-shot back-compat — a local map over the whole region.
+// Range callers MUST build the shared map first: ranges are extracted on
+// concurrent worker threads and only the pre-built map is read-only.
+static long extract_body(TxxHandle *h, int flags, const int64_t *ext_amounts,
+                         long n_ext, const uint8_t *ext_scripts,
+                         const int64_t *ext_script_off, long tx_lo, long tx_hi,
+                         long capacity, uint8_t *z,
+                         uint8_t *px, uint8_t *py, uint8_t *r, uint8_t *s,
+                         uint8_t *present, int32_t *item_tx, int32_t *item_input,
+                         int32_t *item_sig, int32_t *item_key, int32_t *item_nsigs,
+                         int32_t *item_nkeys, uint8_t *txids,
+                         int32_t *tx_n_inputs, int32_t *tx_extracted,
+                         int32_t *tx_items, int32_t *tx_sigs, int32_t *tx_coinbase,
+                         int32_t *tx_unsupported) {
+  std::vector<TxSpan> &txs = h->txs;
+  if (tx_lo < 0 || tx_hi > long(txs.size()) || tx_lo > tx_hi) return -1;
   bool bch = (flags & 1) != 0;
   bool intra = (flags & 2) != 0;
-  struct PrevoutInfo {
-    int64_t value;
-    const uint8_t *script;
-    uint32_t script_len;
-  };
-  std::unordered_map<OutpointKey, PrevoutInfo, OutpointHash> prevout_map;
+  PrevoutMap local_map;
+  const PrevoutMap *prevout_map = nullptr;
   if (intra) {
-    size_t total_outs = 0;
-    for (const TxSpan &tx : txs) total_outs += tx.outs.size();
-    prevout_map.reserve(total_outs * 2);
-    for (const TxSpan &tx : txs) {
-      for (size_t vout = 0; vout < tx.outs.size(); ++vout) {
-        OutpointKey key;
-        memcpy(key.b, tx.txid, 32);
-        uint32_t v32 = uint32_t(vout);
-        memcpy(key.b + 32, &v32, 4);
-        PrevoutInfo info{tx.outs[vout].value, nullptr, 0};
-        out_script(tx.outs[vout], &info.script, &info.script_len);
-        prevout_map[key] = info;
-      }
+    if (h->intra_built) {
+      prevout_map = &h->intra;
+    } else {
+      build_prevout_map(txs, local_map);
+      prevout_map = &local_map;
     }
   }
 
@@ -1630,8 +1664,8 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
     if (intra) {
       OutpointKey key;
       memcpy(key.b, in.prevout, 36);
-      auto it = prevout_map.find(key);
-      if (it != prevout_map.end()) {
+      auto it = prevout_map->find(key);
+      if (it != prevout_map->end()) {
         *amt = it->second.value;
         got |= 1;
         if (it->second.script != nullptr) {
@@ -1663,10 +1697,11 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
   PubkeyCache liftcache;  // x-only lift results, keyed by x32 — separate
                           // object, so no cross-lane key collisions exist
   long item = 0;
-  long flat_input = 0;  // index into ext_amounts / ext_script_off
-  for (size_t ti = 0; ti < txs.size(); ++ti) {
+  long flat_input = 0;  // RANGE-RELATIVE index into ext_amounts/ext_script_off
+  for (size_t ti = size_t(tx_lo); ti < size_t(tx_hi); ++ti) {
+    size_t oti = ti - size_t(tx_lo);  // range-relative output row
     TxSpan &tx = txs[ti];
-    memcpy(txids + ti * 32, tx.txid, 32);
+    memcpy(txids + oti * 32, tx.txid, 32);
     int32_t n_inputs = 0, extracted = 0, coinbase = 0, unsupported = 0;
     int32_t sigs = 0;
     long tx_item_start = item;
@@ -1743,7 +1778,7 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
           if (sb != nullptr) memcpy(s + item * 32, sb, 32);
           else memset(s + item * 32, 0, 32);
           present[item] = 0;
-          item_tx[item] = int32_t(ti);
+          item_tx[item] = int32_t(oti);
           item_input[item] = int32_t(idx);
           item_sig[item] = 0;
           item_key[item] = 0;
@@ -1830,7 +1865,7 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
         memcpy(r + item * 32, sig, 32);
         memcpy(s + item * 32, sig + 32, 32);
         present[item] = 3;
-        item_tx[item] = int32_t(ti);
+        item_tx[item] = int32_t(oti);
         item_input[item] = int32_t(idx);
         item_sig[item] = 0;
         item_key[item] = 0;
@@ -1951,7 +1986,7 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
             memset(py + item * 32, 0, 32);
           }
         }
-        item_tx[item] = int32_t(ti);
+        item_tx[item] = int32_t(oti);
         item_input[item] = int32_t(idx);
         item_sig[item] = 0;
         item_key[item] = 0;
@@ -2020,7 +2055,7 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
               memset(py + item * 32, 0, 32);
             }
           }
-          item_tx[item] = int32_t(ti);
+          item_tx[item] = int32_t(oti);
           item_input[item] = int32_t(idx);
           item_sig[item] = i;
           item_key[item] = j;
@@ -2037,14 +2072,186 @@ long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
         sigs += m;
       }
     }
-    tx_n_inputs[ti] = n_inputs;
-    tx_extracted[ti] = extracted;
-    tx_items[ti] = int32_t(item - tx_item_start);
-    tx_sigs[ti] = sigs;
-    tx_coinbase[ti] = coinbase;
-    tx_unsupported[ti] = unsupported;
+    tx_n_inputs[oti] = n_inputs;
+    tx_extracted[oti] = extracted;
+    tx_items[oti] = int32_t(item - tx_item_start);
+    tx_sigs[oti] = sigs;
+    tx_coinbase[oti] = coinbase;
+    tx_unsupported[oti] = unsupported;
   }
   return item;
+}
+
+long txx_extract_h2(void *hp, int flags, const int64_t *ext_amounts,
+                    long n_ext, const uint8_t *ext_scripts,
+                    const int64_t *ext_script_off, long capacity, uint8_t *z,
+                    uint8_t *px, uint8_t *py, uint8_t *r, uint8_t *s,
+                    uint8_t *present, int32_t *item_tx, int32_t *item_input,
+                    int32_t *item_sig, int32_t *item_key, int32_t *item_nsigs,
+                    int32_t *item_nkeys, uint8_t *txids,
+                    int32_t *tx_n_inputs, int32_t *tx_extracted,
+                    int32_t *tx_items, int32_t *tx_sigs, int32_t *tx_coinbase,
+                    int32_t *tx_unsupported) {
+  TxxHandle *h = static_cast<TxxHandle *>(hp);
+  return extract_body(h, flags, ext_amounts, n_ext, ext_scripts,
+                      ext_script_off, 0, long(h->txs.size()), capacity, z, px,
+                      py, r, s, present, item_tx, item_input, item_sig,
+                      item_key, item_nsigs, item_nkeys, txids, tx_n_inputs,
+                      tx_extracted, tx_items, tx_sigs, tx_coinbase,
+                      tx_unsupported);
+}
+
+// Build the handle's shared whole-region intra-block prevout map (at most
+// once; idempotent).  MUST run before any txx_extract_range_h with the
+// intra flag: ranges extract on concurrent threads and only the pre-built
+// map is read-only.  Returns the map size.
+long txx_build_intra_h(void *hp) {
+  TxxHandle *h = static_cast<TxxHandle *>(hp);
+  if (!h->intra_built) {
+    build_prevout_map(h->txs, h->intra);
+    h->intra_built = true;
+  }
+  return long(h->intra.size());
+}
+
+// Per-tx layout rows (n_txs each): input counts and candidate-item
+// capacities — the Python side derives range capacities and the flat
+// oracle-row offsets (cumsum) for tx-range sharding from these.
+long txx_tx_layout_h(void *hp, int32_t *n_inputs, int32_t *capacity) {
+  TxxHandle *h = static_cast<TxxHandle *>(hp);
+  for (size_t ti = 0; ti < h->txs.size(); ++ti) {
+    const TxSpan &tx = h->txs[ti];
+    long cap = 0;
+    for (const InSpan &in : tx.ins) {
+      InTemplate t;
+      classify_input(in, t);
+      cap += t.kind == InTemplate::MULTISIG
+                 ? long(t.ms.m) * (t.ms.n - t.ms.m + 1)
+                 : 1;
+    }
+    n_inputs[ti] = int32_t(tx.ins.size());
+    capacity[ti] = int32_t(cap);
+  }
+  return long(h->txs.size());
+}
+
+// Tx-range extraction over the shared handle (ISSUE 11): same result rows
+// as txx_extract_h2 but only for txs [tx_lo, tx_hi), with range-relative
+// oracle rows and output indices (see extract_body).  Thread-safe across
+// DISJOINT ranges once txx_build_intra_h ran (or the intra flag is off).
+long txx_extract_range_h(void *hp, int flags, const int64_t *ext_amounts,
+                         long n_ext, const uint8_t *ext_scripts,
+                         const int64_t *ext_script_off, long tx_lo, long tx_hi,
+                         long capacity, uint8_t *z,
+                         uint8_t *px, uint8_t *py, uint8_t *r, uint8_t *s,
+                         uint8_t *present, int32_t *item_tx, int32_t *item_input,
+                         int32_t *item_sig, int32_t *item_key,
+                         int32_t *item_nsigs, int32_t *item_nkeys,
+                         uint8_t *txids, int32_t *tx_n_inputs,
+                         int32_t *tx_extracted, int32_t *tx_items,
+                         int32_t *tx_sigs, int32_t *tx_coinbase,
+                         int32_t *tx_unsupported) {
+  return extract_body(static_cast<TxxHandle *>(hp), flags, ext_amounts, n_ext,
+                      ext_scripts, ext_script_off, tx_lo, tx_hi, capacity, z,
+                      px, py, r, s, present, item_tx, item_input, item_sig,
+                      item_key, item_nsigs, item_nkeys, txids, tx_n_inputs,
+                      tx_extracted, tx_items, tx_sigs, tx_coinbase,
+                      tx_unsupported);
+}
+
+// ---------------------------------------------------------------------------
+// Native UTXO block-connect (ISSUE 11): one pass over the parsed region
+// emits the block's spend/create key-value delta as a ready-to-apply batch
+// blob in the v1 record format (op u8, klen u32le, vlen u32le, key, value):
+//
+//   create: op=1, key = prefix ++ txid ++ vout_le32,
+//           value = amount_le64 ++ scriptPubKey
+//   spend:  op=2, key = prefix ++ prevout_txid ++ prevout_vout_le32
+//
+// Creates are emitted before spends per the WHOLE region and coinbase
+// inputs spend nothing — exactly UtxoStore.apply_block's semantics, so the
+// Python per-tx parse leaves block ingest entirely (node._apply_block_utxo).
+// ---------------------------------------------------------------------------
+
+// Exact byte size of the ops blob txx_utxo_ops_h would emit.
+long txx_utxo_size_h(void *hp) {
+  TxxHandle *h = static_cast<TxxHandle *>(hp);
+  static const uint8_t ZERO_TXID[32] = {0};
+  const long REC = 9, KEY = 1 + 32 + 4;
+  long total = 0;
+  for (const TxSpan &tx : h->txs) {
+    for (const OutSpan &o : tx.outs) {
+      const uint8_t *script = nullptr;
+      uint32_t slen = 0;
+      out_script(o, &script, &slen);
+      total += REC + KEY + 8 + long(slen);
+    }
+    for (const InSpan &in : tx.ins) {
+      if (memcmp(in.prevout, ZERO_TXID, 32) != 0) total += REC + KEY;
+    }
+  }
+  return total;
+}
+
+// Emit the delta blob into `out` (capacity `cap` bytes).  `created` /
+// `spent` receive the op counts.  Returns bytes written, or -2 when cap
+// is too small (use txx_utxo_size_h).
+long txx_utxo_ops_h(void *hp, uint8_t prefix, long cap, uint8_t *out,
+                    long *created, long *spent) {
+  TxxHandle *h = static_cast<TxxHandle *>(hp);
+  static const uint8_t ZERO_TXID[32] = {0};
+  long pos = 0, n_created = 0, n_spent = 0;
+  auto put_hdr = [&](uint8_t op, uint32_t klen, uint32_t vlen) {
+    out[pos] = op;
+    memcpy(out + pos + 1, &klen, 4);  // little-endian on supported targets
+    memcpy(out + pos + 5, &vlen, 4);
+    pos += 9;
+  };
+  const uint32_t KEY = 1 + 32 + 4;
+  for (const TxSpan &tx : h->txs) {
+    for (size_t vout = 0; vout < tx.outs.size(); ++vout) {
+      const OutSpan &o = tx.outs[vout];
+      const uint8_t *script = nullptr;
+      uint32_t slen = 0;
+      out_script(o, &script, &slen);
+      uint32_t vlen = 8 + slen;
+      if (pos + 9 + long(KEY) + long(vlen) > cap) return -2;
+      put_hdr(1, KEY, vlen);
+      out[pos] = prefix;
+      memcpy(out + pos + 1, tx.txid, 32);
+      uint32_t v32 = uint32_t(vout);
+      memcpy(out + pos + 33, &v32, 4);
+      pos += KEY;
+      uint64_t amt = uint64_t(o.value);
+      memcpy(out + pos, &amt, 8);
+      if (slen) memcpy(out + pos + 8, script, slen);
+      pos += vlen;
+      ++n_created;
+    }
+  }
+  for (const TxSpan &tx : h->txs) {
+    for (const InSpan &in : tx.ins) {
+      if (memcmp(in.prevout, ZERO_TXID, 32) == 0) continue;
+      if (pos + 9 + long(KEY) > cap) return -2;
+      put_hdr(2, KEY, 0);
+      out[pos] = prefix;
+      memcpy(out + pos + 1, in.prevout, 36);  // txid ++ vout_le32 (wire order)
+      pos += KEY;
+      ++n_spent;
+    }
+  }
+  if (created) *created = n_created;
+  if (spent) *spent = n_spent;
+  return pos;
+}
+
+// All parsed txids, row-major (n_txs x 32) — block connect and mempool
+// confirmation need the txid list without a Python parse OR an extract.
+long txx_txids_h(void *hp, uint8_t *out) {
+  TxxHandle *h = static_cast<TxxHandle *>(hp);
+  for (size_t ti = 0; ti < h->txs.size(); ++ti)
+    memcpy(out + ti * 32, h->txs[ti].txid, 32);
+  return long(h->txs.size());
 }
 
 }  // extern "C"

@@ -100,7 +100,7 @@ class _QueueReader:
 
 def mock_peer_react(
     net: Network, blocks: list[Block], msg, getdata_blocks: list[Block] = (),
-    relay: "TxRelay | None" = None,
+    relay: "TxRelay | None" = None, serve_blocks: bool = True,
 ) -> list:
     """Scripted protocol brain (reference ``mockPeerReact`` NodeSpec.hs:135-147).
 
@@ -136,6 +136,9 @@ def mock_peer_react(
         missing = []
         for iv in msg.invs:
             if iv.type in (InvType.BLOCK, InvType.WITNESS_BLOCK):
+                if not serve_blocks:
+                    continue  # block-stalling remote (IBD retry tests):
+                    # headers flow, block getdata is never answered
                 b = by_hash.get(iv.hash)
                 if b is not None:
                     out.append(MsgBlock(b))
@@ -161,6 +164,7 @@ async def _fake_remote(
     send_version_first: bool = True,
     getdata_blocks: list[Block] = (),
     relay: "TxRelay | None" = None,
+    serve_blocks: bool = True,
 ) -> None:
     """The remote endpoint: speaks real wire bytes over the pipe."""
     if send_version_first:
@@ -186,7 +190,7 @@ async def _fake_remote(
             payload = await reader.read_exact(header.length) if header.length else b""
             msg = decode_message(net, header, payload)
             for reply in mock_peer_react(
-                net, blocks, msg, getdata_blocks, relay
+                net, blocks, msg, getdata_blocks, relay, serve_blocks
             ):
                 to_node.put_nowait(encode_message(net, reply))
     except EOFError:
@@ -199,6 +203,7 @@ def dummy_peer_connect(
     send_version_first: bool = True,
     getdata_blocks: list[Block] = (),
     relay: "TxRelay | None" = None,
+    serve_blocks: bool = True,
 ):
     """Transport factory injected as ``NodeConfig.connect``
     (reference ``dummyPeerConnect`` NodeSpec.hs:94-133).  ``relay`` gives
@@ -213,7 +218,7 @@ def dummy_peer_connect(
         task = asyncio.get_running_loop().create_task(
             _fake_remote(
                 net, blocks, to_node, from_node, send_version_first,
-                getdata_blocks, relay,
+                getdata_blocks, relay, serve_blocks,
             )
         )
         try:

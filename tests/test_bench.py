@@ -88,6 +88,29 @@ _PIPELINE_OK = {
                                   "4": 351622.8},
 }
 
+# Canned healthy long-IBD A/B result (ISSUE 11; the real subprocess path
+# is covered by test_ibd_worker_subprocess).
+_IBD_OK = {
+    "ok": True, "proxy": "cpu-native", "blocks": 240, "txs_per_block": 128,
+    "inputs_per_tx": 1, "sigs": 30720,
+    "ingest_native": {"wall_s": 4.76, "blocks_per_s": 50.4,
+                      "txs_per_s": 6506.4, "sigs_per_s": 6455.9,
+                      "verdicts": 30960, "fetched_blocks": 240, "runs": 2},
+    "ingest_python": {"wall_s": 14.81, "blocks_per_s": 16.2,
+                      "txs_per_s": 2091.0, "sigs_per_s": 2074.8,
+                      "verdicts": 30960, "fetched_blocks": 240, "runs": 2},
+    "connect_native": {"wall_s": 1.17, "blocks_per_s": 205.9,
+                       "txs_per_s": 26567.5, "sigs_per_s": None,
+                       "verdicts": 0, "fetched_blocks": 240, "runs": 1},
+    "connect_python": {"wall_s": 2.01, "blocks_per_s": 119.7,
+                       "txs_per_s": 15437.8, "sigs_per_s": None,
+                       "verdicts": 0, "fetched_blocks": 240, "runs": 1},
+    "ingest_speedup": 3.111, "connect_speedup": 1.72, "speedup": 3.111,
+    "kill9": {"ok": True, "killed_at_watermark": 600,
+              "resumed_from_watermark": 601, "final_watermark": 1500,
+              "reverified_blocks": 0, "refetched_blocks": 0},
+}
+
 # Canned healthy chaos-resilience result (the real subprocess path is
 # covered by test_chaos_worker_subprocess).
 _CHAOS_OK = {
@@ -132,6 +155,9 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         if mode == "--pipeline":
             # likewise for the ride-along pipeline A/B section (ISSUE 10)
             return dict(_PIPELINE_OK)
+        if mode == "--ibd":
+            # likewise for the ride-along long-IBD section (ISSUE 11)
+            return dict(_IBD_OK)
         raise AssertionError(f"unexpected worker call: {mode} {env_extra}")
 
     monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
@@ -175,7 +201,7 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         c for c in calls
         if c[0] not in (
             "--mempool", "--chaos", "--kernel-ab", "--recovery",
-            "--pipeline",
+            "--pipeline", "--ibd",
         )
     ]
     return line, calls, rc
@@ -661,6 +687,106 @@ def test_pipeline_section_failure_labeled(monkeypatch):
     assert ps["ok"] is False
     assert "timed out" in ps["error"]
     assert ps["serial"]["sigs_per_s"] == 10.0
+
+
+def _is_ibd(mode, env):
+    return mode == "--ibd"
+
+
+def test_ibd_section_always_present(monkeypatch):
+    """ISSUE 11: the BENCH JSON carries an ``ibd`` section (the 4-leg
+    fetch-planner A/B + the kill -9 resume leg) on every run."""
+    bench = _load_bench()
+    line, _, _ = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 1.0, "device": "tpu:v5e"}),
+        ],
+    )
+    ib = line["ibd"]
+    assert ib["ok"] is True
+    assert ib["speedup"] == ib["ingest_speedup"] > 1.0
+    for leg in ("ingest_native", "ingest_python",
+                "connect_native", "connect_python"):
+        assert ib[leg]["blocks_per_s"] > 0
+        assert ib[leg]["fetched_blocks"] == ib["blocks"]
+    k9 = ib["kill9"]
+    assert k9["ok"] is True
+    assert k9["reverified_blocks"] == 0 and k9["refetched_blocks"] == 0
+    assert k9["resumed_from_watermark"] >= k9["killed_at_watermark"]
+
+
+def test_ibd_section_worker_env_is_device_free(monkeypatch):
+    """The ibd worker runs on the cpu proxy (backend="cpu" never imports
+    jax); its env pins cpu anyway."""
+    bench = _load_bench()
+    seen = []
+    monkeypatch.setattr(
+        bench, "_run_worker",
+        lambda mode, timeout, env=None: (
+            seen.append((mode, timeout, dict(env or {})))
+            or dict(_IBD_OK)
+        ),
+    )
+    assert bench._ibd_section()["ok"] is True
+    ((mode, timeout, env),) = seen
+    assert mode == "--ibd"
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert timeout == bench.T_IBD
+
+
+def test_ibd_section_failure_labeled(monkeypatch):
+    """A failed/timed-out ibd scenario is labeled — with whatever partial
+    A/B or kill9 evidence it produced — never masked, and never takes
+    the headline down with it."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_ibd, {"ok": False, "error": "kill -9 leg failed",
+                       "speedup": 3.1,
+                       "kill9": {"ok": False, "reverified_blocks": 4}}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 9.0  # headline survived
+    ib = line["ibd"]
+    assert ib["ok"] is False
+    assert "kill -9" in ib["error"]
+    assert ib["kill9"]["reverified_blocks"] == 4
+
+
+@pytest.mark.slow  # four full planner-driven syncs + the kill -9 child
+# in a subprocess (multi-minute; the scripted pins above cover the
+# section contract in tier 1)
+def test_ibd_worker_subprocess():
+    """The real ``--ibd`` worker end-to-end in a subprocess: every A/B
+    leg completes with verdict conservation, the native ingest leg beats
+    the Python baseline, and the kill -9 leg resumes from the watermark
+    with zero re-verified blocks."""
+    import subprocess
+
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        TPUNODE_BENCH_IBD_BLOCKS="60", TPUNODE_BENCH_IBD_TXS="16",
+        TPUNODE_BENCH_IBD_KILL_BLOCKS="300",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ibd"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["ok"] is True, line
+    total = 60 * 17
+    assert line["ingest_native"]["verdicts"] == total
+    assert line["ingest_python"]["verdicts"] == total
+    assert line["kill9"]["ok"] is True
+    assert line["kill9"]["reverified_blocks"] == 0
 
 
 @pytest.mark.slow  # two full node firehose runs + the scaling curve in a

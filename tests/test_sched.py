@@ -95,7 +95,7 @@ async def test_packer_depths_metrics_and_drain():
     p = LanePacker()
     p.push(_sub(5, "mempool"))
     p.push(_sub(2, "block"))
-    assert p.depths() == {"block": 2, "mempool": 5, "bulk": 0}
+    assert p.depths() == {"block": 2, "mempool": 5, "ibd": 0, "bulk": 0}
     assert p.batches() == 2
     assert metrics.get(
         "sched.queue_depth", labels={"priority": "mempool"}
@@ -156,7 +156,7 @@ async def test_packer_skips_failed_submission_remainder():
         (True, 0, 2)
     ]
     assert p.pending() == 0 and p.depths() == {
-        "block": 0, "mempool": 0, "bulk": 0
+        "block": 0, "mempool": 0, "ibd": 0, "bulk": 0
     }
 
 

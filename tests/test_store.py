@@ -166,21 +166,21 @@ def test_native_v1_replays_bit_identically_under_v2_reader(tmp_path):
     s.close()
 
 
-def test_native_refuses_v2_directory(tmp_path):
-    """Version gate (ISSUE 9): the v1-only native engine must refuse a
-    directory with v2 artifacts instead of serving a stale data subset."""
-    from tpunode.store import StoreVersionError
-
+def test_native_opens_v2_directory(tmp_path):
+    """ISSUE 11: the native engine now replays the v2 segmented format
+    (it used to refuse via StoreVersionError); ``auto`` still prefers
+    LogKV for v2 directories (async group-commit, quarantining salvage).
+    The deep interop matrix lives in tests/test_native_v2.py."""
     path = str(tmp_path / "v2.log")
     s = LogKV(path)
     s.put(b"k", b"v")
     s.close()
     _native(str(tmp_path / "probe.log")).close()  # skips if unbuildable
-    with pytest.raises(StoreVersionError):
-        _native(path)
-    with pytest.raises(StoreVersionError):
-        open_store(path, engine="native")
-    # auto picks the engine that can actually read what is on disk
+    nkv = open_store(path, engine="native")
+    assert getattr(nkv, "format_v2", False) is True
+    assert nkv.get(b"k") == b"v"
+    nkv.close()
+    # auto keeps picking the Python engine for v2 directories
     auto = open_store(path)
     assert isinstance(auto, LogKV)
     assert auto.get(b"k") == b"v"

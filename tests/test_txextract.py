@@ -337,3 +337,153 @@ def test_malformed_data_raises():
     bad = (1).to_bytes(4, "little") + b"\xfe\x00\x00\x00\x01" + b"\x00" * 8
     with pytest.raises(ValueError):
         extract_raw(bad, 1)
+
+
+# ---------------------------------------------------------------------------
+# tx-range sharding (ISSUE 11): range extraction over the shared handle is
+# bit-identical to the whole-region extract
+
+def _merge_shards(shards):
+    import numpy as np
+
+    class _M:
+        pass
+
+    m = _M()
+    for name in (
+        "z", "px", "py", "r", "s", "present", "item_input", "item_sig",
+        "item_key", "item_nsigs", "item_nkeys", "txids", "tx_n_inputs",
+        "tx_extracted", "tx_items", "tx_sigs", "tx_coinbase",
+        "tx_unsupported",
+    ):
+        setattr(m, name, np.concatenate([getattr(s, name) for s in shards]))
+    m.count = sum(s.count for s in shards)
+    return m
+
+
+@pytest.mark.parametrize("cuts", [(0, 7, 40), (0, 1, 39), (0, 20)])
+def test_extract_range_sharded_matches_serial(cuts):
+    """Contiguous tx-range shards (shared intra map, range-local oracle
+    rows) merge to EXACTLY the serial whole-region result — every item
+    row, every per-tx stat."""
+    import numpy as np
+
+    from benchmarks.txgen import gen_mixed_txs, synth_prevout
+    from tpunode.txextract import ParsedTxRegion
+
+    txs = gen_mixed_txs(40, seed=0x5A5A)
+    raw = _serialize_all(txs)
+    with ParsedTxRegion(raw, len(txs)) as region:
+        pv_txids, pv_vouts, pv_wants = region.scan_prevouts(False)
+        ext = [-1] * len(pv_wants)
+        scr = [None] * len(pv_wants)
+        for i in pv_wants.nonzero()[0]:
+            res = synth_prevout(pv_txids[i].tobytes(), int(pv_vouts[i]))
+            if res is not None:
+                ext[int(i)], scr[int(i)] = res
+        serial = region.extract(
+            intra_amounts=True, ext_amounts=ext, ext_scripts=scr
+        )
+        region.build_intra()
+        off = region.input_offsets()
+        bounds = list(cuts) + [len(txs)]
+        shards = []
+        for lo, hi in zip(bounds, bounds[1:]):
+            fl, fh = int(off[lo]), int(off[hi])
+            shards.append(region.extract_range(
+                lo, hi, intra_amounts=True,
+                ext_amounts=ext[fl:fh], ext_scripts=scr[fl:fh],
+            ))
+        merged = _merge_shards(shards)
+        assert merged.count == serial.count
+        for name in (
+            "z", "px", "py", "r", "s", "present", "item_input",
+            "item_sig", "item_key", "item_nsigs", "item_nkeys", "txids",
+            "tx_n_inputs", "tx_extracted", "tx_items", "tx_sigs",
+            "tx_coinbase", "tx_unsupported",
+        ):
+            assert np.array_equal(
+                getattr(merged, name), getattr(serial, name)
+            ), name
+        # item_tx is range-relative: rebase and compare
+        rebased = np.concatenate([
+            s.item_tx + lo for s, lo in zip(shards, bounds)
+        ])
+        assert np.array_equal(rebased, serial.item_tx)
+
+
+def test_extract_range_cross_shard_intra_spends():
+    """An in-block spend whose funding tx lives in a DIFFERENT shard
+    still resolves through the shared intra map — the whole point of
+    building it once on the handle."""
+    from benchmarks.txgen import gen_signed_txs
+    from tpunode.txextract import ParsedTxRegion
+
+    # every 2nd tx is a P2WPKH spend of its predecessor's output 0
+    txs = gen_signed_txs(8, inputs_per_tx=1, seed=0x17, segwit_every=2)
+    raw = _serialize_all(txs)
+    with ParsedTxRegion(raw, len(txs)) as region:
+        serial = region.extract(intra_amounts=True)
+        region.build_intra()
+        # cut between a funding tx (index 4) and its segwit child (5)
+        a = region.extract_range(0, 5, intra_amounts=True)
+        b = region.extract_range(5, 8, intra_amounts=True)
+        assert a.count + b.count == serial.count
+        # the child extracted (not unsupported): its amount resolved
+        # across the shard boundary
+        assert int(b.tx_unsupported[0]) == int(serial.tx_unsupported[5])
+        assert int(b.tx_extracted[0]) == int(serial.tx_extracted[5]) == 1
+
+
+def test_extract_range_validates_bounds():
+    from benchmarks.txgen import gen_signed_txs
+    from tpunode.txextract import ParsedTxRegion
+
+    txs = gen_signed_txs(3, inputs_per_tx=1, seed=0x18)
+    with ParsedTxRegion(_serialize_all(txs), 3) as region:
+        with pytest.raises(ValueError):
+            region.extract_range(2, 5)
+        with pytest.raises(ValueError):
+            region.extract_range(-1, 2)
+        empty = region.extract_range(1, 1)
+        assert empty.count == 0 and empty.n_txs == 0
+
+
+def test_utxo_ops_blob_layout():
+    """The one-pass UTXO delta blob: creates (key -> amount+script) before
+    spends, coinbase inputs skipped, v1 record framing."""
+    import struct
+
+    from benchmarks.txgen import gen_signed_txs
+    from tpunode.txextract import ParsedTxRegion
+
+    txs = gen_signed_txs(5, inputs_per_tx=2, seed=0x19)
+    with ParsedTxRegion(_serialize_all(txs), 5) as region:
+        blob, created, spent = region.utxo_ops()
+        tids = region.txids()
+    assert created == sum(len(t.outputs) for t in txs)
+    assert spent == sum(len(t.inputs) for t in txs)  # no coinbase here
+    rec = struct.Struct("<BII")
+    pos = n_put = n_del = 0
+    seen_del = False
+    while pos < len(blob):
+        op, klen, vlen = rec.unpack_from(blob, pos)
+        pos += rec.size
+        key = blob[pos : pos + klen]
+        pos += klen
+        val = blob[pos : pos + vlen]
+        pos += vlen
+        assert key[0:1] == b"o" and klen == 37
+        if op == 1:
+            assert not seen_del  # creates strictly before spends
+            n_put += 1
+            txid, vout = key[1:33], int.from_bytes(key[33:], "little")
+            ti = next(
+                i for i in range(len(txs)) if tids[i].tobytes() == txid
+            )
+            out = txs[ti].outputs[vout]
+            assert val == struct.pack("<q", out.value) + out.script
+        else:
+            seen_del = True
+            n_del += 1
+    assert (n_put, n_del) == (created, spent)

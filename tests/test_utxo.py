@@ -267,15 +267,19 @@ async def test_out_of_order_block_parks_until_predecessor(tmp_path):
 async def test_reorg_beneath_watermark_goes_loudly_stale(tmp_path):
     """Review pin: a watermark on a branch the chain no longer follows
     must not silently absorb the new branch's deltas — the next connect
-    fails the hash-chain check, emits utxo.reorg_stale, and the
-    watermark never advances (no undo log: rebuild is the remedy)."""
+    fails the hash-chain check AND finds no undo record (the seed wrote
+    none: the reorg is effectively deeper than the retained undo depth),
+    so it emits utxo.reorg_stale and the watermark never advances
+    (rebuild is the remedy).  Clean unwinds with undo records are pinned
+    by test_ibd.py's reorg test."""
     from tpunode.utxo import UTXO_NAMESPACE
 
     blocks = all_blocks()
     store = LogKV(str(tmp_path / "node.log"))
     # seed a height-1 watermark pointing at a block hash that is NOT on
-    # (or even known to) the canned chain — an orphaned branch's tip
-    UtxoStore(Namespaced(store, UTXO_NAMESPACE)).apply(
+    # (or even known to) the canned chain — an orphaned branch's tip,
+    # with NO undo record retained (undo_depth=0)
+    UtxoStore(Namespaced(store, UTXO_NAMESPACE), undo_depth=0).apply(
         1, b"\xab" * 32, spends=[], creates=[]
     )
     r0 = metrics.get("utxo.reorg_stale")
@@ -300,3 +304,117 @@ async def test_reorg_beneath_watermark_goes_loudly_stale(tmp_path):
         )
         assert node.utxo.height == 1  # never advanced onto wrong state
     store.close()
+
+
+# ---------------------------------------------------------------------------
+# per-block UNDO records (ISSUE 11)
+
+def _demo_blocks():
+    """Three small hand-rolled deltas exercising spends of earlier
+    creates and same-block create+spend netting."""
+    t1, t2, t3 = b"\x01" * 32, b"\x02" * 32, b"\x03" * 32
+    return [
+        # height 1: two outputs born
+        ([], [(t1, 0, 100, b"\x51"), (t1, 1, 200, b"\x52")]),
+        # height 2: spends t1:0, creates t2:0
+        ([(t1, 0)], [(t2, 0, 300, b"\x53")]),
+        # height 3: spends t2:0 and t1:1, creates t3:0
+        ([(t2, 0), (t1, 1)], [(t3, 0, 400, b"\x54")]),
+    ]
+
+
+def test_undo_disconnect_reconnect_round_trips():
+    """The ISSUE 11 pin: disconnect + re-connect round-trips the UTXO
+    set bit-identically, at every depth."""
+    u = UtxoStore(MemoryKV())
+    snaps = [u.snapshot()]
+    hashes = []
+    for h, (spends, creates) in enumerate(_demo_blocks(), start=1):
+        bh = bytes([h]) * 32
+        hashes.append(bh)
+        assert u.apply(h, bh, spends=spends, creates=creates)
+        snaps.append(u.snapshot())
+    # unwind all the way down, checking each restored state
+    for h in (3, 2, 1):
+        assert u.disconnect()
+        assert u.height == (h - 1 if h >= 2 else -1)
+        assert u.snapshot() == snaps[h - 1]
+        assert u.block_hash == (hashes[h - 2] if h >= 2 else None)
+    assert u.height == -1 and u.block_hash is None
+    # reconnect everything: same final state as the first pass
+    for h, (spends, creates) in enumerate(_demo_blocks(), start=1):
+        assert u.apply(h, hashes[h - 1], spends=spends, creates=creates)
+    assert u.snapshot() == snaps[-1]
+    assert u.block_hash == hashes[-1]
+
+
+def test_undo_retention_depth():
+    """Undo records older than undo_depth are pruned in the connect
+    batch: disconnect works back exactly undo_depth blocks, then refuses
+    (the loudly-stale fallback's trigger)."""
+    u = UtxoStore(MemoryKV(), undo_depth=2)
+    for h in range(1, 5):
+        u.apply(h, bytes([h]) * 32, spends=[],
+                creates=[(bytes([h]) * 32, 0, h, b"")])
+    assert u.undo_available(4) and u.undo_available(3)
+    assert not u.undo_available(2)  # pruned by the height-4 connect
+    assert u.disconnect()
+    assert u.disconnect()
+    assert not u.disconnect()  # deeper than retention
+    assert u.height == 2  # store untouched by the refused disconnect
+
+
+def test_undo_disabled_with_zero_depth():
+    u = UtxoStore(MemoryKV(), undo_depth=0)
+    u.apply(1, b"\x01" * 32, spends=[], creates=[(b"\x0a" * 32, 0, 1, b"")])
+    assert not u.undo_available()
+    assert not u.disconnect()
+    assert u.height == 1
+
+
+def test_watermark_persists_with_undo_across_reopen(tmp_path):
+    """Undo records survive the log replay: a reopened store can still
+    disconnect its tip."""
+    path = str(tmp_path / "kv.log")
+    s = LogKV(path)
+    u = UtxoStore(Namespaced(s, b"u/"))
+    u.apply(1, b"\x01" * 32, spends=[], creates=[(b"\x0b" * 32, 0, 9, b"")])
+    u.apply(2, b"\x02" * 32, spends=[(b"\x0b" * 32, 0)], creates=[])
+    s.close()
+    s2 = LogKV(path)
+    u2 = UtxoStore(Namespaced(s2, b"u/"))
+    assert u2.height == 2
+    assert u2.disconnect()
+    assert u2.height == 1
+    assert u2.block_hash == b"\x01" * 32
+    assert u2.lookup(b"\x0b" * 32, 0) == (9, b"")  # spend restored
+    s2.close()
+
+
+def test_apply_ops_blob_matches_apply_block():
+    """ISSUE 11: the C++ one-pass delta blob (ParsedTxRegion.utxo_ops ->
+    apply_ops_blob) produces a store bit-identical to the Python
+    apply_block path — undo records included (both disconnect to the
+    same state)."""
+    txextract = pytest.importorskip("tpunode.txextract")
+    if not txextract.have_native_extract():
+        pytest.skip("native txextract unavailable")
+    from tpunode.txextract import ParsedTxRegion
+
+    blocks = all_blocks()
+    upy = UtxoStore(MemoryKV())
+    unat = UtxoStore(MemoryKV())
+    for height, b in enumerate(blocks, start=1):
+        assert upy.apply_block(height, b.header.hash, list(b.txs))
+        raw = b.serialize()[80:]  # strip header; varint(count) + txs
+        # skip the tx-count varint (fixture blocks carry < 0xFD txs)
+        with ParsedTxRegion(raw[1:], len(b.txs)) as region:
+            blob, created, spent = region.utxo_ops()
+        assert unat.apply_ops_blob(
+            height, b.header.hash, blob, created, spent
+        )
+    assert upy.snapshot() == unat.snapshot()
+    assert upy.height == unat.height == len(blocks)
+    # undo parity: both paths disconnect to the same prior state
+    assert upy.disconnect() and unat.disconnect()
+    assert upy.snapshot() == unat.snapshot()

@@ -40,7 +40,14 @@ from .headers import (
     next_work_required,
     split_point,
 )
-from .node import Node, NodeConfig, TxVerdict, VerifyShed, tcp_connect
+from .node import (
+    IbdConfig,
+    Node,
+    NodeConfig,
+    TxVerdict,
+    VerifyShed,
+    tcp_connect,
+)
 from .params import (
     BCH,
     BCH_REGTEST,

@@ -474,6 +474,7 @@ KNOWN_LAYERS = frozenset({
     "chain",      # header-chain actor (tpunode/chain.py)
     "chaos",      # fault injection (tpunode/chaos.py, ISSUE 7)
     "events",     # event-log self-metrics (tpunode/events.py)
+    "ibd",        # block-fetch-driven IBD planner (tpunode/ibd.py, ISSUE 11)
     "mempool",    # mempool subsystem (tpunode/mempool.py)
     "node",       # node composition/ingest (tpunode/node.py)
     "peer",       # wire sessions (tpunode/peer.py)

@@ -1,16 +1,26 @@
 """ctypes binding to the native C++ KV store (native/kvstore).
 
 Implements the same :class:`tpunode.store.KVStore` protocol as the Python
-engines; ``open_store(path)`` uses this engine for **existing v1 logs**
-when the shared library builds.  The on-disk format is the legacy v1
-single-file log (the reference's analogous component is RocksDB behind
-rocksdb-haskell-jprupp, package.yaml:32-33); the Python ``LogKV`` now
-writes the crash-consistent v2 segmented format (ISSUE 9), which its v2
-reader can mix with v1 but this engine cannot — ``NativeKV`` is
-version-gated and raises :class:`tpunode.store.StoreVersionError` on a
-v2 directory instead of silently serving a stale subset.  A v1 log
-written here replays bit-identically under the v2 reader (pinned by
-tests/test_store.py).
+engines (the reference's analogous component is RocksDB behind
+rocksdb-haskell-jprupp, package.yaml:32-33).  Two on-disk modes, decided
+by what is at ``path`` (ISSUE 11 — the engine used to refuse v2
+directories via :class:`tpunode.store.StoreVersionError`):
+
+* **legacy v1** single-file log for paths with no v2 artifacts — exactly
+  what this engine always wrote, replayed bit-identically by the Python
+  v2 reader (pinned by tests/test_store.py);
+* **v2 segmented** (the CRC+seq format ``LogKV`` writes, ISSUE 9):
+  replays the base snapshot/legacy file plus every segment with CRC and
+  per-segment sequence validation, truncates a torn tail of the last
+  file, and appends its own records into a fresh v2 segment — so the
+  native engine serves the store the node actually writes, and ``LogKV``
+  replays the result bit-identically (tests/test_native_v2.py).
+
+Recovery division of labor: mid-log damage (a sealed file failing
+CRC/sequence checks) makes ``kv_open`` FAIL rather than silently serve a
+prefix of acked data — the quarantining salvage path belongs to
+``LogKV`` (tpunode/store.py), which remains the engine of record for
+damaged stores.
 """
 
 from __future__ import annotations
@@ -97,6 +107,8 @@ def load_kvstore_lib() -> ctypes.CDLL:
         lib.kv_open.restype = ctypes.c_void_p
         lib.kv_open.argtypes = [ctypes.c_char_p]
         lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_format.restype = ctypes.c_int
+        lib.kv_format.argtypes = [ctypes.c_void_p]
         lib.kv_get.restype = ctypes.c_int
         lib.kv_get.argtypes = [
             ctypes.c_void_p,
@@ -136,21 +148,22 @@ class NativeKV:
         self.path = path
         self.fsync = fsync
         self._read_tick = 0
-        self._h = None  # __del__ must survive a version-gate refusal
-        # Version gate (ISSUE 9): the C++ engine speaks the v1 single-file
-        # format only.  Opening a directory holding v2 artifacts (CRC'd
-        # segments / a v2 snapshot base) would silently serve a stale
-        # subset of the data — refuse loudly instead of mixing engines.
-        if v2_artifacts(path):
-            raise StoreVersionError(
-                f"{path}: log format v2 (segments/snapshot present); the "
-                "native engine reads v1 only — open with the LogKV engine"
-            )
+        self._h = None  # __del__ must survive an open failure
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._lib = load_kvstore_lib()
         self._h = self._lib.kv_open(path.encode())
         if not self._h:
+            # kv_open refuses mid-log damage (a sealed segment failing
+            # CRC/sequence validation) and formats newer than v2: both
+            # are LogKV's richer recovery/reader territory, never a
+            # silent stale-prefix serve.
+            if v2_artifacts(path):
+                raise StoreVersionError(
+                    f"{path}: native v2 replay refused (mid-log damage or "
+                    "newer format) — open with the LogKV engine to salvage"
+                )
             raise OSError(f"kv_open failed for {path!r}")
+        self.format_v2 = bool(self._lib.kv_format(self._h))
 
     # Same 1-in-64 read-latency sampling as LogKV (store.py): the registry
     # lock must not dominate a sub-µs native lookup.

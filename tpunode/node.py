@@ -68,7 +68,8 @@ from .peer import (
 )
 from .peermgr import PeerMgr, PeerMgrConfig, SockAddr
 from .store import KVStore, Namespaced
-from .utxo import UTXO_NAMESPACE, UtxoStore
+from .ibd import BlockFetcher, IbdConfig
+from .utxo import UNDO_DEPTH_DEFAULT, UTXO_NAMESPACE, UtxoStore
 from .wire import (
     InvType,
     MsgAddr,
@@ -86,7 +87,10 @@ from .wire import (
     Tx,
 )
 
-__all__ = ["NodeConfig", "Node", "TxVerdict", "VerifyShed", "tcp_connect"]
+__all__ = [
+    "NodeConfig", "Node", "TxVerdict", "VerifyShed", "tcp_connect",
+    "IbdConfig",
+]
 
 
 log = logging.getLogger("tpunode.node")
@@ -216,10 +220,26 @@ class NodeConfig:
     # mempool and ``prevout_lookup``, and blocks at or below the
     # watermark skip re-verification entirely on restart.
     utxo: bool = False
+    # per-block UNDO retention (ISSUE 11): reorgs at/below the watermark
+    # up to this deep disconnect cleanly (utxo.disconnect) instead of
+    # going loudly stale; 0 disables undo records entirely.
+    utxo_undo_depth: int = UNDO_DEPTH_DEFAULT
+    # block-fetch-driven IBD (ISSUE 11 / ROADMAP item 5): when set, the
+    # node schedules its own getdata block batches across the peer fleet
+    # from the UTXO watermark to the header tip (tpunode/ibd.py) — a bare
+    # Node syncs the whole chain with no embedder pushes, and a restart
+    # resumes from the watermark re-fetching nothing below it.  Requires
+    # ``utxo=True`` (the watermark IS the sync cursor).
+    ibd: Optional[IbdConfig] = None
 
     def __post_init__(self):
         if self.connect is None:
             self.connect = tcp_connect
+        if self.ibd is not None and not self.utxo:
+            raise ValueError(
+                "NodeConfig.ibd requires utxo=True: the persistent UTXO "
+                "watermark is the fetch planner's sync cursor"
+            )
 
 
 class Node:
@@ -276,8 +296,26 @@ class Node:
         # persistent UTXO set over the main store (NodeConfig.utxo); the
         # watermark survives restarts, so it must be read before ingest
         self.utxo: Optional[UtxoStore] = (
-            UtxoStore(Namespaced(cfg.store, UTXO_NAMESPACE))
+            UtxoStore(
+                Namespaced(cfg.store, UTXO_NAMESPACE),
+                undo_depth=cfg.utxo_undo_depth,
+            )
             if cfg.utxo
+            else None
+        )
+        # block-fetch-driven IBD planner (ISSUE 11): schedules getdata
+        # batches across the fleet from the watermark to the header tip
+        self.ibd: Optional[BlockFetcher] = (
+            BlockFetcher(
+                cfg.ibd,
+                net=cfg.net,
+                chain=self.chain,
+                peer_mgr=self.peer_mgr,
+                utxo=self.utxo,
+                pressure=self._ibd_pressure,
+                on_failure=self._component_failed,
+            )
+            if cfg.ibd is not None
             else None
         )
         # block connects serialize here: applies are atomic per block, but
@@ -396,6 +434,8 @@ class Node:
             await self._stack.enter_async_context(self.mempool)
         await self._stack.enter_async_context(self.chain)
         await self._stack.enter_async_context(self.peer_mgr)
+        if self.ibd is not None:
+            await self._stack.enter_async_context(self.ibd)
         self._tasks.link(self._chain_events(chain_sub), name="glue-chain")
         self._tasks.link(self._peer_events(peer_sub), name="glue-peer")
         self._started_at = _time.monotonic()
@@ -484,6 +524,8 @@ class Node:
             extra["mempool_orphans"] = self.mempool.orphan_count()
         if self.utxo is not None:
             extra["utxo_height"] = self.utxo.height
+        if self.ibd is not None:
+            extra["ibd_target"] = self.ibd.stats()["target"]
         return extra
 
     def _uptime(self) -> float:
@@ -594,6 +636,11 @@ class Node:
                 if self.utxo is not None
                 else {"enabled": False}
             ),
+            "ibd": (
+                self.ibd.stats()
+                if self.ibd is not None
+                else {"enabled": False}
+            ),
             "events": events.counts(),
         }
 
@@ -639,6 +686,25 @@ class Node:
             len(self._tx_accum) >= self.MAX_TX_ACCUM // 2
             or self._verify_pending >= self.MAX_VERIFY_PENDING
         )
+
+    def _ibd_pressure(self) -> bool:
+        """Should the IBD planner defer scheduling more block batches?
+        Half the shed bound: the planner can keep the pipeline saturated
+        but a delivery burst must never reach MAX_VERIFY_PENDING (every
+        shed block costs a refetch round-trip later)."""
+        return (
+            self._verify_pending >= self.MAX_VERIFY_PENDING // 2
+            or len(self._utxo_pending) >= self.MAX_UTXO_PENDING // 2
+        )
+
+    def _block_priority(self) -> str:
+        """Engine priority class for block verify submissions: planner-era
+        backfill runs at ``ibd`` (beneath live block/mempool traffic in
+        the lane packer, tpunode/verify/sched.py) so a syncing node still
+        serves fresh verdicts first; live pushed blocks keep ``block``."""
+        if self.ibd is not None and self.ibd.backfilling:
+            return "ibd"
+        return "block"
 
     def _prevout_oracle(self):
         """The prevout lookup the verify paths consult, in precedence
@@ -749,6 +815,10 @@ class Node:
                 if nxt is None:
                     break
                 await self._utxo_apply_one(self.utxo.height + 1, nxt)
+        if self.ibd is not None:
+            # the watermark may have moved: the planner retires finished
+            # batches and schedules further ahead
+            self.ibd.nudge()
 
     # Bound on parked out-of-order block connects (blocks are held alive
     # while parked; MAX_VERIFY_PENDING already bounds how many can be in
@@ -763,10 +833,13 @@ class Node:
         HASH-chain contiguity, not just height: after a reorg beneath the
         watermark, the new branch's block at watermark+1 does not extend
         the watermark block — applying it would stack the new branch's
-        deltas on the orphaned branch's UTXO state.  The set has no undo
-        log (ROADMAP), so it goes loudly STALE (``utxo.reorg_stale``)
-        and refuses further connects until the embedder rebuilds it
-        (delete the ``u/`` namespace and re-sync).
+        deltas on the orphaned branch's UTXO state.  The per-block UNDO
+        log (ISSUE 11) disconnects tip blocks back to the fork point when
+        the records are retained (``utxo.undo_depth``, default 100);
+        deeper reorgs keep the old behavior and go loudly STALE
+        (``utxo.reorg_stale``), refusing further connects until the
+        embedder rebuilds the set (delete the ``u/`` namespace and
+        re-sync).
 
         Note the watermark gates on the block's verdicts having been
         *published*, not on every signature being valid: this node is a
@@ -779,23 +852,46 @@ class Node:
             self.utxo.block_hash is not None
             and block.header.prev != self.utxo.block_hash
         ):
-            metrics.inc("utxo.reorg_stale")
-            events.emit(
-                "utxo.reorg_stale", height=height,
-                watermark=self.utxo.height,
-            )
-            log.error(
-                "[Node] UTXO set is STALE: block %d does not extend the "
-                "watermark block (reorg beneath height %d); rebuild the "
-                "UTXO namespace to resume",
-                height, self.utxo.height,
-            )
-            return
+            if await self._utxo_unwind_reorg(block):
+                # the watermark rolled back to this block's branch; the
+                # parked blocks were fetched against the OLD branch state
+                # and may now be stale — drop them, re-delivery heals
+                # (the fetch planner replans against the new best chain)
+                self._utxo_pending.clear()
+                bn = self.chain.get_block(block.header.hash)
+                expected = max(self.utxo.height + 1, 1)
+                if bn is None or bn.height < expected:
+                    metrics.inc("utxo.skipped")
+                    return
+                if bn.height > expected:
+                    # above the rolled-back watermark: park — its
+                    # predecessors on the new branch are being fetched
+                    if len(self._utxo_pending) < self.MAX_UTXO_PENDING:
+                        self._utxo_pending[bn.height] = block
+                        metrics.inc("utxo.deferred")
+                    return
+                height = bn.height
+                if (
+                    self.utxo.block_hash is not None
+                    and block.header.prev != self.utxo.block_hash
+                ):
+                    return  # unwound, but this block is on a third branch
+            else:
+                metrics.inc("utxo.reorg_stale")
+                events.emit(
+                    "utxo.reorg_stale", height=height,
+                    watermark=self.utxo.height,
+                )
+                log.error(
+                    "[Node] UTXO set is STALE: block %d does not extend "
+                    "the watermark block (reorg beneath height %d deeper "
+                    "than the undo retention); rebuild the UTXO namespace "
+                    "to resume",
+                    height, self.utxo.height,
+                )
+                return
         try:
-            txs = await asyncio.to_thread(lambda: list(block.txs))
-            await asyncio.to_thread(
-                self.utxo.apply_block, height, block.header.hash, txs
-            )
+            await self._utxo_connect_off_loop(height, block)
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -806,6 +902,77 @@ class Node:
             log.warning(
                 "[Node] utxo connect failed at height %d: %r", height, e
             )
+
+    async def _utxo_connect_off_loop(self, height: int, block) -> None:
+        """The physical connect, off-loop.  Native fast path (ISSUE 11):
+        the C++ extractor computes the whole spend/create delta + undo
+        rows in ONE pass over the wire bytes (``ParsedTxRegion.utxo_ops``
+        -> ``UtxoStore.apply_ops_blob``), so no Python per-tx parse ever
+        runs during block connect.  The Python ``apply_block`` path stays
+        the reference and the fallback (``TPUNODE_UTXO_NATIVE=0``, eager
+        blocks without raw bytes, no native toolchain); both produce
+        bit-identical stores (tests/test_utxo.py)."""
+        assert self.utxo is not None
+        raw = getattr(block, "raw_txs", None)
+        if (
+            raw is not None
+            and _native_extract_available()
+            and os.environ.get("TPUNODE_UTXO_NATIVE", "1") != "0"
+        ):
+            utxo = self.utxo
+            block_hash = block.header.hash
+            n_txs = block.tx_count
+
+            def connect_native():
+                from .txextract import ParsedTxRegion
+
+                with ParsedTxRegion(raw, n_txs) as region:
+                    blob, created, spent = region.utxo_ops()
+                    return utxo.apply_ops_blob(
+                        height, block_hash, blob, created, spent
+                    )
+
+            await self._run_extract(connect_native)
+        else:
+            txs = await asyncio.to_thread(lambda: list(block.txs))
+            await asyncio.to_thread(
+                self.utxo.apply_block, height, block.header.hash, txs
+            )
+
+    async def _utxo_unwind_reorg(self, block) -> bool:
+        """Disconnect tip blocks (per-block UNDO records, ISSUE 11) until
+        the watermark block lies on ``block``'s branch — the fork point.
+        True when the unwind reached it; False (store untouched beyond
+        any blocks already unwound) when an undo record is missing
+        (reorg deeper than retention) or the branch is unknown — the
+        caller then falls back to loudly-stale."""
+        assert self.utxo is not None
+        bn = self.chain.get_block(block.header.hash)
+        if bn is None:
+            return False
+        unwound = 0
+        start = self.utxo.height
+        while self.utxo.height >= 0:
+            wm_hash = self.utxo.block_hash
+            if wm_hash is not None and self.utxo.height <= bn.height:
+                anc = self.chain.get_ancestor(self.utxo.height, bn)
+                if anc is not None and anc.hash == wm_hash:
+                    break  # the watermark is an ancestor: fork reached
+            ok = await asyncio.to_thread(self.utxo.disconnect)
+            if not ok:
+                return False
+            unwound += 1
+        if unwound:
+            metrics.inc("utxo.reorg_unwound")
+            events.emit(
+                "utxo.reorg_unwound", from_height=start,
+                to_height=self.utxo.height, blocks=unwound,
+            )
+            log.info(
+                "[Node] reorg: disconnected %d block(s), watermark %d -> %d",
+                unwound, start, self.utxo.height,
+            )
+        return True
 
     def _count_unhandled(self, msg) -> None:
         """A peer message the event router has no handler for: count it
@@ -835,6 +1002,9 @@ class Node:
                     # chain activity triggers mempool housekeeping
                     # (orphan expiry, deferred fetch scheduling)
                     self.mempool.chain_event(event)
+                if self.ibd is not None:
+                    # new headers extend the fetch planner's target
+                    self.ibd.nudge()
             self.cfg.pub.publish(event)
 
     async def _peer_events(self, sub) -> None:
@@ -851,6 +1021,9 @@ class Node:
                 if self.mempool is not None:
                     # release in-flight fetch slots + announcer entries
                     self.mempool.peer_gone(event.peer)
+                if self.ibd is not None:
+                    # in-flight block batches reassign to another peer
+                    self.ibd.peer_gone(event.peer)
             elif isinstance(event, PeerMessage):
                 p, msg = event.peer, event.message
                 if isinstance(msg, MsgVersion):
@@ -1366,10 +1539,7 @@ class Node:
             with span("node.extract"):
                 try:
                     # shared worker pool (ISSUE 10): several blocks'
-                    # regions parse/extract in parallel (each block keeps
-                    # ONE region — the intra-block prevout map is
-                    # whole-region by construction, so tx-range sharding
-                    # applies to the independent mempool batches only)
+                    # regions parse/extract in parallel
                     region = await self._run_extract(
                         ParsedTxRegion, raw, n_txs
                     )
@@ -1385,15 +1555,32 @@ class Node:
                 # block_outs -> prevout_lookup precedence (an in-block hit
                 # shadows whatever the oracle would have said).
                 ext, ext_scripts = self._resolve_ext_rows(region, bch)
+                # BLOCK regions shard across the worker pool as contiguous
+                # tx ranges (ISSUE 11), exactly like mempool drains: the
+                # intra-block prevout map is built ONCE on the shared
+                # handle (read-only for the range jobs), so sharded
+                # extraction is bit-identical to serial (pinned by
+                # tests/test_txextract.py).
+                shard_block = (
+                    block is not None
+                    and self._extract_workers > 1
+                    and region.n_txs >= 2 * self.MIN_SHARD_TXS
+                )
                 try:
-                    submitted = True
-                    items = await self._run_extract_owned(
-                        region,
-                        bch=bch,
-                        intra_amounts=n_txs > 1,
-                        ext_amounts=ext,
-                        ext_scripts=ext_scripts,
-                    )
+                    if shard_block:
+                        submitted = True
+                        shards = await self._extract_block_sharded(
+                            region, bch, ext, ext_scripts
+                        )
+                    else:
+                        submitted = True
+                        shards = [await self._run_extract_owned(
+                            region,
+                            bch=bch,
+                            intra_amounts=n_txs > 1,
+                            ext_amounts=ext,
+                            ext_scripts=ext_scripts,
+                        )]
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
@@ -1404,38 +1591,26 @@ class Node:
                 # The txids come from the native extract — no Python
                 # parse — and arrive before the verdicts do.
                 self.mempool.confirmed(
-                    [items.txid(ti) for ti in range(items.n_txs)]
+                    [it.txid(ti) for it in shards
+                     for ti in range(it.n_txs)]
                 )
-            metrics.inc("node.verify_txs", items.n_txs)
-            metrics.inc("node.verify_inputs", int(items.tx_n_inputs.sum()))
-            verdicts: list[bool] = []
-            if items.count:
-                try:
-                    # block ingest outranks mempool relay in the packer
-                    verdicts = await self.verify_engine.verify_raw(
-                        items,
-                        priority="block" if block is not None else "mempool",
-                    )
-                except asyncio.CancelledError:
-                    raise
-                except Exception as e:
-                    self._verify_failure("engine", e)
-                    for ti in range(items.n_txs):
-                        self._publish_verdict(
-                            TxVerdict(peer, items.txid(ti), False, (),
-                                      items.stats(ti), error=f"engine: {e}")
-                        )
-                    return
-            # candidate verdicts -> per-signature verdicts (consensus walk)
-            with span("node.commit"):
-                per_sig = items.combine(verdicts)
-                for ti, sl in enumerate(items.sig_slices()):
-                    vs = tuple(per_sig[sl])
-                    self._publish_verdict(
-                        TxVerdict(peer, items.txid(ti), all(vs), vs,
-                                  items.stats(ti))
-                    )
-            if block is not None:
+            metrics.inc(
+                "node.verify_txs", sum(it.n_txs for it in shards)
+            )
+            metrics.inc(
+                "node.verify_inputs",
+                sum(int(it.tx_n_inputs.sum()) for it in shards),
+            )
+            # every shard is its own engine submission (the lane packer
+            # coalesces them into full device lanes); planner-era
+            # backfill rides the "ibd" class beneath live traffic
+            priority = (
+                self._block_priority() if block is not None else "mempool"
+            )
+            clean = all(await asyncio.gather(*(
+                self._commit_items(peer, it, priority) for it in shards
+            )))
+            if block is not None and clean:
                 # persistent UTXO connect only AFTER the block's verdicts
                 # are published: the watermark means "verified AND
                 # applied", so a crash mid-verify must leave the block
@@ -1449,6 +1624,102 @@ class Node:
                 self._verify_pending -= 1
             # the item's pipeline trace (if any) ends with its verdicts
             _finish_active_trace()
+
+    async def _commit_items(self, peer, items, priority: str) -> bool:
+        """Engine round + verdict publication for one RawSigItems batch
+        (a whole message, or one tx-range shard of a block).  Returns
+        False when the engine failed (error verdicts published)."""
+        assert self.verify_engine is not None
+        verdicts: list[bool] = []
+        if items.count:
+            try:
+                verdicts = await self.verify_engine.verify_raw(
+                    items, priority=priority
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self._verify_failure("engine", e)
+                for ti in range(items.n_txs):
+                    self._publish_verdict(
+                        TxVerdict(peer, items.txid(ti), False, (),
+                                  items.stats(ti), error=f"engine: {e}")
+                    )
+                return False
+        # candidate verdicts -> per-signature verdicts (consensus walk)
+        with span("node.commit"):
+            per_sig = items.combine(verdicts)
+            for ti, sl in enumerate(items.sig_slices()):
+                vs = tuple(per_sig[sl])
+                self._publish_verdict(
+                    TxVerdict(peer, items.txid(ti), all(vs), vs,
+                              items.stats(ti))
+                )
+        return True
+
+    async def _extract_block_sharded(self, region, bch: bool, ext,
+                                     ext_scripts) -> list:
+        """Split a parsed BLOCK region into contiguous per-worker
+        tx-range sub-extractions (ISSUE 11).  The shared intra-block
+        prevout map is built once (off-loop) before the range jobs go to
+        the pool; each job's oracle rows are the range's slice of the
+        whole-region rows.  Close ownership is collective: the region is
+        freed when the LAST submitted job finishes (or every queued job
+        is cancelled before running) — never under a live extract."""
+        n = region.n_txs
+        w = min(self._extract_workers, n // self.MIN_SHARD_TXS)
+        if n > 1:
+            await self._run_extract(region.build_intra)
+        off = region.input_offsets()
+        size = (n + w - 1) // w
+        jobs = []
+        for lo in range(0, n, size):
+            hi = min(lo + size, n)
+            fl, fh = int(off[lo]), int(off[hi])
+            jobs.append(functools.partial(
+                region.extract_range, lo, hi,
+                bch=bch,
+                intra_amounts=n > 1,
+                ext_amounts=ext[fl:fh] if ext is not None else None,
+                ext_scripts=(
+                    ext_scripts[fl:fh] if ext_scripts is not None else None
+                ),
+            ))
+        assert self._extract_pool is not None  # built with the engine
+        cfuts = []
+        try:
+            for job in jobs:
+                cfuts.append(self._extract_pool.submit(job))
+        finally:
+            self._close_when_done(region, cfuts)
+        return list(await asyncio.gather(
+            *(asyncio.wrap_future(f) for f in cfuts)
+        ))
+
+    @staticmethod
+    def _close_when_done(region, cfuts: list) -> None:
+        """Free a shared region handle once every submitted job is out of
+        the pool (finished OR cancelled-before-running).  The callbacks
+        watch the CONCURRENT futures — the only signal that cannot fire
+        while a worker thread still holds the handle (the same
+        use-after-free discipline as `_run_extract_owned`)."""
+        if not cfuts:
+            region.close()
+            return
+        import threading
+
+        state = {"remaining": len(cfuts)}
+        lock = threading.Lock()
+
+        def _done(_f):
+            with lock:
+                state["remaining"] -= 1
+                last = state["remaining"] == 0
+            if last:
+                region.close()
+
+        for f in cfuts:
+            f.add_done_callback(_done)
 
     async def _verify_txs(self, peer, txs: list[Tx], block=None) -> None:
         """Verify every tx of one message.  All txs' signatures are submitted
@@ -1524,7 +1795,8 @@ class Node:
                             self.verify_engine.verify(
                                 [i.verify_item for i in items],
                                 priority=(
-                                    "block" if block is not None
+                                    self._block_priority()
+                                    if block is not None
                                     else "mempool"
                                 ),
                             ),

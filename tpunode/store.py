@@ -34,12 +34,13 @@ style namespacing — with two Python engines:
   once the batch is on disk (acked ⇒ durable), the event loop never blocks
   on ``os.fsync``, and batches queued while one fsync runs coalesce into the
   next (one fsync amortized over the group);
-* v1 logs (the pre-v2 single-file format, still written by the C++
-  ``NativeKV``) replay bit-identically under the v2 reader; new writes go to
-  v2 segments and the first compaction rewrites everything as a v2
-  snapshot.  ``open_store`` version-gates the engines: ``NativeKV`` refuses
-  a directory with v2 artifacts (tpunode/native.py), and ``auto`` picks the
-  engine that can actually read what is on disk.
+* v1 logs (the pre-v2 single-file format, what the C++ ``NativeKV`` writes
+  on fresh paths) replay bit-identically under the v2 reader; new writes go
+  to v2 segments and the first compaction rewrites everything as a v2
+  snapshot.  The C++ engine reads AND appends the v2 format too (ISSUE 11,
+  tpunode/native.py) — ``auto`` still prefers :class:`LogKV` for v2
+  directories (group-commit async writes, quarantining salvage), with the
+  native engine an explicit opt-in.
 
 A C++ engine (``native/kvstore``) plugs in behind the same protocol via
 :func:`open_store` once built; see native/kvstore/README.
@@ -983,15 +984,16 @@ class Namespaced:
 def open_store(path: Optional[str], engine: str = "auto") -> KVStore:
     """Open a store: ``None`` -> in-memory; else durable at ``path``.
 
-    ``engine`` may be ``auto``/``native``/``log``/``memory``.  The engines
-    are version-gated (ISSUE 9): :class:`LogKV` writes crash-consistent
-    v2 segments the v1-only C++ engine cannot read, so
+    ``engine`` may be ``auto``/``native``/``log``/``memory``:
 
     * ``auto`` opens an **existing v1 single-file log** with the native
       engine when its shared library builds (compat with stores it wrote),
-      and everything else — fresh paths and v2 stores — with :class:`LogKV`;
-    * ``native`` raises :class:`StoreVersionError` on a v2 directory
-      rather than silently reading a stale subset of the data.
+      and everything else — fresh paths and v2 stores — with :class:`LogKV`
+      (async group-commit writes, quarantining salvage);
+    * ``native`` opens v1 files AND v2 directories with the C++ engine
+      (ISSUE 11); it raises :class:`StoreVersionError` only on mid-log
+      damage or a newer-than-v2 format, where LogKV's salvage/reader is
+      required — never silently serving a stale subset of the data.
     """
     if path is None or engine == "memory":
         return MemoryKV()

@@ -137,6 +137,32 @@ def load_txextract_lib() -> ctypes.CDLL:
             i32, i32, i32, i32, i32, i32,  # item_*
             u8, i32, i32, i32, i32, i32, i32,  # txids + tx_*
         ]
+        # tx-range sharding (ISSUE 11): shared intra map + range extraction
+        lib.txx_build_intra_h.restype = ctypes.c_long
+        lib.txx_build_intra_h.argtypes = [ctypes.c_void_p]
+        lib.txx_tx_layout_h.restype = ctypes.c_long
+        lib.txx_tx_layout_h.argtypes = [ctypes.c_void_p, i32, i32]
+        lib.txx_extract_range_h.restype = ctypes.c_long
+        lib.txx_extract_range_h.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_long,   # ext_amounts, n_ext
+            ctypes.c_void_p, ctypes.c_void_p,  # ext_scripts, ext_script_off
+            ctypes.c_long, ctypes.c_long,      # tx_lo, tx_hi
+            ctypes.c_long,
+            u8, u8, u8, u8, u8, u8,  # z px py r s present
+            i32, i32, i32, i32, i32, i32,  # item_*
+            u8, i32, i32, i32, i32, i32, i32,  # txids + tx_*
+        ]
+        # native UTXO block-connect (ISSUE 11)
+        lib.txx_utxo_size_h.restype = ctypes.c_long
+        lib.txx_utxo_size_h.argtypes = [ctypes.c_void_p]
+        lib.txx_utxo_ops_h.restype = ctypes.c_long
+        lib.txx_utxo_ops_h.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint8, ctypes.c_long, u8,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.txx_txids_h.restype = ctypes.c_long
+        lib.txx_txids_h.argtypes = [ctypes.c_void_p, u8]
         lib._ext_amounts_t = i64  # kept for callers building arrays
         _lib = lib
         return lib
@@ -318,6 +344,7 @@ class ParsedTxRegion:
         self.n_txs = int(self._lib.txx_parsed_txs(self._h))
         self.capacity = int(self._lib.txx_parsed_capacity(self._h))
         self.n_inputs = int(self._lib.txx_parsed_inputs(self._h))
+        self._layout: Optional[tuple] = None
 
     def close(self) -> None:
         if self._h:
@@ -352,6 +379,68 @@ class ParsedTxRegion:
             raise ValueError(f"txx_prevouts_h failed ({n})")
         return txids[:n], vouts[:n], wants[:n]
 
+    # -- tx-range sharding (ISSUE 11) ---------------------------------------
+
+    def build_intra(self) -> int:
+        """Build the handle's shared whole-region intra-block prevout map
+        (idempotent; returns its size).  MUST run before concurrent
+        :meth:`extract_range` calls with ``intra_amounts=True`` — ranges
+        extract on worker threads and only the pre-built map is
+        read-only."""
+        assert self._h, "region closed"
+        return int(self._lib.txx_build_intra_h(self._h))
+
+    def tx_layout(self) -> tuple[np.ndarray, np.ndarray]:
+        """Per-tx ``(n_inputs, item_capacity)`` int32 rows (cached): the
+        shard planner derives range capacities and the flat oracle-row
+        offsets (cumsum of inputs) from these."""
+        assert self._h, "region closed"
+        if self._layout is None:
+            n = max(1, self.n_txs)
+            n_in = np.zeros(n, np.int32)
+            cap = np.zeros(n, np.int32)
+            got = int(self._lib.txx_tx_layout_h(self._h, n_in, cap))
+            self._layout = (n_in[:got], cap[:got])
+        return self._layout
+
+    def input_offsets(self) -> np.ndarray:
+        """Flat-input offset of each tx (n_txs + 1 rows): tx ``i``'s
+        inputs occupy oracle rows ``[off[i], off[i+1])``."""
+        n_in, _ = self.tx_layout()
+        off = np.zeros(len(n_in) + 1, np.int64)
+        np.cumsum(n_in, out=off[1:])
+        return off
+
+    def extract_range(
+        self,
+        tx_lo: int,
+        tx_hi: int,
+        bch: bool = False,
+        intra_amounts: bool = True,
+        ext_amounts: Optional[Sequence[int]] = None,
+        ext_scripts: Optional[Sequence[Optional[bytes]]] = None,
+    ) -> RawSigItems:
+        """Extract only txs ``[tx_lo, tx_hi)`` of the region — the shard
+        body of parallel BLOCK extraction (node._verify_txs_native).
+
+        The oracle rows (``ext_amounts``/``ext_scripts``) are the RANGE's
+        rows: slice the whole-region rows with :meth:`input_offsets`.
+        Results are self-contained (per-tx arrays and ``item_tx`` indexed
+        from ``tx_lo``).  With ``intra_amounts``, :meth:`build_intra`
+        must have run first; in-block spends then resolve across range
+        boundaries exactly like the whole-region extract — sharded
+        extraction is bit-identical to serial (tests/test_txextract.py).
+        """
+        assert self._h, "region closed"
+        if not (0 <= tx_lo <= tx_hi <= self.n_txs):
+            raise ValueError(f"bad tx range [{tx_lo}, {tx_hi})")
+        _, caps = self.tx_layout()
+        capacity = max(1, int(caps[tx_lo:tx_hi].sum()))
+        return self._extract_impl(
+            tx_lo, tx_hi, capacity, bch, intra_amounts, ext_amounts,
+            ext_scripts,
+        )
+
     def extract(
         self,
         bch: bool = False,
@@ -367,8 +456,22 @@ class ParsedTxRegion:
         keypath spend is detected from the prevout script and its BIP341
         digest signs over every input's amount AND script."""
         assert self._h, "region closed"
-        capacity = max(1, self.capacity)
-        nt = max(1, self.n_txs)
+        return self._extract_impl(
+            0, self.n_txs, max(1, self.capacity), bch, intra_amounts,
+            ext_amounts, ext_scripts,
+        )
+
+    def _extract_impl(
+        self,
+        tx_lo: int,
+        tx_hi: int,
+        capacity: int,
+        bch: bool,
+        intra_amounts: bool,
+        ext_amounts: Optional[Sequence[int]],
+        ext_scripts: Optional[Sequence[Optional[bytes]]],
+    ) -> RawSigItems:
+        nt = max(1, tx_hi - tx_lo)
         out = RawSigItems(
             count=0,
             z=np.zeros((capacity, 32), np.uint8),
@@ -421,8 +524,9 @@ class ParsedTxRegion:
             concat = off = None  # noqa: F841 — keep alive through the call
             scr_ptr = None
             off_ptr = None
-        count = self._lib.txx_extract_h2(
-            self._h, flags, ext_ptr, n_ext, scr_ptr, off_ptr, capacity,
+        count = self._lib.txx_extract_range_h(
+            self._h, flags, ext_ptr, n_ext, scr_ptr, off_ptr,
+            tx_lo, tx_hi, capacity,
             out.z, out.px, out.py, out.r, out.s, out.present,
             out.item_tx, out.item_input,
             out.item_sig, out.item_key, out.item_nsigs, out.item_nkeys,
@@ -431,7 +535,7 @@ class ParsedTxRegion:
             out.tx_coinbase, out.tx_unsupported,
         )
         if count < 0:
-            raise ValueError(f"txx_extract_h2 failed ({count})")
+            raise ValueError(f"txx_extract_range_h failed ({count})")
         # trim to the actual item count (views, no copies)
         out.count = int(count)
         for name in (
@@ -440,13 +544,45 @@ class ParsedTxRegion:
             "item_nsigs", "item_nkeys",
         ):
             setattr(out, name, getattr(out, name)[:count])
-        # per-tx arrays keep their true n_txs length
+        # per-tx arrays keep their true range length
         for name in (
             "txids", "tx_n_inputs", "tx_extracted", "tx_items", "tx_sigs",
             "tx_coinbase", "tx_unsupported",
         ):
-            setattr(out, name, getattr(out, name)[: self.n_txs])
+            setattr(out, name, getattr(out, name)[: tx_hi - tx_lo])
         return out
+
+    # -- native UTXO block-connect (ISSUE 11) -------------------------------
+
+    def utxo_ops(self, prefix: bytes = b"o") -> tuple[bytes, int, int]:
+        """The region's UTXO delta as a ready batch blob: v1-record-format
+        ``op(u8) klen(u32le) vlen(u32le) key value`` rows — creates
+        (``prefix ++ txid ++ vout_le32`` -> ``amount_le64 ++ script``)
+        before spends (deletes), whole-region, coinbase inputs skipped —
+        exactly ``UtxoStore.apply_block``'s semantics with zero Python
+        per-tx work.  Returns ``(blob, n_created, n_spent)``."""
+        assert self._h, "region closed"
+        if len(prefix) != 1:
+            raise ValueError("prefix must be a single byte")
+        size = int(self._lib.txx_utxo_size_h(self._h))
+        buf = np.zeros(max(1, size), np.uint8)
+        created = ctypes.c_long()
+        spent = ctypes.c_long()
+        n = self._lib.txx_utxo_ops_h(
+            self._h, prefix[0], size, buf,
+            ctypes.byref(created), ctypes.byref(spent),
+        )
+        if n < 0:
+            raise ValueError(f"txx_utxo_ops_h failed ({n})")
+        return buf[:n].tobytes(), int(created.value), int(spent.value)
+
+    def txids(self) -> np.ndarray:
+        """All parsed txids as an ``(n_txs, 32)`` uint8 array — no Python
+        parse, no extraction."""
+        assert self._h, "region closed"
+        out = np.zeros((max(1, self.n_txs), 32), np.uint8)
+        n = int(self._lib.txx_txids_h(self._h, out))
+        return out[:n]
 
 
 def extract_raw(

@@ -10,9 +10,11 @@ submission's items could have filled.
 
 This module owns the queue instead:
 
-* **Priority classes** — ``block`` > ``mempool`` > ``bulk``.  Block-ingest
-  items always pack (and therefore dispatch) ahead of mempool relay,
-  which packs ahead of bulk/re-index traffic.  Within a class, FIFO.
+* **Priority classes** — ``block`` > ``mempool`` > ``ibd`` > ``bulk``.
+  Live block-ingest items always pack (and therefore dispatch) ahead of
+  mempool relay, which packs ahead of IBD backfill (ISSUE 11: the fetch
+  planner's historical blocks must not starve fresh traffic), which packs
+  ahead of bulk/re-index traffic.  Within a class, FIFO.
 * **Cross-submission packing** — :meth:`LanePacker.pop_lane` slices
   queued payloads so every lane is exactly ``target`` items (the
   compiled device shape) regardless of how the work arrived.  One
@@ -49,9 +51,11 @@ __all__ = [
     "LanePacker",
 ]
 
-# Dispatch order under saturation: block ingest outranks mempool relay
+# Dispatch order under saturation: live block ingest outranks mempool
+# relay, which outranks IBD backfill (planner-fetched historical blocks,
+# ISSUE 11 — a syncing node keeps serving fresh verdicts first), which
 # outranks bulk (API default / re-index) traffic.
-PRIORITIES = ("block", "mempool", "bulk")
+PRIORITIES = ("block", "mempool", "ibd", "bulk")
 
 # Linear occupancy buckets (0.05 steps): lane occupancy lives in [0, 1],
 # which the duration-shaped default bounds would quantize uselessly.
