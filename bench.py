@@ -254,6 +254,8 @@ def _worker_bench() -> None:
             kernel_name = "xla"
 
         from benchmarks.common import device_kind, make_triples, tile
+        from tpunode.verify import field as _field
+        from tpunode.verify import kernel as _kernel_mod
         from tpunode.verify.curve import point_form as _point_form
         from tpunode.verify.ecdsa_cpu import verify_batch_cpu
 
@@ -333,6 +335,8 @@ def _worker_bench() -> None:
                     "device": device_kind(),
                     "kernel": kernel_name,
                     "point_form": _point_form(),
+                    "field_reduce": _field.reduce_mode(),
+                    "window_bits": _kernel_mod.window_bits(),
                     "batch": batch,
                     "step_ms": round(dt * 1e3, 3),
                     "compile_s": round(compile_s, 1),
@@ -1357,19 +1361,25 @@ def _worker_ibd_child() -> None:
 
 
 def _worker_kernel_ab() -> None:
-    """Kernel point-form A/B worker (ISSUE 8): projective vs affine XLA
-    step time at one batch size on cpu-jax, in a bounded subprocess.
+    """Kernel formulation A/B worker: XLA step times on cpu-jax, in a
+    bounded subprocess, cells timed ROUND-ROBIN so host-load drift hits
+    every cell equally (the PERF r6 lesson: sequential per-process runs
+    on this box swing ±75%).
 
-    Both forms compile first (persistent cache), verdicts cross-check
-    against the C++ engine (a mismatch is FATAL — an A/B must never
-    time a wrong program), then the timed steps run ROUND-ROBIN so
-    host-load drift hits both forms equally (the PERF r6 lesson:
-    sequential per-process runs on this box swing ±75%).  Prints one
-    JSON line with median-of-N + spread per form, like
-    ``baseline_cpu_single_core``.
+    Two grids behind TPUNODE_BENCH_KERNELAB_MODE:
+
+    * ``forms`` (default, ISSUE 8): projective vs affine point form.
+    * ``reduce`` (ISSUE 12): the field_reduce x window_bits grid
+      (eager/lazy x 4/5) at the default point form.
+
+    Every cell compiles first (persistent cache) and cross-checks its
+    verdicts against the C++ engine (a mismatch is FATAL — an A/B must
+    never time a wrong program).  Prints one JSON line with
+    median-of-N + spread per cell, like ``baseline_cpu_single_core``.
     """
     batch = int(os.environ.get("TPUNODE_BENCH_KERNELAB_BATCH", 1024))
     iters = int(os.environ.get("TPUNODE_BENCH_KERNELAB_ITERS", 5))
+    mode = os.environ.get("TPUNODE_BENCH_KERNELAB_MODE", "forms")
     try:
         import jax
         import jax.numpy as jnp
@@ -1381,6 +1391,8 @@ def _worker_kernel_ab() -> None:
         enable_compile_cache()
         from benchmarks.common import make_triples, tile
         from tpunode.verify import curve as C
+        from tpunode.verify import field as F
+        from tpunode.verify import kernel as K
         from tpunode.verify.cpu_native import load_native_verifier
         from tpunode.verify.ecdsa_cpu import verify_batch_cpu
         from tpunode.verify.kernel import (
@@ -1391,57 +1403,83 @@ def _worker_kernel_ab() -> None:
 
         base = make_triples(min(UNIQUE, batch))
         items = tile(base, batch)
-        prep = prepare_batch(items, pad_to=batch)
-        args = tuple(jnp.asarray(a) for a in prep.device_args)
         native = load_native_verifier()
         expect = (
             native.verify_batch(base)
             if native is not None
             else verify_batch_cpu(base)
         )
-        forms = ("projective", "affine")
-        stats: dict = {f: {"times": []} for f in forms}
-        for form in forms:
-            C.set_point_form(form)
-            _progress(f"compiling {form} XLA program at batch {batch}...")
+
+        # (label, setter) per cell.  Args are prepared per cell: the
+        # 5-bit cells carry 27-row digit arrays (and Python host prep).
+        if mode == "reduce":
+            def setter_for(red, wb):
+                def set_modes():
+                    F.set_field_modes(reduce=red)
+                    K.set_kernel_modes(window_bits=wb)
+                return set_modes
+
+            cells = [
+                (f"{red}@w{wb}", setter_for(red, wb))
+                for red in ("eager", "lazy")
+                for wb in (4, 5)
+            ]
+            delta_keys = ("lazy@w4", "eager@w4", "lazy_vs_eager")
+        else:
+            cells = [
+                (form, (lambda f=form: C.set_point_form(f)))
+                for form in ("projective", "affine")
+            ]
+            delta_keys = ("affine", "projective", "affine_vs_projective")
+        stats: dict = {label: {"times": []} for label, _ in cells}
+        cell_args: dict = {}
+        for label, set_modes in cells:
+            set_modes()
+            prep = prepare_batch(items, pad_to=batch)
+            cell_args[label] = tuple(
+                jnp.asarray(a) for a in prep.device_args
+            )
+            _progress(f"compiling {label} XLA program at batch {batch}...")
             t0 = time.perf_counter()
-            out = verify_device(*args)
+            out = verify_device(*cell_args[label])
             got = collect_verdicts(out, len(base))
-            stats[form]["compile_s"] = round(time.perf_counter() - t0, 1)
+            stats[label]["compile_s"] = round(time.perf_counter() - t0, 1)
             if got != expect:
                 print(
                     json.dumps(
                         {"ok": False, "fatal": True,
-                         "error": f"{form}/oracle verdict mismatch"}
+                         "error": f"{label}/oracle verdict mismatch"}
                     )
                 )
                 return
         for i in range(iters):
             _progress(f"timed round {i + 1}/{iters}...")
-            for form in forms:
-                C.set_point_form(form)
+            for label, set_modes in cells:
+                set_modes()
                 t0 = time.perf_counter()
-                verify_device(*args).block_until_ready()
-                stats[form]["times"].append(time.perf_counter() - t0)
+                verify_device(*cell_args[label]).block_until_ready()
+                stats[label]["times"].append(time.perf_counter() - t0)
         section: dict = {
             "ok": True,
             "batch": batch,
             "proxy": "cpu-jax",
             "iters": iters,
+            "mode": mode,
             "forms": {},
         }
-        for form in forms:
-            ts = stats[form]["times"]
-            section["forms"][form] = {
+        for label, _ in cells:
+            ts = stats[label]["times"]
+            section["forms"][label] = {
                 "step_ms": round(statistics.median(ts) * 1e3, 1),
                 "step_ms_min": round(min(ts) * 1e3, 1),
                 "step_ms_max": round(max(ts) * 1e3, 1),
                 "spread_rel": round(max(ts) / min(ts) - 1.0, 3),
-                "compile_s": stats[form]["compile_s"],
+                "compile_s": stats[label]["compile_s"],
             }
-        proj = section["forms"]["projective"]["step_ms"]
-        aff = section["forms"]["affine"]["step_ms"]
-        section["affine_vs_projective"] = round(aff / proj - 1.0, 4)
+        a_key, b_key, delta_name = delta_keys
+        a = section["forms"][a_key]["step_ms"]
+        b = section["forms"][b_key]["step_ms"]
+        section[delta_name] = round(a / b - 1.0, 4)
         print(json.dumps(section))
     except Exception as e:  # noqa: BLE001 — worker reports, parent decides
         print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
@@ -1486,6 +1524,29 @@ def _kernel_section() -> dict:
                 out[key]["fatal"] = True
         else:
             out[key] = res
+    # ISSUE 12: the field_reduce x window_bits grid at 1024, in its own
+    # bounded worker so a timed-out grid is labeled without costing the
+    # point-form cells (or the headline).
+    if T_KERNEL_AB > 0:
+        res = _run_worker(
+            "--kernel-ab", T_KERNEL_AB * 2,
+            {"JAX_PLATFORMS": "cpu",
+             "TPUNODE_BENCH_KERNELAB_BATCH": "1024",
+             "TPUNODE_BENCH_KERNELAB_MODE": "reduce"},
+        )
+        key = "reduce_window_batch_1024"
+        if not res.get("ok") and "error" in res:
+            out[key] = {"ok": False, "error": str(res["error"])[:300]}
+            if res.get("fatal"):
+                out[key]["fatal"] = True
+        else:
+            out[key] = res
+    else:
+        out["reduce_window_batch_1024"] = {
+            "ok": False,
+            "error": "disabled by operator: "
+                     "TPUNODE_BENCH_KERNELAB_TIMEOUT <= 0",
+        }
     return out
 
 

@@ -138,29 +138,35 @@ def run_campaign(
     field_mul: str | None = None,
     field_sqr: str | None = None,
     point_form: str | None = None,
+    field_reduce: str | None = None,
+    window_bits: int | None = None,
 ) -> dict:
     """Build the pool and compare the chosen device program against the
     C++ verifier AND each shape's required verdict.  Returns the result
     dict (``mismatches`` MUST be 0).  ``field_mul``/``field_sqr`` select
-    the limb-product formulation and ``point_form`` the MSM point form
-    (ISSUE 8) process-wide (None keeps the active mode); every dispatch
-    path retraces per mode."""
+    the limb-product formulation, ``point_form`` the MSM point form
+    (ISSUE 8), ``field_reduce`` the reduction discipline and
+    ``window_bits`` the MSM window width (ISSUE 12) process-wide (None
+    keeps the active mode); every dispatch path retraces per mode."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
     from tpunode.verify import curve as C
     from tpunode.verify import field as F
+    from tpunode.verify import kernel as K
     from tpunode.verify.cpu_native import load_native_verifier
     from tpunode.verify.ecdsa_cpu import verify_batch_cpu
     from tpunode.verify.engine import enable_compile_cache
     from tpunode.verify.kernel import verify_batch_tpu
 
     enable_compile_cache()
-    if field_mul is not None or field_sqr is not None:
-        F.set_field_modes(mul=field_mul, sqr=field_sqr)
+    if field_mul is not None or field_sqr is not None or field_reduce is not None:
+        F.set_field_modes(mul=field_mul, sqr=field_sqr, reduce=field_reduce)
     if point_form is not None:
         C.set_point_form(point_form)
+    if window_bits is not None:
+        K.set_kernel_modes(window_bits=window_bits)
     if pallas:
         import jax.numpy as jnp
 
@@ -211,8 +217,13 @@ def run_campaign(
         "mismatches": len(mismatches),
         "mismatch_detail": mismatches[:10],
         "kernel": "pallas-interpret" if pallas else "xla",
-        "field_modes": {"mul": F.mul_mode(), "sqr": F.sqr_mode()},
+        "field_modes": {
+            "mul": F.mul_mode(),
+            "sqr": F.sqr_mode(),
+            "reduce": F.reduce_mode(),
+        },
         "point_form": C.point_form(),
+        "window_bits": K.window_bits(),
         "gen_s": round(gen_s, 1),
         "run_s": round(run_s, 1),
         "oracle": "native-cpp" if native is not None else "python",
@@ -223,7 +234,8 @@ def run_campaign(
 
 def main() -> None:
     pallas = "--pallas" in sys.argv
-    field_mul = field_sqr = point_form = None
+    field_mul = field_sqr = point_form = field_reduce = None
+    window_bits = None
     pos = []
     args = list(sys.argv[1:])
     while args:
@@ -240,6 +252,18 @@ def main() -> None:
             if not args:
                 sys.exit("--point-form needs a value (projective|affine)")
             point_form = args.pop(0)
+        elif a.startswith("--field-reduce="):
+            field_reduce = a.split("=", 1)[1]
+        elif a == "--field-reduce":  # ISSUE 12 spells it space-separated
+            if not args:
+                sys.exit("--field-reduce needs a value (eager|lazy)")
+            field_reduce = args.pop(0)
+        elif a.startswith("--window-bits="):
+            window_bits = int(a.split("=", 1)[1])
+        elif a == "--window-bits":
+            if not args:
+                sys.exit("--window-bits needs a value (4|5)")
+            window_bits = int(args.pop(0))
         else:
             pos.append(a)
     n_base = int(pos[0]) if pos else (32 if pallas else 256)
@@ -249,7 +273,8 @@ def main() -> None:
                  f"interpret block (got {batch})")
     res = run_campaign(n_base, batch, pallas=pallas,
                        field_mul=field_mul, field_sqr=field_sqr,
-                       point_form=point_form)
+                       point_form=point_form, field_reduce=field_reduce,
+                       window_bits=window_bits)
     print(json.dumps(res))
     if res["mismatches"]:
         sys.exit(1)

@@ -77,7 +77,9 @@ def run_json_subprocess(
 
 
 def worker_rung_env(batch: int, kernel: str | None = None,
-                    point_form: str | None = None):
+                    point_form: str | None = None,
+                    field_reduce: str | None = None,
+                    window_bits: int | None = None):
     """Env + display label for one device-ladder rung.
 
     Shared by bench.py's round-end ladder and benchmarks/watcher.py (the
@@ -85,7 +87,9 @@ def worker_rung_env(batch: int, kernel: str | None = None,
     one place: ``kernel`` None means auto-select (pallas on TPU), "xla"
     forces the portable XLA program (the Mosaic-outage fallback);
     ``point_form`` selects the MSM point form (ISSUE 8 — the watcher's
-    affine rungs ride this; None keeps the worker's process default).
+    affine rungs ride this); ``field_reduce``/``window_bits`` select the
+    ISSUE 12 lazy-reduction / window-width formulation (the watcher's
+    ``kind="lazy"`` rungs).  None keeps the worker's process default.
     """
     env = {"TPUNODE_BENCH_BATCH": str(batch),
            "TPUNODE_BENCH_REQUIRE_TPU": "1"}
@@ -95,6 +99,12 @@ def worker_rung_env(batch: int, kernel: str | None = None,
     if point_form:
         env["TPUNODE_POINT_FORM"] = point_form
         label += f"/{point_form}"
+    if field_reduce:
+        env["TPUNODE_FIELD_REDUCE"] = field_reduce
+        label += f"/{field_reduce}"
+    if window_bits:
+        env["TPUNODE_WINDOW_BITS"] = str(window_bits)
+        label += f"/w{window_bits}"
     return env, label
 
 

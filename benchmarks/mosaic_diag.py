@@ -24,6 +24,11 @@ the two possible causes when an uptime window allows:
    the de-scanned static-digit pow, the 4-level select tree), each as a
    minimal kernel so a short uptime window can bisect which ones Mosaic
    lowers before the affine flagship is attempted on device.
+   ``lazy_reduce`` / ``window5`` (ISSUE 12) extend the set with the
+   lazy pipeline's wide accumulator (47-sublane intermediates, one
+   loose reduction per expression) and the 5-bit window constructs
+   (32-entry VMEM table, 5-level select tree, ONE shared G-table copy
+   broadcast across lanes).
 7. ``flagship`` — the real ``verify_blocked`` at batch 256 (one block).
    The failing-construct set names the thing to fix.
 
@@ -499,6 +504,124 @@ def _select_tree() -> None:
         assert F.from_limbs(got[:, i]) == pow(av[i], dv[i], F.P), i
 
 
+def _lazy_reduce() -> None:
+    """The ISSUE-12 lazy-reduction primitive exactly as curve.py's lazy
+    bodies compose it: two bare convolutions (mul_t_wide) accumulated
+    wide (acc_add) and paid down with ONE loose reduction — the
+    47-sublane intermediates are the construct Mosaic hasn't seen
+    before this PR.  canonical(reduce_wide_loose(a·b + c·d)) must equal
+    (a*b + c*d) mod p."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    from tpunode.verify import field as F
+    from tpunode.verify import pallas_field as PF
+
+    b = 256
+
+    def kernel(a_ref, b_ref, c_ref, d_ref, o_ref):
+        w = PF.acc_add(
+            PF.mul_t_wide(a_ref[...], b_ref[...]),
+            PF.mul_t_wide(c_ref[...], d_ref[...]),
+        )
+        o_ref[...] = PF.canonical(PF.reduce_wide_loose(w))
+
+    rng = np.random.default_rng(29)
+    cols = []
+    vals = []
+    for _ in range(4):
+        v = [int(rng.integers(0, 2**61)) for _ in range(b)]
+        vals.append(v)
+        cols.append(jnp.asarray(np.stack([F.to_limbs(x) for x in v], axis=1)))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((F.NLIMBS, b), jnp.int32),
+        interpret=_INTERPRET,
+    )(*cols)
+    av, bv, cv, dv = vals
+    for i in (0, b - 1):
+        got = F.from_limbs(np.asarray(out)[:, i])
+        want = (av[i] * bv[i] + cv[i] * dv[i]) % F.P
+        assert got == want, (i, got)
+
+
+def _window5() -> None:
+    """The ISSUE-12 5-bit window constructs in one probe: a 32-entry
+    VMEM scratch table built with pl.ds stores, a 5-level select tree
+    over it (digits in [0, 32)), and a SHARED constant table input —
+    (32, L, 1), one copy for all lanes, broadcast against the per-lane
+    digit row inside each where (the layout the wb=5 kernel uses for
+    G/λG instead of per-lane duplication).  Selected per-lane power
+    times selected shared power must equal a^d * g^d mod p."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from tpunode.verify import field as F
+    from tpunode.verify import pallas_field as PF
+
+    b = 256
+    g = 0xC0FFEE
+    gtab_np = np.stack(
+        [F.to_limbs(pow(g, k, F.P))[:, None] for k in range(32)], axis=0
+    )  # (32, L, 1): ONE shared copy
+
+    def tree32(entries, d):
+        level = list(entries)
+        for i in range(5):
+            bit = ((d >> i) & 1) == 1
+            level = [
+                jnp.where(bit, level[2 * j + 1], level[2 * j])
+                for j in range(len(level) // 2)
+            ]
+        return level[0]
+
+    def kernel(a_ref, g_ref, d_ref, o_ref, tab_ref):
+        one = jnp.concatenate(
+            [jnp.ones((1, b), jnp.int32),
+             jnp.zeros((F.NLIMBS - 1, b), jnp.int32)], axis=0)
+        t = a_ref[...]
+        tab_ref[0] = one
+        tab_ref[1] = t
+
+        def build(k, c):
+            tab_ref[pl.ds(k, 1)] = PF.mul(
+                tab_ref[pl.ds(k - 1, 1)][0], t)[None]
+            return c
+
+        lax.fori_loop(2, 32, build, 0)
+        d = d_ref[...]  # (1, B)
+        mine = tree32([tab_ref[tv] for tv in range(32)], d)
+        shared = tree32([g_ref[tv] for tv in range(32)], d)  # (L,1)x(1,B)
+        o_ref[...] = PF.canonical(PF.mul(mine, shared))
+
+    rng = np.random.default_rng(31)
+    av = [int(rng.integers(2, 2**31)) for _ in range(b)]
+    dv = [int(rng.integers(0, 32)) for _ in range(b)]
+    a = jnp.asarray(np.stack([F.to_limbs(v) for v in av], axis=1))
+    d = jnp.asarray(np.array(dv, dtype=np.int32)[None])
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype),
+        in_specs=[
+            pl.BlockSpec(a.shape),
+            pl.BlockSpec(gtab_np.shape),
+            pl.BlockSpec((1, b)),
+        ],
+        scratch_shapes=[pltpu.VMEM((32, F.NLIMBS, b), jnp.int32)],
+        interpret=_INTERPRET,
+    )(a, jnp.asarray(gtab_np), d)
+    got = np.asarray(out)
+    for i in (0, 7, b - 1):
+        want = pow(av[i], dv[i], F.P) * pow(g, dv[i], F.P) % F.P
+        assert F.from_limbs(got[:, i]) == want, i
+
+
 def _flagship() -> None:
     import jax.numpy as jnp
 
@@ -555,6 +678,8 @@ def main() -> None:
                      ("batch_inv", _batch_inv),
                      ("pow_descan", _pow_descan),
                      ("select_tree", _select_tree),
+                     ("lazy_reduce", _lazy_reduce),
+                     ("window5", _window5),
                      ("flagship", _flagship)):
         out = _case(name, fn)
         res["cases"].append(out)
@@ -587,7 +712,8 @@ def main() -> None:
                               "restore the flagship; failing = "
                               + ",".join(failed))
         elif failed and set(failed) <= {"field_mul_dot", "mixed_add",
-                                        "batch_inv", "pow_descan"}:
+                                        "batch_inv", "pow_descan",
+                                        "lazy_reduce", "window5"}:
             # Default programs healthy; only OFF-BY-DEFAULT experimental
             # primitives fail — the corresponding knobs stay off on TPU
             # (PERF.md records which).

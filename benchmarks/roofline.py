@@ -54,9 +54,17 @@ class CountingField:
     """Field namespace that counts mul/sqr calls while delegating to the
     real implementation — `curve`'s formulas take the namespace as their
     ``F=`` parameter, so the counts come from executing the audited code,
-    not from reading it."""
+    not from reading it.
+
+    The ISSUE 12 lazy pipeline adds the wide-accumulator ops: WIDE_OPS
+    are limb convolutions (mul-like work, same MACs as their eager
+    twins), TAIL_OPS are the carry/fold machinery (reductions, hoisted
+    tighten rounds, wide sums — zero MACs, all carry/fold vector ops)."""
 
     OPS = ("mul", "mul_t", "sqr", "sqr_t", "mul_small_red")
+    WIDE_OPS = ("mul_wide", "mul_t_wide", "sqr_wide", "sqr_t_wide")
+    TAIL_OPS = ("reduce_wide", "reduce_wide_loose", "tighten", "acc_add")
+    ALL_OPS = OPS + WIDE_OPS + TAIL_OPS
 
     def __init__(self, base):
         self._base = base
@@ -64,7 +72,7 @@ class CountingField:
 
     def __getattr__(self, name):
         attr = getattr(self._base, name)
-        if name in self.OPS:
+        if name in self.ALL_OPS:
             def counted(*a, _attr=attr, _name=name, **kw):
                 self.counts[_name] += 1
                 return _attr(*a, **kw)
@@ -101,16 +109,20 @@ def _point_op_counts():
 def _batch_inversion_counts():
     """Field-op counts of the affine Q-table batch normalization
     (kernel._normalize_q_table: prefix/suffix products + per-entry X/Y
-    scaling), by EXECUTING the live helper with a counting namespace.
-    The shared Fermat ladder is counted separately (`_pow_ladder_model`)
-    — the stub pow_const here contributes zero ops."""
+    scaling), by EXECUTING the live helper with a counting namespace at
+    the ACTIVE table size (2^window_bits entries).  The shared Fermat
+    ladder is counted separately (`_pow_ladder_model`) — the stub
+    pow_const here contributes zero ops."""
     import jax.numpy as jnp
 
     from tpunode.verify import field as F
     from tpunode.verify import kernel as K
 
     one = jnp.asarray(F.ONE)
-    qt = jnp.stack([jnp.stack([one, one, one], axis=0)] * 16, axis=0)
+    qt = jnp.stack(
+        [jnp.stack([one, one, one], axis=0)] * (1 << K.window_bits()),
+        axis=0,
+    )
     cf = CountingField(F)
     K._normalize_q_table(qt, F=cf, pow_const=lambda t, d: t)
     return dict(cf.counts)
@@ -142,12 +154,12 @@ def _pow_ladder_model(digits) -> collections.Counter:
 
 def _q_table_build_model(add_c: dict, dbl_c: dict) -> collections.Counter:
     """Field-op counts of the on-device Q-table build under the ACTIVE
-    ladder mode: ``scan`` = 14 sequential complete adds; ``unroll`` = a
-    log-depth double-and-add chain (7 doublings + 7 additions — fewer
-    muls AND a ~5-deep critical path)."""
+    ladder mode and window width: ``scan`` = 2^wb - 2 sequential
+    complete adds; ``unroll`` = a log-depth double-and-add chain (fewer
+    muls AND a much shorter critical path)."""
     from tpunode.verify import kernel as K
 
-    tab_entries = 1 << K.WINDOW_BITS
+    tab_entries = 1 << K.window_bits()
     if K.pow_ladder_mode() == "scan":
         return _scale(add_c, tab_entries - 2)
     c = collections.Counter()
@@ -160,79 +172,114 @@ def _scale(counts: dict, k: int) -> collections.Counter:
     return collections.Counter({op: n * k for op, n in counts.items()})
 
 
-def field_op_model(point_form: "str | None" = None) -> dict:
+def field_op_model(
+    point_form: "str | None" = None,
+    field_reduce: "str | None" = None,
+    window_bits: "int | None" = None,
+) -> dict:
     """Per-verify (per lane) field-op counts for each signature algorithm,
     assembled from kernel.py's structure under the ACTIVE formulation
-    modes (or ``point_form`` explicitly — the affine/projective A/B the
-    ISSUE 8 acceptance wants stated side by side)."""
+    modes (or ``point_form``/``field_reduce``/``window_bits`` explicitly
+    — the A/B comparisons the ISSUE 8/12 acceptances want stated side by
+    side; explicit modes are applied process-wide for the duration of
+    the call and restored after)."""
     from tpunode.verify import curve as C
+    from tpunode.verify import field as Fm
     from tpunode.verify import kernel as K
 
-    form = point_form or C.point_form()
-    add_c, dbl_c, mixed_c = _point_op_counts()
-    tab_entries = 1 << K.WINDOW_BITS  # 16
-    halves = sum(
-        1 for name, nd in K._DEVICE_FIELDS if nd == 2 and name.startswith("d")
-    )  # the 4 GLV half-scalar digit streams
-    pow_digits = len(K._EULER_DIGITS)  # 64 4-bit windows
-    assert len(K._PM2_DIGITS) == pow_digits
+    prev_f = Fm.field_modes()
+    prev_wb = K.window_bits()
+    try:
+        if field_reduce is not None:
+            Fm.set_field_modes(reduce=field_reduce)
+        if window_bits is not None:
+            K.set_kernel_modes(window_bits=window_bits)
+        form = point_form or C.point_form()
+        add_c, dbl_c, mixed_c = _point_op_counts()
+        tab_entries = 1 << K.window_bits()  # 16 at 4-bit, 32 at 5-bit
+        wb = K.window_bits()
+        nwin = K.windows()
+        halves = sum(
+            1
+            for name, nd in K._DEVICE_FIELDS
+            if nd == 2 and name.startswith("d")
+        )  # the 4 GLV half-scalar digit streams
+        pow_digits = len(K._EULER_DIGITS)  # 64 4-bit windows
+        assert len(K._PM2_DIGITS) == pow_digits
 
-    pow_ladder = _pow_ladder_model(K._PM2_DIGITS)
-    euler_ladder = _pow_ladder_model(K._EULER_DIGITS)
-    q_table = _q_table_build_model(add_c, dbl_c)
-    lambda_table = collections.Counter({"mul": tab_entries})  # β·X per entry
+        pow_ladder = _pow_ladder_model(K._PM2_DIGITS)
+        euler_ladder = _pow_ladder_model(K._EULER_DIGITS)
+        q_table = _q_table_build_model(add_c, dbl_c)
+        lambda_table = collections.Counter(
+            {"mul": tab_entries}
+        )  # β·X per entry
 
-    msm = _scale(dbl_c, K.WINDOWS * halves)
-    batch_inv = collections.Counter()
-    if form == "affine":
-        # mixed additions against the batch-normalized 2-coordinate
-        # tables (ISSUE 8): one Montgomery-trick inversion per lane —
-        # prefix/suffix/normalize muls counted by executing the live
-        # helper, plus ONE shared Fermat ladder over the whole table.
-        msm += _scale(mixed_c, K.WINDOWS * halves)
-        batch_inv = collections.Counter(_batch_inversion_counts())
-        batch_inv += pow_ladder
-    else:
-        msm += _scale(add_c, K.WINDOWS * halves)
+        # per window round: wb doublings + one add per half-scalar
+        msm = _scale(dbl_c, nwin * wb)
+        batch_inv = collections.Counter()
+        if form == "affine":
+            # mixed additions against the batch-normalized 2-coordinate
+            # tables (ISSUE 8): one Montgomery-trick inversion per lane —
+            # prefix/suffix/normalize muls counted by executing the live
+            # helper, plus ONE shared Fermat ladder over the whole table.
+            msm += _scale(mixed_c, nwin * halves)
+            batch_inv = collections.Counter(_batch_inversion_counts())
+            batch_inv += pow_ladder
+        else:
+            msm += _scale(add_c, nwin * halves)
 
-    accept_ecdsa = collections.Counter({"mul": 2})  # m1, m2 projective checks
-    on_curve = collections.Counter({"mul": 1, "sqr": 2})  # qy² = qx³ + 7
+        accept_ecdsa = collections.Counter({"mul": 2})  # m1, m2 checks
+        on_curve = collections.Counter({"mul": 1, "sqr": 2})  # qy²=qx³+7
 
-    base = (
-        msm + q_table + batch_inv + lambda_table + accept_ecdsa + on_curve
-    )
-    ecdsa = base
-    # BCH Schnorr: + jacobi(Y·Z) Euler pow (1 mul + ladder)
-    schnorr = base + collections.Counter({"mul": 1}) + euler_ladder
-    # BIP340: + Fermat inverse Z^(p-2) (ladder) + y = Y·Z⁻¹ (1 mul)
-    bip340 = base + collections.Counter({"mul": 1}) + pow_ladder
+        base = (
+            msm + q_table + batch_inv + lambda_table + accept_ecdsa
+            + on_curve
+        )
+        ecdsa = base
+        # BCH Schnorr: + jacobi(Y·Z) Euler pow (1 mul + ladder)
+        schnorr = base + collections.Counter({"mul": 1}) + euler_ladder
+        # BIP340: + Fermat inverse Z^(p-2) (ladder) + y = Y·Z⁻¹ (1 mul)
+        bip340 = base + collections.Counter({"mul": 1}) + pow_ladder
 
-    def flat(c: collections.Counter) -> dict:
-        d = {op: int(c.get(op, 0)) for op in CountingField.OPS}
-        d["total_mul_like"] = sum(d.values())
-        d["squarings"] = d["sqr"] + d["sqr_t"]
-        return d
+        def flat(c: collections.Counter) -> dict:
+            d = {op: int(c.get(op, 0)) for op in CountingField.ALL_OPS}
+            mul_like = CountingField.OPS + CountingField.WIDE_OPS
+            d["total_mul_like"] = sum(d[op] for op in mul_like)
+            d["squarings"] = (
+                d["sqr"] + d["sqr_t"] + d["sqr_wide"] + d["sqr_t_wide"]
+            )
+            d["reductions"] = (
+                sum(d[op] for op in CountingField.OPS)
+                + d["reduce_wide"]
+                + d["reduce_wide_loose"]
+            )
+            return d
 
-    return {
-        "pt_add": dict(add_c),
-        "pt_double": dict(dbl_c),
-        "pt_add_mixed": dict(mixed_c),
-        "point_form": form,
-        "structure": {
-            "windows": K.WINDOWS,
-            "half_scalars": halves,
-            "table_entries": tab_entries,
-            "pow_digits": pow_digits,
-            "pow_ladder": K.pow_ladder_mode(),
-            "select16": K.select_mode(),
-            "batch_inversion": flat(batch_inv) if batch_inv else None,
-        },
-        "per_verify": {
-            "ecdsa": flat(ecdsa),
-            "schnorr": flat(schnorr),
-            "bip340": flat(bip340),
-        },
-    }
+        return {
+            "pt_add": dict(add_c),
+            "pt_double": dict(dbl_c),
+            "pt_add_mixed": dict(mixed_c),
+            "point_form": form,
+            "structure": {
+                "windows": nwin,
+                "window_bits": wb,
+                "field_reduce": Fm.reduce_mode(),
+                "half_scalars": halves,
+                "table_entries": tab_entries,
+                "pow_digits": pow_digits,
+                "pow_ladder": K.pow_ladder_mode(),
+                "select16": K.select_mode(),
+                "batch_inversion": flat(batch_inv) if batch_inv else None,
+            },
+            "per_verify": {
+                "ecdsa": flat(ecdsa),
+                "schnorr": flat(schnorr),
+                "bip340": flat(bip340),
+            },
+        }
+    finally:
+        Fm.set_field_modes(mul=prev_f[0], sqr=prev_f[1], reduce=prev_f[2])
+        K.set_kernel_modes(window_bits=prev_wb)
 
 
 # ---------------------------------------------------------------------------
@@ -317,12 +364,23 @@ def field_leaf_costs(batch: int = 8) -> dict:
 
     a = jnp.asarray(np.ones((F.NLIMBS, batch), np.int32))
     b = jnp.asarray(np.full((F.NLIMBS, batch), 2, np.int32))
+    w = jnp.asarray(np.ones((2 * F.NLIMBS - 1, batch), np.int32))
     costs = {
         "mul": count_int_ops(F.mul, a, b),
         "mul_t": count_int_ops(F.mul_t, a, b),
         "sqr": count_int_ops(F.sqr, a),
         "sqr_t": count_int_ops(F.sqr_t, a),
         "mul_small_red": count_int_ops(lambda x: F.mul_small_red(x, 21), a),
+        # ISSUE 12 wide-accumulator primitives: the lazy pipeline's
+        # convolutions (mul-like) and carry/fold machinery (tail)
+        "mul_wide": count_int_ops(F.mul_wide, a, b),
+        "mul_t_wide": count_int_ops(F.mul_t_wide, a, b),
+        "sqr_wide": count_int_ops(F.sqr_wide, a),
+        "sqr_t_wide": count_int_ops(F.sqr_t_wide, a),
+        "reduce_wide": count_int_ops(F.reduce_wide, w),
+        "reduce_wide_loose": count_int_ops(F.reduce_wide_loose, w),
+        "tighten": count_int_ops(F.tighten, a),
+        "acc_add": count_int_ops(lambda x, y: F.acc_add(x, y), w, w),
     }
     for op in costs:
         costs[op]["total"] = sum(costs[op].values())
@@ -343,6 +401,17 @@ def mac_model() -> dict:
         "sqr": sqr_macs,
         "sqr_t": sqr_macs,
         "mul_small_red": F.NLIMBS + F._FN,  # a*k + the 4-limb top fold
+        # ISSUE 12 wide ops: a wide product is the SAME convolution as
+        # its eager twin (the reduction tail it skips has no MACs);
+        # the tail ops are pure carry/fold vector work.
+        "mul_wide": mul_macs,
+        "mul_t_wide": mul_macs,
+        "sqr_wide": sqr_macs,
+        "sqr_t_wide": sqr_macs,
+        "reduce_wide": 0,
+        "reduce_wide_loose": 0,
+        "tighten": 0,
+        "acc_add": 0,
         # int8 MXU packing: an 11-bit limb splits into two <=6-bit halves,
         # so each int32 MAC becomes 4 int8 MACs (lo*lo, lo*hi, hi*lo,
         # hi*hi) accumulated in the MXU's int32 accumulators.
@@ -385,28 +454,64 @@ MEASURED = {
 }
 
 
+# Which bare convolution each product op embeds: the difference between
+# an op's leaf cost and its bare convolution's IS its carry/fold work
+# (input carry rounds + the reduction tail) — the ops the ISSUE 12 lazy
+# pipeline removes.  Tail ops (reduce_wide/tighten/acc_add) are pure
+# carry/fold; mul_small_red's convolution part is its scale multiply.
+_CONV_OF = {
+    "mul": "mul_t_wide",
+    "mul_t": "mul_t_wide",
+    "mul_wide": "mul_t_wide",
+    "mul_t_wide": "mul_t_wide",
+    "sqr": "sqr_t_wide",
+    "sqr_t": "sqr_t_wide",
+    "sqr_wide": "sqr_t_wide",
+    "sqr_t_wide": "sqr_t_wide",
+}
+
+
+def _carry_fold_cost(op: str, leaf: dict) -> float:
+    """Per-call carry/fold vector ops of ``op``: leaf total minus the
+    embedded bare convolution (multiplies + anti-diagonal accumulation),
+    which laziness never changes."""
+    if op in _CONV_OF:
+        return leaf[op]["total"] - leaf[_CONV_OF[op]]["total"]
+    if op == "mul_small_red":  # conv part = the scale/fold multiplies
+        return leaf[op]["total"] - leaf[op].get("mul", 0) - leaf[op].get(
+            "mac", 0
+        )
+    return leaf[op]["total"]  # reduce_wide / tighten / acc_add
+
+
 def _per_algo_work(ops: dict, macs: dict, leaf: dict) -> dict:
     per_algo = {}
+    all_ops = CountingField.ALL_OPS
     for algo, counts in ops["per_verify"].items():
-        mac_total = sum(
-            counts[op] * macs[op] for op in CountingField.OPS
-        )
+        mac_total = sum(counts[op] * macs[op] for op in all_ops)
         vec_total = sum(
-            counts[op] * leaf[op]["total"] for op in CountingField.OPS
+            counts[op] * leaf[op]["total"] for op in all_ops
         )
         vec_mul = sum(
             counts[op] * (leaf[op].get("mul", 0) + leaf[op].get("mac", 0))
-            for op in CountingField.OPS
+            for op in all_ops
+        )
+        carry_fold = sum(
+            counts[op] * _carry_fold_cost(op, leaf) for op in all_ops
         )
         per_algo[algo] = {
             "field_muls": counts["total_mul_like"],
             "squarings": counts["squarings"],
+            "reductions": counts["reductions"],
             "int32_macs": int(mac_total),
             "int8_macs_if_packed": int(mac_total * macs["int8_split_factor"]),
             # field ops only; the MSM's selects/einsums add ~20-30% more
             # (bench-measured, PERF.md) — this is the arithmetic floor
             "vector_int_ops": int(vec_total),
             "vector_mul_ops": int(vec_mul),
+            # input-carry + reduction-tail ops only (convolution
+            # accumulation excluded): the rounds ISSUE 12 fuses
+            "carry_fold_vector_ops": int(carry_fold),
         }
     return per_algo
 
@@ -456,11 +561,30 @@ def roofline(chip: str = "v5e") -> dict:
             "vpu_bound_sigs_s": round(vpu_ops_s / w["vector_int_ops"]),
         }
 
-    # Bytes per lane over the PCIe/HBM boundary (device inputs + verdict):
-    # 4 digit streams x WINDOWS + 4 limb arrays + masks.
-    from tpunode.verify import kernel as K
+    # Lazy-reduction x window-width A/B at the arithmetic floor (ISSUE
+    # 12): the lazy model must remove a MEASURABLE share of the
+    # carry/fold vector ops (the acceptance pin is >= 25% for the ECDSA
+    # per-verify total, tested in test_benchmarks), and the 5-bit
+    # windows cut rounds at the cost of bigger tables.
+    reduce_compare = {}
+    for red in ("eager", "lazy"):
+        for wbits in K.WINDOW_BITS_MODES:
+            w = _per_algo_work(
+                field_op_model(field_reduce=red, window_bits=wbits),
+                macs,
+                leaf,
+            )["ecdsa"]
+            reduce_compare[f"{red}@w{wbits}"] = {
+                "field_muls": w["field_muls"],
+                "reductions": w["reductions"],
+                "vector_int_ops": w["vector_int_ops"],
+                "carry_fold_vector_ops": w["carry_fold_vector_ops"],
+                "vpu_bound_sigs_s": round(vpu_ops_s / w["vector_int_ops"]),
+            }
 
-    in_bytes = 4 * K.WINDOWS * 4 + 4 * F.NLIMBS * 4 + 6 * 1 + 4
+    # Bytes per lane over the PCIe/HBM boundary (device inputs + verdict):
+    # 4 digit streams x windows() + 4 limb arrays + masks.
+    in_bytes = 4 * K.windows() * 4 + 4 * F.NLIMBS * 4 + 6 * 1 + 4
     util = {}
     for label, m in MEASURED.items():
         algo = "ecdsa"  # the headline workload is ECDSA-only
@@ -475,13 +599,19 @@ def roofline(chip: str = "v5e") -> dict:
     return {
         "chip": chip,
         "chip_model": ch,
-        "field_modes": {"mul": F.mul_mode(), "sqr": F.sqr_mode()},
+        "field_modes": {
+            "mul": F.mul_mode(),
+            "sqr": F.sqr_mode(),
+            "reduce": F.reduce_mode(),
+        },
         "kernel_modes": {
             "point_form": C.point_form(),
             "select16": K.select_mode(),
             "pow_ladder": K.pow_ladder_mode(),
+            "window_bits": K.window_bits(),
         },
         "point_form_compare": form_compare,
+        "reduce_window_compare": reduce_compare,
         "op_model": ops,
         "mac_model": macs,
         "leaf_costs": {k: {kk: round(vv, 1) for kk, vv in v.items()}
@@ -538,6 +668,17 @@ def _markdown(r: dict) -> str:
     for form, w in r["point_form_compare"].items():
         lines.append(
             f"| {form} | {w['field_muls']} | {w['vector_int_ops']:,} "
+            f"| {w['vpu_bound_sigs_s']:,} |"
+        )
+    lines.append("")
+    lines.append("| reduce@width (ecdsa) | field muls | reductions "
+                 "| carry/fold vec ops | vector int ops "
+                 "| all-VPU bound (sigs/s) |")
+    lines.append("|---|---|---|---|---|---|")
+    for key, w in r["reduce_window_compare"].items():
+        lines.append(
+            f"| {key} | {w['field_muls']} | {w['reductions']} "
+            f"| {w['carry_fold_vector_ops']:,} | {w['vector_int_ops']:,} "
             f"| {w['vpu_bound_sigs_s']:,} |"
         )
     return "\n".join(lines)

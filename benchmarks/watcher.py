@@ -102,6 +102,16 @@ AFFINE_LADDER = (
     (32768, 360.0, None),
     (8192, 300.0, "xla"),
 )
+# Lazy-reduction rungs (ISSUE 12): once per round after the affine slot,
+# bank a device number for the lazy pipeline (kind="lazy" rows — the
+# headline fallback ignores them).  The combined lazy+5-bit-window rung
+# leads (the full formulation the roofline model favors); the lazy-only
+# XLA rung is the Mosaic-outage fallback.
+LAZY_LADDER = (
+    (32768, 360.0, None, "lazy", 5),
+    (32768, 360.0, None, "lazy", 4),
+    (8192, 300.0, "xla", "lazy", 4),
+)
 CONFIG_BUDGETS = {"config2": 600.0, "config5": 900.0, "config3": 900.0}
 # Sweep order: config2 is cheap; config3 (full-node IBD on device) is
 # the VERDICT item-2 money shot and must be banked before config5,
@@ -152,6 +162,11 @@ _headline_banked = False
 # separate from _mosaic_broken so an experiment that Mosaic can't lower
 # never degrades the projective headline ladder (review r8).
 _affine_pallas_broken = False
+# Same isolation for the LAZY-program rungs (ISSUE 12): the lazy/5-bit
+# programs carry constructs Mosaic may reject (47-sublane wides,
+# 32-entry tables — mosaic_diag's lazy_reduce/window5 cases) while the
+# eager flagship lowers fine.
+_lazy_pallas_broken = False
 
 BENCH_LOCK = os.path.join(REPO, "benchmarks", ".bench_running")
 
@@ -190,6 +205,7 @@ def run_headline(
     first-bank — only the pallas rungs are worth running (an XLA number
     is already on disk)."""
     global _mosaic_broken, _headline_banked, _affine_pallas_broken
+    global _lazy_pallas_broken
     if pallas_only:
         rungs = [r for r in LADDER if r[2] is None]
     elif _mosaic_broken:
@@ -212,12 +228,14 @@ def run_headline(
         if res.get("ok"):
             if kernel is None:
                 # pallas works (again): restore the full-budget ladder,
-                # and give the affine pallas rung its chance back too —
-                # a transient tunnel hang on the affine rung must not
-                # skip it for the rest of a multi-hour watcher session
-                # once the flagship proves Mosaic healthy (review r8)
+                # and give the affine/lazy pallas rungs their chance
+                # back too — a transient tunnel hang on an experiment
+                # rung must not skip it for the rest of a multi-hour
+                # watcher session once the flagship proves Mosaic
+                # healthy (review r8)
                 _mosaic_broken = False
                 _affine_pallas_broken = False
+                _lazy_pallas_broken = False
             _headline_banked = True
             _record("headline", {
                 "metric": "sig_verify_throughput",
@@ -320,6 +338,63 @@ def run_affine() -> bool:
             _log("affine: pallas AFFINE program broken/hanging — affine "
                  "XLA rung only (projective headline ladder unaffected)")
             _affine_pallas_broken = True
+    return False
+
+
+def run_lazy() -> bool:
+    """One pass over the lazy-reduction rungs (ISSUE 12): bank a device
+    number for the lazy field pipeline (and the 5-bit windows on the
+    leading rung) as a ``kind="lazy"`` row.  Returns True when a sample
+    was banked (the once-per-round slot is then spent).  Same
+    short-window discipline and failure isolation as :func:`run_affine`:
+    a failing LAZY pallas rung sets only the lazy-local broken flag —
+    the projective/eager headline ladder is never degraded by an
+    experiment's failure — and a fatal verdict mismatch poisons the
+    round exactly like the headline's."""
+    global _lazy_pallas_broken
+    rungs = (
+        [r for r in LAZY_LADDER if r[2] == "xla"]
+        if (_mosaic_broken or _lazy_pallas_broken)
+        else list(LAZY_LADDER)
+    )
+    for batch, budget, kernel, reduce, wbits in rungs:
+        if _bench_running():
+            _log("lazy: bench.py running — yielding the tunnel")
+            return False
+        env, label = worker_rung_env(
+            batch, kernel, field_reduce=reduce, window_bits=wbits
+        )
+        res = _run_json(
+            [sys.executable, "bench.py", "--worker"], budget, env,
+        )
+        if res.get("ok"):
+            _record("lazy", {
+                "metric": "sig_verify_throughput",
+                "value": round(res["rate"], 1), "unit": "sigs/sec/chip",
+                "device": res.get("device"), "kernel": res.get("kernel"),
+                "field_reduce": res.get("field_reduce", reduce),
+                "window_bits": res.get("window_bits", wbits),
+                "batch": res.get("batch"), "step_ms": res.get("step_ms"),
+                "compile_s": res.get("compile_s"),
+                "init_s": res.get("init_s"),
+            })
+            return True
+        err = str(res.get("error", ""))
+        _log(f"lazy {label}: {err or '?'}")
+        if res.get("fatal"):
+            # a lazy/oracle verdict mismatch is a kernel correctness
+            # failure like any other: poison the round's sampling
+            _record("fatal", {"error": res.get("error"),
+                              "field_reduce": reduce,
+                              "window_bits": wbits})
+            raise FatalMismatch(res.get("error", "verdict mismatch"))
+        if "initializing backend" in err or "probing backend" in err:
+            _log("lazy: tunnel lost — back to probing")
+            return False
+        if kernel is None and ("MosaicError" in err or "timed out" in err):
+            _log("lazy: pallas LAZY program broken/hanging — lazy XLA "
+                 "rung only (projective headline ladder unaffected)")
+            _lazy_pallas_broken = True
     return False
 
 
@@ -506,7 +581,8 @@ def _rotate_runs_file() -> list[dict]:
 def handle_window(swept: set) -> float:
     """One live-window pass: headline sweep, same-window pallas upgrade,
     config sweep, once-per-round affine point-form sample (ISSUE 8),
-    once-per-round Mosaic diagnostic.  Mutates ``swept``
+    once-per-round lazy-reduction sample (ISSUE 12), once-per-round
+    Mosaic diagnostic.  Mutates ``swept``
     (the on-device captures so far this round) and returns the sleep
     interval until the next probe.  Raises FatalMismatch to stop the
     watcher for the round.
@@ -552,6 +628,10 @@ def handle_window(swept: set) -> float:
         # window must not spend itself on the experiment first.
         if "affine" not in swept and run_affine():
             swept.add("affine")
+        # Lazy-reduction sample (ISSUE 12): once per round, after the
+        # affine slot — same experiment-last discipline.
+        if "lazy" not in swept and run_lazy():
+            swept.add("lazy")
     if (
         (why == "exhausted" or (head is not None and _mosaic_broken))
         and "mosaic_diag" not in swept

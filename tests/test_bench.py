@@ -1466,10 +1466,11 @@ def _setup_window(monkeypatch, W, head, why, mosaic=False):
         lambda argv, t, env=None: diags.append(argv) or {"cases": ["x"]},
     )
     monkeypatch.setattr(W, "_record", lambda k, p: recs.append(k))
-    # the once-per-round affine sample (ISSUE 8) has its own tests; stub
-    # it here so the diag/config call counts these scenarios pin stay
-    # exact
+    # the once-per-round affine (ISSUE 8) and lazy (ISSUE 12) samples
+    # have their own tests; stub them here so the diag/config call
+    # counts these scenarios pin stay exact
     monkeypatch.setattr(W, "run_affine", lambda: False)
+    monkeypatch.setattr(W, "run_lazy", lambda: False)
     return configs, diags, recs
 
 

@@ -142,7 +142,10 @@ def test_roofline_op_counts_match_rcb_and_structure():
     from benchmarks.roofline import field_op_model
     from tpunode.verify.kernel import WINDOW_BITS, WINDOWS, _EULER_DIGITS
 
-    m = field_op_model()
+    # the eager body is the one whose op counts ARE the RCB'16 paper's
+    # (the round-12 lazy default counts wide/tail ops instead — pinned
+    # in test_roofline_lazy_reduce_model_pins)
+    m = field_op_model(field_reduce="eager", window_bits=4)
     add, dbl = m["pt_add"], m["pt_double"]
     # RCB Algorithm 7: 12 muls (+ 2 reduced small-constant scalings)
     assert add["mul"] + add.get("mul_t", 0) == 12
@@ -198,7 +201,7 @@ def test_roofline_affine_op_model_pins():
     from benchmarks.roofline import field_op_model
     from tpunode.verify.kernel import WINDOW_BITS, WINDOWS
 
-    m = field_op_model("affine")
+    m = field_op_model("affine", field_reduce="eager", window_bits=4)
     assert m["point_form"] == "affine"
     mixed, add, dbl = m["pt_add_mixed"], m["pt_add"], m["pt_double"]
     assert mixed["mul"] + mixed.get("mul_t", 0) == 11  # RCB'16 Alg 8
@@ -224,8 +227,9 @@ def test_roofline_affine_op_model_pins():
     )
     ecdsa = m["per_verify"]["ecdsa"]["total_mul_like"]
     assert ecdsa == expect
-    proj = field_op_model("projective")["per_verify"]["ecdsa"][
-        "total_mul_like"]
+    proj = field_op_model(
+        "projective", field_reduce="eager", window_bits=4
+    )["per_verify"]["ecdsa"]["total_mul_like"]
     # affine = projective - 132 cheaper adds + the inversion's cost
     assert ecdsa == proj - WINDOWS * 4 + inv["total_mul_like"]
 
@@ -245,6 +249,61 @@ def test_roofline_point_form_compare_block():
     assert r["kernel_modes"]["point_form"] in ("projective", "affine")
     # the ECDSA mul totals really are per-form (not one model twice)
     assert pc["affine"]["field_muls"] != pc["projective"]["field_muls"]
+
+
+def test_roofline_lazy_reduce_model_pins():
+    """ISSUE 12 acceptance: the lazy formulation removes >= 25% of the
+    per-verify carry/fold vector ops vs eager (the reduce_window_compare
+    block), with the mul-like work unchanged — laziness removes carry
+    rounds and reduction tails, never convolutions — and the reduction
+    count itself pinned structurally (counted by EXECUTING the live
+    formulas, so a formula edit moves these on purpose or fails)."""
+    from benchmarks.roofline import field_op_model, roofline
+
+    r = roofline()
+    rc = r["reduce_window_compare"]
+    assert set(rc) == {"eager@w4", "eager@w5", "lazy@w4", "lazy@w5"}
+
+    for wb in (4, 5):
+        eager, lazy = rc[f"eager@w{wb}"], rc[f"lazy@w{wb}"]
+        # same convolution work: the mul-like count is reduce-invariant
+        assert lazy["field_muls"] == eager["field_muls"]
+        # the tentpole lever: >= 25% of the carry/fold vector ops gone
+        drop = 1 - lazy["carry_fold_vector_ops"] / eager["carry_fold_vector_ops"]
+        assert drop >= 0.25, (wb, drop)
+        # fewer reductions, strictly better arithmetic floor
+        assert lazy["reductions"] < eager["reductions"]
+        assert lazy["vpu_bound_sigs_s"] > eager["vpu_bound_sigs_s"]
+
+    # structural reduction pins (projective form, counted live):
+    # eager pays one reduction per mul-like op; the lazy bodies fuse the
+    # per-formula tails — pt_add 14 -> 11, pt_double 9 -> 8,
+    # pt_add_mixed 13 -> 10 paid reductions (mul_small_red's fold counts
+    # as its own reduction; all loose tails).
+    m = field_op_model(field_reduce="lazy", window_bits=4)
+    assert m["structure"]["field_reduce"] == "lazy"
+    assert m["structure"]["window_bits"] == 4
+    def reds(c):
+        return sum(c.get(k, 0) for k in (
+            "mul", "mul_t", "sqr", "sqr_t", "mul_small_red",
+            "reduce_wide", "reduce_wide_loose"))
+    assert reds(m["pt_add"]) == 11
+    assert reds(m["pt_double"]) == 8
+    assert reds(m["pt_add_mixed"]) == 10
+    ec = m["per_verify"]["ecdsa"]
+    assert ec["reductions"] < ec["total_mul_like"]
+    eager_ec = field_op_model(field_reduce="eager", window_bits=4)[
+        "per_verify"]["ecdsa"]
+    assert eager_ec["reductions"] == eager_ec["total_mul_like"]
+
+    # 5-bit windows: 27 rounds over 32-entry tables
+    m5 = field_op_model(window_bits=5)
+    assert m5["structure"]["windows"] == 27
+    assert m5["structure"]["table_entries"] == 32
+    # fewer window rounds -> fewer MSM muls despite the bigger table
+    assert (m5["per_verify"]["ecdsa"]["total_mul_like"]
+            < field_op_model(window_bits=4)["per_verify"]["ecdsa"][
+                "total_mul_like"])
 
 
 @pytest.mark.slow  # ~35 s of interpret-mode numpy in a subprocess
@@ -274,6 +333,35 @@ def test_mosaic_diag_affine_primitive_cases():
     assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
     cases = json.loads(out.stdout.strip().splitlines()[-1])
     assert [c["ok"] for c in cases] == [True] * 3, cases
+
+
+@pytest.mark.slow  # ~10 s of interpret-mode numpy in a subprocess
+def test_mosaic_diag_lazy_reduce_and_window5_cases():
+    """The ISSUE-12 mosaic_diag repro cases: the lazy wide accumulator
+    (47-sublane intermediates + one loose reduction) and the 5-bit
+    window constructs (32-entry VMEM table, 5-level select tree, shared
+    constant table) pass in interpret mode."""
+    env = dict(os.environ)
+    env.update(TPUNODE_DIAG_INTERPRET="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import jax; jax.config.update('jax_platforms', 'cpu');"
+            "from benchmarks import mosaic_diag as d;"
+            "import json;"
+            "print(json.dumps([d._case('lazy_reduce', d._lazy_reduce),"
+            "                  d._case('window5', d._window5)]))",
+        ],
+        cwd=REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1500:]
+    cases = json.loads(out.stdout.strip().splitlines()[-1])
+    assert [c["ok"] for c in cases] == [True] * 2, cases
 
 
 @pytest.mark.slow  # ~3 min of interpret-mode numpy for 64 unrolled windows
@@ -483,6 +571,67 @@ def test_run_affine_pallas_failure_does_not_degrade_headline(
     assert calls[0].get("TPUNODE_BENCH_KERNEL") == "xla"
 
 
+def test_run_lazy_banks_kind_lazy(monkeypatch, tmp_path):
+    """ISSUE 12: the watcher's lazy rungs bank a ``kind="lazy"`` row
+    (never the headline), pass TPUNODE_FIELD_REDUCE/TPUNODE_WINDOW_BITS
+    to the worker (the leading rung is lazy@w5), keep only the lazy XLA
+    rung during a Mosaic outage, and a failing LAZY pallas program sets
+    only the lazy-local broken flag."""
+    watcher = _load_watcher()
+    runs = tmp_path / "runs.jsonl"
+    monkeypatch.setattr(watcher, "RUNS_PATH", str(runs))
+    monkeypatch.setattr(watcher, "_bench_running", lambda: False)
+
+    calls = []
+
+    def fake_run_json(argv, timeout, env=None):
+        calls.append(env or {})
+        return {"ok": True, "rate": 234567.0, "device": "tpu:v5e",
+                "kernel": "pallas", "field_reduce": "lazy",
+                "window_bits": 5, "batch": 32768}
+
+    monkeypatch.setattr(watcher, "_run_json", fake_run_json)
+    assert watcher.run_lazy() is True
+    assert calls[0].get("TPUNODE_FIELD_REDUCE") == "lazy"
+    assert calls[0].get("TPUNODE_WINDOW_BITS") == "5"
+    rows = [json.loads(line) for line in open(runs)]
+    assert [r["kind"] for r in rows] == ["lazy"]
+    assert rows[0]["field_reduce"] == "lazy"
+    assert rows[0]["window_bits"] == 5
+    # bench.py's headline fallback ignores the lazy row
+    import bench
+
+    assert bench._freshest_device_run(str(runs)) is None
+
+    # Mosaic outage: only the lazy XLA rung is attempted
+    calls.clear()
+    watcher._mosaic_broken = True
+    assert watcher.run_lazy() is True
+    assert len(calls) == 1
+    assert calls[0].get("TPUNODE_BENCH_KERNEL") == "xla"
+    watcher._mosaic_broken = False
+
+    # a MosaicError on a lazy pallas rung: lazy-local flag only
+    def fail_pallas(argv, timeout, env=None):
+        calls.append(env or {})
+        if env and env.get("TPUNODE_BENCH_KERNEL") == "xla":
+            return {"ok": True, "rate": 50000.0, "device": "tpu:v5e",
+                    "kernel": "xla", "field_reduce": "lazy",
+                    "window_bits": 4, "batch": 8192}
+        return {"ok": False,
+                "error": "MosaicError: cannot lower wide accumulator"}
+
+    monkeypatch.setattr(watcher, "_run_json", fail_pallas)
+    calls.clear()
+    assert watcher.run_lazy() is True  # banked via the lazy XLA rung
+    assert watcher._lazy_pallas_broken is True
+    assert watcher._mosaic_broken is False  # headline ladder unaffected
+    calls.clear()
+    watcher.run_lazy()
+    assert len(calls) == 1
+    assert calls[0].get("TPUNODE_BENCH_KERNEL") == "xla"
+
+
 def test_run_affine_fatal_poisons_round(monkeypatch, tmp_path):
     """An affine/oracle verdict mismatch is a correctness failure like
     any other: recorded as a fatal row (poisoning bench.py's watcher
@@ -538,15 +687,19 @@ def test_kernel_section_shape_and_labels(monkeypatch):
     # 32768 disabled by default: labeled, no worker launched for it
     assert out["batch_32768"]["ok"] is False
     assert "disabled by default" in out["batch_32768"]["error"]
-    assert [c[0] for c in calls] == ["--kernel-ab"]
+    # the ISSUE 12 reduce x window grid rides its own worker call
+    assert [c[0] for c in calls] == ["--kernel-ab", "--kernel-ab"]
     assert calls[0][2]["TPUNODE_BENCH_KERNELAB_BATCH"] == "1024"
+    assert "TPUNODE_BENCH_KERNELAB_MODE" not in calls[0][2]
+    assert calls[1][2]["TPUNODE_BENCH_KERNELAB_MODE"] == "reduce"
+    assert out["reduce_window_batch_1024"]["ok"] is True
 
     # env-enabled big batch: attempted and failure-labeled on timeout
     monkeypatch.setattr(bench, "T_KERNEL_AB_BIG", 60.0)
     calls.clear()
     out = bench._kernel_section()
     assert [c[2]["TPUNODE_BENCH_KERNELAB_BATCH"] for c in calls] == [
-        "1024", "32768"]
+        "1024", "32768", "1024"]
     assert out["batch_32768"] == {"ok": False,
                                   "error": "timed out after 1s"}
 

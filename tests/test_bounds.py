@@ -1,0 +1,85 @@
+"""The static limb-bound tracker (ISSUE 12): the int32-safety audit of
+the field pipeline is CHECKED code — these tests pin that it passes over
+every live formula in both reduce modes and that it fails loudly on a
+deliberately-overflowing chain."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from tpunode.verify import bounds as B
+from tpunode.verify import field as F
+
+
+def test_audit_passes_live_formulas_both_modes():
+    """The acceptance gate: every live formula body, both reduce
+    disciplines, from the window loop's input bounds — no overflow, and
+    output coordinates stay inside the 2^13 closure the MSM feeds back."""
+    for mode in F.REDUCE_MODES:
+        out = B.audit_formulas(mode)
+        assert set(out) == {"pt_add", "pt_double", "pt_add_mixed"}
+        for name, peak in out.items():
+            assert 0 < peak <= B.COORD_BOUND, (mode, name, peak)
+
+
+def test_overflow_chain_fails_loudly():
+    """A synthetic chain that violates int32 headroom must raise at
+    'trace time' (the audit), not corrupt silently: two maximally loose
+    2^20-limb operands convolve past 2^31."""
+    bf = B.BoundField()
+    fat = B.BVal.uniform(1 << 20)
+    with pytest.raises(B.BoundOverflow):
+        bf.mul_t(fat, fat)
+    # accumulating too many legal wides also trips the tracker
+    w = bf.mul_t_wide(B.BVal.uniform(1 << 13), B.BVal.uniform(1 << 13))
+    with pytest.raises(B.BoundOverflow):
+        bf.acc_add(*([w] * 16))
+
+
+def test_documented_output_contracts_enforced():
+    """_reduce_wide's docstring bounds (|limb| <= 2^12, loose <= 2^13)
+    are asserted by the tracker, not just written down."""
+    bf = B.BoundField()
+    a = B.BVal.uniform(1 << 13)
+    tight = bf.mul_t(a, a)
+    assert tight.max() <= 1 << 12
+    loose = bf.reduce_wide_loose(bf.mul_t_wide(a, a))
+    assert loose.max() <= 1 << 13
+    # the loose output is a legal mul_t operand and coordinate
+    bf.mul_t(loose, loose)
+
+
+def test_carry_bound_is_sound_numerically():
+    """The tracker's carry-round interval arithmetic really bounds the
+    implementation: run field._carry on adversarial int32 vectors and
+    compare against the tracked bound."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    bound = 1 << 17
+    tracked = B._carry(B.BVal.uniform(bound), 1)
+    for _ in range(20):
+        x = rng.integers(-bound, bound + 1, size=(F.NLIMBS, 4))
+        got = np.asarray(F._carry(jnp.asarray(x.astype(np.int32)), 1))
+        assert (np.abs(got) <= np.array(tracked.b)[:, None]).all()
+
+
+def test_assert_formulas_safe_is_cached():
+    B._AUDITED.clear()
+    B.assert_formulas_safe("eager")
+    assert "eager" in B._AUDITED
+    marker = B._AUDITED["eager"]
+    B.assert_formulas_safe("eager")  # second call: cached, same object
+    assert B._AUDITED["eager"] is marker
+
+
+def test_bval_ops():
+    a = B.BVal((1, 2, 3))
+    b = B.BVal((10, 20, 30))
+    assert (a + b).b == (11, 22, 33)
+    assert (a - b).b == (11, 22, 33)  # magnitudes add under subtraction
+    assert (-a).b == a.b
+    assert (a * -4).b == (4, 8, 12)  # |k| scaling
+    with pytest.raises(B.BoundOverflow):
+        B.BVal.uniform((1 << 30)) + B.BVal.uniform(1 << 30)

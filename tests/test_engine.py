@@ -515,10 +515,12 @@ def test_verify_config_field_formulation_knob():
     try:
         VerifyConfig(backend="cpu", warmup=False,
                      field_mul="dot_general", field_sqr="mul")
-        assert F.field_modes() == ("dot_general", "mul")
+        assert F.field_modes() == ("dot_general", "mul", prev[2])
         VerifyConfig(backend="cpu", warmup=False)  # None: unchanged
-        assert F.field_modes() == ("dot_general", "mul")
+        assert F.field_modes() == ("dot_general", "mul", prev[2])
         VerifyConfig(backend="cpu", warmup=False, field_sqr="half")
-        assert F.field_modes() == ("dot_general", "half")
+        assert F.field_modes() == ("dot_general", "half", prev[2])
+        VerifyConfig(backend="cpu", warmup=False, field_reduce="lazy")
+        assert F.field_modes() == ("dot_general", "half", "lazy")
     finally:
-        F.set_field_modes(mul=prev[0], sqr=prev[1])
+        F.set_field_modes(mul=prev[0], sqr=prev[1], reduce=prev[2])

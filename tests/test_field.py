@@ -222,7 +222,9 @@ def test_set_field_modes_validates(restore_modes):
     assert F.field_modes() == before
     prev = F.set_field_modes(mul="dot_general")
     assert prev[0] in F.MUL_MODES and F.mul_mode() == "dot_general"
-    assert F.field_modes() == (F.mul_mode(), F.sqr_mode())
+    assert F.field_modes() == (F.mul_mode(), F.sqr_mode(), F.reduce_mode())
+    with pytest.raises(ValueError):
+        F.set_field_modes(reduce="nope")
 
 
 def test_env_mode_rejects_typos(monkeypatch):
@@ -239,6 +241,115 @@ def test_env_mode_rejects_typos(monkeypatch):
     monkeypatch.delenv("TPUNODE_FIELD_MUL")
     assert F._env_mode("TPUNODE_FIELD_MUL", F.MUL_MODES, "shift_add") == (
         "shift_add"
+    )
+
+
+# ---------- lazy-reduction wide API (ISSUE 12) ----------------------------
+
+
+def _adversarial_operands():
+    """Contract-edge operands: canonical, negative-limb (a - b), and
+    top-overflow (mul_small_red outputs carry a fat non-top profile;
+    a tight value scaled by 8 carries a fat top limb)."""
+    a, b = rand_fe(), rand_fe()
+    canon = limbs(a)
+    neg = limbs(3) - limbs(b)  # negative loose limbs
+    m = F.mul(limbs(a), limbs(b))
+    top = m * 8  # |limb| <= 2^15 incl the top: mul's contract edge
+    return [(canon, a), (neg, (3 - b) % F.P), (m, a * b % F.P),
+            (top, 8 * (a * b) % F.P)]
+
+
+def test_wide_api_matches_eager_bit_exact():
+    """reduce_wide(mul_wide(a, b)) IS mul(a, b) — bit-identical limbs,
+    not just mod-p equal — on random and adversarial inputs; same for
+    the _t and sqr variants."""
+    for la, _ in _adversarial_operands():
+        for lb, _ in _adversarial_operands():
+            assert (
+                np.asarray(F.reduce_wide(F.mul_wide(la, lb)))
+                == np.asarray(F.mul(la, lb))
+            ).all()
+    a, b = rand_fe(), rand_fe()
+    ta, tb = limbs(a), limbs(b)  # canonical: pre-tight
+    assert (
+        np.asarray(F.reduce_wide(F.mul_t_wide(ta, tb)))
+        == np.asarray(F.mul_t(ta, tb))
+    ).all()
+    assert (
+        np.asarray(F.reduce_wide(F.sqr_wide(ta))) == np.asarray(F.sqr(ta))
+    ).all()
+    assert (
+        np.asarray(F.reduce_wide(F.sqr_t_wide(ta))) == np.asarray(F.sqr_t(ta))
+    ).all()
+
+
+def test_acc_add_and_loose_reduce_exact():
+    """Accumulated wides reduce to the exact sum mod p, through both the
+    tight and the loose tail; loose output limbs honor the documented
+    <= 2^13 bound and re-enter the mul contracts."""
+    a, b, c, d = (rand_fe() for _ in range(4))
+    w = F.acc_add(
+        F.mul_t_wide(limbs(a), limbs(b)), F.mul_t_wide(limbs(c), limbs(d))
+    )
+    want = (a * b + c * d) % F.P
+    assert ints(F.reduce_wide(w)) % F.P == want
+    loose = F.reduce_wide_loose(w)
+    assert ints(loose) % F.P == want
+    assert np.abs(np.asarray(loose)).max() <= (1 << 13)
+    # subtraction of wides is plain limb arithmetic
+    w2 = F.mul_t_wide(limbs(a), limbs(b)) - F.mul_t_wide(limbs(c), limbs(d))
+    assert ints(F.reduce_wide(w2)) % F.P == (a * b - c * d) % F.P
+    # loose outputs are legal downstream operands
+    assert ints(F.mul_t(loose, loose)) % F.P == want * want % F.P
+
+
+@pytest.fixture
+def restore_reduce():
+    prev = F.reduce_mode()
+    yield
+    F.set_field_modes(reduce=prev)
+
+
+def test_lazy_formulas_equal_eager_mod_p(restore_reduce):
+    """curve.pt_add / pt_double / pt_add_mixed: the lazy bodies produce
+    the SAME canonical values as the eager bodies on random and
+    adversarial (negative-limb, loose) coordinates — the ISSUE 12
+    bit-identity pin (canonical representations compared bit-exact)."""
+    from tpunode.verify.curve import pt_add, pt_add_mixed, pt_double
+
+    def canon_pt(p):
+        return [np.asarray(F.canonical(p[i])) for i in range(3)]
+
+    rng_l = random.Random(99)
+    for _ in range(3):
+        # loose adversarial coords: differences of canonical values
+        coords = []
+        for _ in range(8):
+            x, y = rng_l.getrandbits(256) % F.P, rng_l.getrandbits(256) % F.P
+            coords.append(limbs(x) - limbs(y) + limbs(small := 5))
+        p = [coords[0], coords[1], coords[2]]
+        q = [coords[3], coords[4], coords[5]]
+        q2 = [coords[6], coords[7]]
+        for fn, args in (
+            (pt_add, (p, q)),
+            (pt_double, (p,)),
+            (pt_add_mixed, (p, q2)),
+        ):
+            eager = fn(*args, reduce="eager")
+            lazy = fn(*args, reduce="lazy")
+            for ce, cl in zip(canon_pt(eager), canon_pt(lazy)):
+                assert (ce == cl).all(), fn.__name__
+
+
+def test_reduce_env_knob_rejects_typos(monkeypatch):
+    monkeypatch.setenv("TPUNODE_FIELD_REDUCE", "lazyy")
+    with pytest.raises(ValueError):
+        F._env_mode("TPUNODE_FIELD_REDUCE", F.REDUCE_MODES, "eager")
+    monkeypatch.setenv("TPUNODE_FIELD_REDUCE", " Lazy ")
+    assert (
+        F._env_mode("TPUNODE_FIELD_REDUCE", F.REDUCE_MODES, "eager")
+        == "lazy"
     )
 
 

@@ -582,6 +582,131 @@ def test_kernel_matches_oracle_dot_general_formulation():
         F.set_field_modes(mul=prev[0], sqr=prev[1])
 
 
+# ---------- ISSUE 12: lazy reduction + window width ------------------------
+
+
+@pytest.fixture
+def restore_issue12_modes():
+    from tpunode.verify import field as F
+    from tpunode.verify import kernel as K
+
+    prev_f = F.field_modes()
+    prev_wb = K.window_bits()
+    yield
+    F.set_field_modes(mul=prev_f[0], sqr=prev_f[1], reduce=prev_f[2])
+    K.set_kernel_modes(window_bits=prev_wb)
+
+
+@pytest.mark.slow  # compiles a second full XLA program (~2 min on CPU)
+def test_kernel_lazy_matches_oracle(restore_issue12_modes):
+    """The XLA program under the lazy-reduction field pipeline, through
+    _verify_device_jit (verify_batch_tpu): verdicts bit-identical to the
+    eager program's and the oracle's."""
+    from tpunode.verify import field as F
+
+    items, expected = _random_batch(8)
+    F.set_field_modes(reduce="lazy")
+    assert verify_batch_tpu(items, pad_to=8) == expected
+
+
+@pytest.mark.slow  # compiles a full XLA program per width (~2 min each)
+def test_kernel_window_bits5_matches_oracle(restore_issue12_modes):
+    """window_bits=5 (27 rounds, 32-entry tables) vs window_bits=4 vs
+    the oracle: bit-identical verdicts."""
+    from tpunode.verify import kernel as K
+
+    items, expected = _random_batch(8)
+    K.set_kernel_modes(window_bits=4)
+    got4 = verify_batch_tpu(items, pad_to=8)
+    K.set_kernel_modes(window_bits=5)
+    got5 = verify_batch_tpu(items, pad_to=8)
+    assert got4 == expected
+    assert got5 == expected
+    assert got4 == got5
+
+
+def test_window5_digits_and_tables(restore_issue12_modes):
+    """Host-side 5-bit structure: digit extraction (including digits
+    that straddle 64-bit word edges — impossible at 4-bit, routine at
+    5), the 32-entry constant tables, and the windows()/bound wiring."""
+    from tpunode.verify import kernel as K
+    from tpunode.verify.ecdsa_cpu import GENERATOR, point_mul
+
+    K.set_kernel_modes(window_bits=5)
+    assert K.windows() == 27 and K.window_bits() == 5
+    rng5 = random.Random(0x5B175)
+    vals = [rng5.getrandbits(5 * 27) for _ in range(32)] + [0, 1, (1 << 135) - 1]
+    arr = K._ints_to_digits_np(vals)
+    assert arr.shape == (len(vals), 27)
+    for i, v in enumerate(vals):
+        assert list(arr[i]) == K._digits_base16(v), v
+        # digits reconstruct the value exactly (MSB-first base-32)
+        acc = 0
+        for d in arr[i]:
+            acc = (acc << 5) | int(d)
+        assert acc == v
+    g, lg, g_aff, lg_aff = K.window_tables()
+    assert g.shape == (32, 3, F.NLIMBS) and g_aff.shape == (32, 2, F.NLIMBS)
+    for k in (1, 2, 17, 31):
+        pt = point_mul(k, GENERATOR)
+        assert F.from_limbs(g[k, 0]) == pt.x
+        assert F.from_limbs(g[k, 1]) == pt.y
+    lam17 = point_mul(17 * K.LAMBDA % CURVE_N, GENERATOR)
+    assert F.from_limbs(lg[17, 0]) == lam17.x
+
+
+def test_window_bits_knob_validation_and_cache_key(restore_issue12_modes):
+    """set_kernel_modes validates window_bits, prep falls back to the
+    Python path at 5-bit (the native layout is 4-bit), and both new
+    knobs ride the jit cache key."""
+    from tpunode.verify import field as F2
+    from tpunode.verify import kernel as K
+
+    with pytest.raises(ValueError):
+        K.set_kernel_modes(window_bits=6)
+    before = K.kernel_modes()
+    K.set_kernel_modes(window_bits=5)
+    assert K.kernel_modes() != before
+    assert K.kernel_modes()[-1] == 5
+    assert K.structure_modes()[-1] == 5
+    with pytest.raises(RuntimeError):
+        K.prepare_batch([], native=True)
+    F2.set_field_modes(reduce="lazy")
+    assert "lazy" in K.kernel_modes()
+
+
+def test_window_flip_between_prep_and_dispatch_raises(restore_issue12_modes):
+    """window_bits is the one knob that changes HOST DATA layout: a
+    batch prepped at one width dispatched after the global flips must
+    raise loudly (review r12 — the silent alternative is wrong verdicts,
+    since the window loop takes its trip count from the data but its
+    doubling count from the global)."""
+    from tpunode.verify import kernel as K
+
+    K.set_kernel_modes(window_bits=4)
+    items, _ = _random_batch(2)
+    prep = K.prepare_batch(items, pad_to=8)
+    K.set_kernel_modes(window_bits=5)
+    with pytest.raises(RuntimeError, match="window"):
+        K._dispatch_prep(prep)
+
+
+def test_select_tree_handles_32_entries():
+    """The shared select-tree fold generalizes to 32 entries (5 levels)
+    and stays identical to the one-hot select."""
+    import numpy as np
+
+    from tpunode.verify.kernel import select_tree16
+
+    rng32 = np.random.default_rng(5)
+    entries = [jnp.asarray(rng32.integers(0, 100, size=(3, 4)).astype(np.int32))
+               for _ in range(32)]
+    digits = jnp.asarray(np.array([0, 7, 19, 31], dtype=np.int32))
+    out = np.asarray(select_tree16(entries, digits))
+    for lane, d in enumerate([0, 7, 19, 31]):
+        assert (out[:, lane] == np.asarray(entries[d])[:, lane]).all()
+
+
 def test_mode_flip_changes_the_traced_program():
     """Flipping the formulation must change what a fresh trace of
     verify_core CONTAINS (dot_general MACs present vs absent) — and the

@@ -304,6 +304,76 @@ def test_pallas_affine_full_variant_with_schnorr_lanes():
     assert got == expected
 
 
+# ---------- ISSUE 12: lazy reduction + window width ------------------------
+
+
+def test_pallas_field_wide_api_matches_field_exact():
+    """The Mosaic-form wide-accumulator API is bit-identical to
+    field.py's: same wides, same reductions (tight and loose), same
+    accumulated sums."""
+    for _ in range(20):
+        a_i, b_i, c_i, d_i = (rng.getrandbits(256) % F.P for _ in range(4))
+        a, b, c, d = col(a_i), col(b_i), col(c_i), col(d_i)
+        assert (
+            np.asarray(PF.reduce_wide(PF.mul_wide(a, b)))
+            == np.asarray(F.reduce_wide(F.mul_wide(a, b)))
+        ).all()
+        w_pf = PF.acc_add(PF.mul_t_wide(a, b), PF.mul_t_wide(c, d))
+        w_f = F.acc_add(F.mul_t_wide(a, b), F.mul_t_wide(c, d))
+        assert (np.asarray(w_pf) == np.asarray(w_f)).all()
+        assert (
+            np.asarray(PF.reduce_wide_loose(w_pf))
+            == np.asarray(F.reduce_wide_loose(w_f))
+        ).all()
+        assert (
+            np.asarray(PF.sqr_t_wide(a)) == np.asarray(F.sqr_t_wide(a))
+        ).all()
+        want = (a_i * b_i + c_i * d_i) % F.P
+        got = F.from_limbs(np.asarray(PF.reduce_wide_loose(w_pf))) % F.P
+        assert got == want
+
+
+@pytest.mark.slow  # a fresh interpret trace (~1 min on CPU), same budget
+# discipline as the affine/dot_general variants above
+def test_pallas_lazy_matches_eager_and_oracle():
+    """ISSUE 12 acceptance (pallas-interpret): the lazy-reduction
+    program variant verdicts bit-identically to the eager variant and
+    the oracle."""
+    items, expected = _mixed_items(9)
+    prep = prepare_batch(items, pad_to=16)
+    args = tuple(jnp.asarray(a) for a in prep.device_args)
+    prev = F.field_modes()
+    try:
+        F.set_field_modes(reduce="lazy")
+        lazy = verify_blocked(*args, interpret=True, block=8,
+                              schnorr_free=True)
+        got = [bool(x) for x in np.asarray(lazy)[: prep.count]]
+        assert got == expected
+    finally:
+        F.set_field_modes(reduce=prev[2])
+
+
+@pytest.mark.slow  # a fresh interpret trace (~1 min on CPU)
+def test_pallas_window5_matches_oracle():
+    """ISSUE 12 acceptance (pallas-interpret): the 5-bit window variant
+    (27 rounds, 32-entry VMEM tables, ONE shared G/λG copy across
+    lanes) verdicts bit-identically to the oracle."""
+    from tpunode.verify import kernel as K
+
+    items, expected = _mixed_items(9)
+    prev_wb = K.window_bits()
+    try:
+        K.set_kernel_modes(window_bits=5)
+        prep = prepare_batch(items, pad_to=16)
+        args = tuple(jnp.asarray(a) for a in prep.device_args)
+        out = verify_blocked(*args, interpret=True, block=8,
+                             schnorr_free=True)
+        got = [bool(x) for x in np.asarray(out)[: prep.count]]
+        assert got == expected
+    finally:
+        K.set_kernel_modes(window_bits=prev_wb)
+
+
 @pytest.mark.slow  # a third interpret-mode kernel trace (~1 min on CPU)
 def test_pallas_kernel_interpret_dot_general_matches_oracle():
     """The flagship pallas program under the dot_general formulation:

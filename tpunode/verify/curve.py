@@ -85,6 +85,21 @@ def make_point(x: jnp.ndarray, y: jnp.ndarray, z: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([x, y, z], axis=0)
 
 
+# The formulas read the process-global reduction discipline (ISSUE 12)
+# at trace time unless the caller pins it via their ``reduce=`` kwarg;
+# module-level binding because the ``F`` name is shadowed by the
+# namespace parameter inside the formula bodies.
+_active_reduce = F.reduce_mode
+
+
+def _mk(F_ns):
+    """The point constructor for a formula's namespace: the namespace's
+    own ``make_point`` when it has one (the bound tracker builds plain
+    lists), :func:`make_point` otherwise (jnp stacking for the real
+    field namespaces)."""
+    return getattr(F_ns, "make_point", make_point)
+
+
 INFINITY = make_point(F.ZERO, F.ONE, F.ZERO)
 
 
@@ -98,12 +113,17 @@ def pt_select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(mask, a, b)
 
 
-def pt_add(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
+def pt_add(p: jnp.ndarray, q: jnp.ndarray, F=F, reduce=None) -> jnp.ndarray:
     """Complete addition (RCB'16 Algorithm 7, a = 0): 12 muls, no exceptions.
 
     ``F`` is the field-arithmetic namespace (mul/mul_t/mul_small_red with
     field.py's contracts); the Pallas kernel passes its Mosaic-friendly
     implementation so both device paths share these audited formulas.
+    ``reduce`` pins the reduction discipline ("eager"/"lazy", ISSUE 12) —
+    None reads the process-global :func:`field.reduce_mode` at trace
+    time.  The two bodies produce different limb representations but
+    identical values mod p (pinned in tests/test_field.py); int32 safety
+    of BOTH is checked by tpunode.verify.bounds at trace time.
 
     Limb-bound audit against field.mul's contract (|non-top limb| <= 2^19,
     |top limb| <= 2^15, pairwise top(a)*top(b) <= 2^30): every mul operand
@@ -112,6 +132,8 @@ def pt_add(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
     <= 2^19, top <= 2^12) — the raw B3 scalings that used to exceed the
     top-limb bound now go through mul_small_red.
     """
+    if (reduce or _active_reduce()) == "lazy":
+        return _pt_add_lazy(p, q, F)
     X1, Y1, Z1 = p[0], p[1], p[2]
     X2, Y2, Z2 = q[0], q[1], q[2]
     mul = F.mul
@@ -140,10 +162,54 @@ def pt_add(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
     t0b = mul(t0_3, t3)
     z3 = mul(z3, t4)
     z3 = z3 + t0b
-    return make_point(x3, y3, z3)
+    return _mk(F)(x3, y3, z3)
 
 
-def pt_add_mixed(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
+def _pt_add_lazy(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
+    """The lazy-reduction body of :func:`pt_add` (ISSUE 12): same RCB
+    algebra, three fused carry/fold levers —
+
+    * the three output coordinates, each a ±-sum of two products,
+      accumulate as unreduced 47-limb wides and pay ONE reduction each
+      (3 reductions saved);
+    * every reduction is the LOOSE tail (``reduce_wide_loose``: one
+      carry round cheaper; outputs <= ~2^12.3, inside every consumer's
+      contract);
+    * shared tail operands get ONE hoisted carry round each instead of
+      a fresh pair inside every full mul (6 rounds instead of 12).
+
+    Values differ limb-wise from the eager body's but are equal mod p;
+    the window loop's verdicts are bit-identical.  int32 safety and the
+    2^13 coordinate closure are checked by tpunode.verify.bounds."""
+    X1, Y1, Z1 = p[0], p[1], p[2]
+    X2, Y2, Z2 = q[0], q[1], q[2]
+    rw = F.reduce_wide_loose
+
+    t0 = rw(F.mul_t_wide(X1, X2))
+    t1 = rw(F.mul_t_wide(Y1, Y2))
+    t2 = rw(F.mul_t_wide(Z1, Z2))
+    t3 = rw(F.mul_wide(X1 + Y1, X2 + Y2))
+    t3 = t3 - (t0 + t1)  # = X1*Y2 + X2*Y1
+    t4 = rw(F.mul_wide(Y1 + Z1, Y2 + Z2))
+    t4 = t4 - (t1 + t2)
+    t5 = rw(F.mul_wide(X1 + Z1, X2 + Z2))
+    t5 = t5 - (t0 + t2)  # = X1*Z2 + X2*Z1
+    t2_b3 = F.mul_small_red(t2, B3)
+    # hoisted carry rounds: each shared operand tightens ONCE, then
+    # every product below is a bare convolution (mul_t_wide)
+    t3 = F.tighten(t3)
+    t4 = F.tighten(t4)
+    t0_3 = F.tighten(t0 + t0 + t0)  # 3*X1*X2
+    z3s = F.tighten(t1 + t2_b3)
+    t1m = F.tighten(t1 - t2_b3)
+    y3r = F.tighten(F.mul_small_red(t5, B3))  # b3*(X1*Z2 + X2*Z1)
+    x3 = rw(F.mul_t_wide(t3, t1m) - F.mul_t_wide(t4, y3r))
+    y3 = rw(F.acc_add(F.mul_t_wide(t1m, z3s), F.mul_t_wide(y3r, t0_3)))
+    z3 = rw(F.acc_add(F.mul_t_wide(z3s, t4), F.mul_t_wide(t0_3, t3)))
+    return _mk(F)(x3, y3, z3)
+
+
+def pt_add_mixed(p: jnp.ndarray, q: jnp.ndarray, F=F, reduce=None) -> jnp.ndarray:
     """Complete MIXED addition (RCB'16 Algorithm 8, a = 0): 11 muls + 2
     reduced scalings — one full mul cheaper than :func:`pt_add` because
     ``q`` is affine: a 2-coordinate ``(x2, y2)`` stack with Z2 = 1
@@ -164,7 +230,12 @@ def pt_add_mixed(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
     top*top <= 2^27 < 2^30).  mul_small_red on Z1 (limbs <= 2^13):
     value*21 < 2^271 so non-top <= 2^11 + 2^11*2^7 <= 2^18.1 — z3/t1m
     sums stay inside mul's |non-top| <= 2^19 input contract.
+
+    ``reduce`` as in :func:`pt_add`: the lazy body fuses the same three
+    output accumulations and hoists the shared-operand carry rounds.
     """
+    if (reduce or _active_reduce()) == "lazy":
+        return _pt_add_mixed_lazy(p, q, F)
     X1, Y1, Z1 = p[0], p[1], p[2]
     x2, y2 = q[0], q[1]
     mul = F.mul
@@ -191,16 +262,48 @@ def pt_add_mixed(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
     t0b = mul(t0_3, t3)
     z3 = mul(z3, t4)
     z3 = z3 + t0b
-    return make_point(x3, y3, z3)
+    return _mk(F)(x3, y3, z3)
 
 
-def pt_double(p: jnp.ndarray, F=F) -> jnp.ndarray:
+def _pt_add_mixed_lazy(p: jnp.ndarray, q: jnp.ndarray, F=F) -> jnp.ndarray:
+    """The lazy-reduction body of :func:`pt_add_mixed` (ISSUE 12): the
+    same fused-tail / loose-reduce / hoisted-carry levers as
+    :func:`_pt_add_lazy` over the mixed-add algebra (Z2 = 1)."""
+    X1, Y1, Z1 = p[0], p[1], p[2]
+    x2, y2 = q[0], q[1]
+    rw = F.reduce_wide_loose
+
+    t0 = rw(F.mul_t_wide(X1, x2))
+    t1 = rw(F.mul_t_wide(Y1, y2))
+    t3 = rw(F.mul_wide(X1 + Y1, x2 + y2))
+    t3 = t3 - (t0 + t1)  # = X1*y2 + x2*Y1
+    t4 = rw(F.mul_t_wide(y2, Z1))
+    t4 = t4 + Y1  # = Y1*Z2 + Y2*Z1 with Z2 = 1
+    t5 = rw(F.mul_t_wide(x2, Z1))
+    t5 = t5 + X1  # = X1*Z2 + X2*Z1 with Z2 = 1
+    t2_b3 = F.mul_small_red(Z1, B3)  # b3*Z1*Z2 with Z2 = 1
+    # hoisted carry rounds, one per shared operand (see _pt_add_lazy)
+    t3 = F.tighten(t3)
+    t4 = F.tighten(t4)
+    t0_3 = F.tighten(t0 + t0 + t0)  # 3*X1*X2
+    z3s = F.tighten(t1 + t2_b3)
+    t1m = F.tighten(t1 - t2_b3)
+    y3r = F.tighten(F.mul_small_red(t5, B3))
+    x3 = rw(F.mul_t_wide(t3, t1m) - F.mul_t_wide(t4, y3r))
+    y3 = rw(F.acc_add(F.mul_t_wide(t1m, z3s), F.mul_t_wide(y3r, t0_3)))
+    z3 = rw(F.acc_add(F.mul_t_wide(z3s, t4), F.mul_t_wide(t0_3, t3)))
+    return _mk(F)(x3, y3, z3)
+
+
+def pt_double(p: jnp.ndarray, F=F, reduce=None) -> jnp.ndarray:
     """Complete doubling (RCB'16 Algorithm 9, a = 0): 6 muls + 2 squarings.
 
     ``F`` as in :func:`pt_add`.  The two squarings (Y^2, Z^2) go through
     ``F.sqr_t`` — the dedicated half-product path (~300 partials vs 576)
     under the default sqr mode; same contract as ``mul_t`` and
-    bit-identical output."""
+    bit-identical output.  ``reduce`` as in :func:`pt_add`."""
+    if (reduce or _active_reduce()) == "lazy":
+        return _pt_double_lazy(p, F)
     X, Y, Z = p[0], p[1], p[2]
     mul = F.mul
 
@@ -220,4 +323,28 @@ def pt_double(p: jnp.ndarray, F=F) -> jnp.ndarray:
     t1 = F.mul_t(X, Y)
     x3 = mul(t0, t1)
     x3 = x3 + x3
-    return make_point(x3, y3, z3)
+    return _mk(F)(x3, y3, z3)
+
+
+def _pt_double_lazy(p: jnp.ndarray, F=F) -> jnp.ndarray:
+    """The lazy-reduction body of :func:`pt_double` (ISSUE 12): the
+    eager body's interior ``x3 = b3·Z²·8Y²`` product never materializes
+    reduced — it fuses into y3's accumulation (one reduction saved) —
+    and the three shared operands (8Y², the b3·Z² scaling, and the
+    t0 - 3·t2 difference) each get ONE hoisted carry round instead of
+    per-mul input carries."""
+    X, Y, Z = p[0], p[1], p[2]
+    rw = F.reduce_wide_loose
+
+    t0 = rw(F.sqr_t_wide(Y))
+    z8 = F.tighten(t0 * 8)  # 8Y^2: tightened once, feeds two products
+    t1 = rw(F.mul_t_wide(Y, Z))
+    t2 = F.tighten(F.mul_small_red(rw(F.sqr_t_wide(Z)), B3))  # b3*Z^2
+    y3s = t0 + t2
+    t0m = F.tighten(t0 - (t2 + t2 + t2))
+    z3 = rw(F.mul_t_wide(t1, z8))
+    y3 = rw(F.acc_add(F.mul_t_wide(t2, z8), F.mul_t_wide(t0m, y3s)))
+    t1b = rw(F.mul_t_wide(X, Y))
+    x3 = rw(F.mul_t_wide(t0m, t1b))
+    x3 = x3 + x3
+    return _mk(F)(x3, y3, z3)

@@ -408,20 +408,44 @@ class VerifyConfig:
     # kernel.kernel_modes(), so the first dispatch traces the requested
     # formulation.  Verdicts are bit-identical across forms.
     point_form: Optional[str] = None
+    # Field reduction discipline (ISSUE 12): None keeps the process-wide
+    # mode (TPUNODE_FIELD_REDUCE env knob); "eager"/"lazy" select
+    # explicitly.  "lazy" accumulates unreduced products in curve.py's
+    # formulas and pays one reduction per expression — values differ
+    # limb-wise, verdicts are bit-identical; int32 safety is asserted at
+    # trace time by tpunode.verify.bounds.
+    field_reduce: Optional[str] = None
+    # MSM window width (ISSUE 12): None keeps the process-wide mode
+    # (TPUNODE_WINDOW_BITS env knob); 4 keeps the 33-round/16-entry r3
+    # structure, 5 runs 27 rounds over 32-entry tables (host prep falls
+    # back to the Python path — the native layout is 4-bit).
+    window_bits: Optional[int] = None
 
     def __post_init__(self):
         if self.device_batch < self.batch_size:
             self.device_batch = self.batch_size
         if self.pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
-        if self.field_mul is not None or self.field_sqr is not None:
+        if (
+            self.field_mul is not None
+            or self.field_sqr is not None
+            or self.field_reduce is not None
+        ):
             from . import field as _field
 
-            _field.set_field_modes(mul=self.field_mul, sqr=self.field_sqr)
+            _field.set_field_modes(
+                mul=self.field_mul,
+                sqr=self.field_sqr,
+                reduce=self.field_reduce,
+            )
         if self.point_form is not None:
             from . import curve as _curve
 
             _curve.set_point_form(self.point_form)
+        if self.window_bits is not None:
+            from . import kernel as _kernel
+
+            _kernel.set_kernel_modes(window_bits=self.window_bits)
 
 
 class VerifyEngine:

@@ -73,6 +73,13 @@ __all__ = [
     "sqr",
     "sqr_t",
     "mul_small_red",
+    "mul_wide",
+    "mul_t_wide",
+    "sqr_wide",
+    "sqr_t_wide",
+    "acc_add",
+    "reduce_wide",
+    "reduce_wide_loose",
     "tighten",
     "canonical",
     "is_zero",
@@ -82,9 +89,11 @@ __all__ = [
     "ONE",
     "MUL_MODES",
     "SQR_MODES",
+    "REDUCE_MODES",
     "field_modes",
     "mul_mode",
     "sqr_mode",
+    "reduce_mode",
     "set_field_modes",
 ]
 
@@ -141,6 +150,20 @@ ONE = jnp.zeros((NLIMBS, 1), dtype=jnp.int32).at[0].set(1)
 
 MUL_MODES = ("shift_add", "dot_general")
 SQR_MODES = ("half", "mul")
+# Reduction discipline (ISSUE 12): "eager" reduces every product to 24
+# limbs on the spot (the r3-r11 behavior); "lazy" lets curve.py's RCB
+# formulas accumulate unreduced 47-limb convolutions (mul_wide/acc_add
+# below) and pay ONE _reduce_wide per accumulated expression, with
+# shared-operand carry rounds hoisted — the fused carry/fold rounds
+# ROADMAP item 1 names.  Values differ limb-wise between modes but are
+# equal mod p (pinned in tests/test_field.py); verdicts are
+# bit-identical.  int32 safety of every lazy chain is CHECKED at trace
+# time by tpunode.verify.bounds (not argued in comments).  "lazy" is
+# the default since round 12: −27% carry/fold vector ops in the op
+# model and a −9.5% measured step on the cpu-jax proxy @1024 (PERF.md;
+# campaign-clean on XLA and pallas-interpret, device verdict pending
+# the watcher's kind="lazy" rungs).
+REDUCE_MODES = ("eager", "lazy")
 
 
 def _env_mode(var: str, allowed: tuple, default: str) -> str:
@@ -157,6 +180,7 @@ def _env_mode(var: str, allowed: tuple, default: str) -> str:
 
 _MUL_MODE = _env_mode("TPUNODE_FIELD_MUL", MUL_MODES, "shift_add")
 _SQR_MODE = _env_mode("TPUNODE_FIELD_SQR", SQR_MODES, "half")
+_REDUCE_MODE = _env_mode("TPUNODE_FIELD_REDUCE", REDUCE_MODES, "lazy")
 
 
 def mul_mode() -> str:
@@ -169,33 +193,48 @@ def sqr_mode() -> str:
     return _SQR_MODE
 
 
+def reduce_mode() -> str:
+    """Active reduction discipline: "eager" | "lazy" (ISSUE 12)."""
+    return _REDUCE_MODE
+
+
 def field_modes() -> tuple:
-    """Hashable (mul_mode, sqr_mode) — THE jit-cache key for every program
-    that embeds field ops (a trace bakes the formulation in)."""
-    return (_MUL_MODE, _SQR_MODE)
+    """Hashable (mul_mode, sqr_mode, reduce_mode) — THE jit-cache key for
+    every program that embeds field ops (a trace bakes the formulation
+    in; the reduce mode changes curve.py's traced formula bodies)."""
+    return (_MUL_MODE, _SQR_MODE, _REDUCE_MODE)
 
 
-def set_field_modes(mul: str | None = None, sqr: str | None = None) -> tuple:
-    """Select the limb-product / squaring formulation process-wide.
+def set_field_modes(
+    mul: str | None = None,
+    sqr: str | None = None,
+    reduce: str | None = None,
+) -> tuple:
+    """Select the limb-product / squaring / reduction formulation
+    process-wide.
 
-    Returns the previous (mul_mode, sqr_mode) so callers can restore.
-    Programs traced BEFORE the flip keep their formulation until their
-    owner re-traces — which every in-repo dispatch site does, because all
-    of them key on :func:`field_modes`.
+    Returns the previous (mul_mode, sqr_mode, reduce_mode) so callers can
+    restore.  Programs traced BEFORE the flip keep their formulation until
+    their owner re-traces — which every in-repo dispatch site does,
+    because all of them key on :func:`field_modes`.
     """
-    global _MUL_MODE, _SQR_MODE
-    # Validate BOTH before mutating either: a caller that catches the
+    global _MUL_MODE, _SQR_MODE, _REDUCE_MODE
+    # Validate ALL before mutating any: a caller that catches the
     # ValueError must find the process-global modes untouched, not
     # half-flipped (which would silently mislabel every later trace).
     if mul is not None and mul not in MUL_MODES:
         raise ValueError(f"mul mode {mul!r} not in {MUL_MODES}")
     if sqr is not None and sqr not in SQR_MODES:
         raise ValueError(f"sqr mode {sqr!r} not in {SQR_MODES}")
-    prev = (_MUL_MODE, _SQR_MODE)
+    if reduce is not None and reduce not in REDUCE_MODES:
+        raise ValueError(f"reduce mode {reduce!r} not in {REDUCE_MODES}")
+    prev = (_MUL_MODE, _SQR_MODE, _REDUCE_MODE)
     if mul is not None:
         _MUL_MODE = mul
     if sqr is not None:
         _SQR_MODE = sqr
+    if reduce is not None:
+        _REDUCE_MODE = reduce
     return prev
 
 
@@ -426,6 +465,76 @@ def mul_small_red(a: jnp.ndarray, k: int) -> jnp.ndarray:
     audit relies on this).
     """
     return _fold_top(a * k)
+
+
+# ---------- lazy-reduction wide-accumulator API (ISSUE 12) ----------------
+#
+# A "wide" value is the unreduced 47-limb convolution of one product —
+# exactly what _reduce_wide consumes.  Wides of the SAME expression may be
+# summed limb-wise (acc_add) before the one shared reduction, eliminating
+# the interior carry/fold rounds the eager formulas pay per product.
+# Wides are plain (47, ...) int32 arrays: negation and subtraction are
+# ordinary elementwise arithmetic (value-exact, sign-correct).
+#
+# int32-safety of every accumulation chain is NOT argued here: the static
+# bound tracker (tpunode.verify.bounds) replays each live formula over
+# exact per-limb magnitude bounds and hard-fails at trace time if any
+# anti-diagonal sum, accumulated wide, or reduction intermediate can
+# exceed int32.  That audit — not these docstrings — is the contract.
+
+
+def mul_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """``mul`` minus the reduction tail: one carry round per input, then
+    the limb convolution.  Input contract identical to :func:`mul`'s;
+    output is the (47, ...) wide for :func:`acc_add`/:func:`reduce_wide`.
+    ``reduce_wide(mul_wide(a, b))`` is bit-identical to ``mul(a, b)``."""
+    return _convolve(_carry(a, 1), _carry(b, 1))
+
+
+def mul_t_wide(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """``mul_t`` minus the reduction tail (pre-tight operands, every
+    |limb| <= 2^13 — :func:`mul_t`'s contract)."""
+    return _convolve(a, b)
+
+
+def sqr_wide(a: jnp.ndarray) -> jnp.ndarray:
+    """``sqr`` minus the reduction tail (mul's input contract)."""
+    return _square_conv(_carry(a, 1))
+
+
+def sqr_t_wide(a: jnp.ndarray) -> jnp.ndarray:
+    """``sqr_t`` minus the reduction tail (mul_t's contract)."""
+    return _square_conv(a)
+
+
+def acc_add(*wides: jnp.ndarray) -> jnp.ndarray:
+    """Sum unreduced wides limb-wise — the lazy accumulator.  Value-exact
+    (int adds); the per-limb magnitude bound is the SUM of the operands'
+    bounds, which the bound tracker checks against int32 at trace time."""
+    out = wides[0]
+    for w in wides[1:]:
+        out = out + w
+    return out
+
+
+def reduce_wide(wide: jnp.ndarray) -> jnp.ndarray:
+    """Public reduction tail: 47 loose product limbs (or an acc_add of a
+    few) -> 24 limbs, every |limb| <= 2^12.  The one reduction a lazy
+    expression pays."""
+    return _reduce_wide(wide)
+
+
+def reduce_wide_loose(wide: jnp.ndarray) -> jnp.ndarray:
+    """``reduce_wide`` minus the final carry round (4 carry rounds + 2
+    folds instead of 5 + 2): output limbs are LOOSE — |limb| <= ~2^12.3
+    (bound-tracker-checked <= 2^13) instead of <= 2^12 — but that still
+    satisfies every consumer the lazy formulas have (coordinate sums,
+    mul_t_wide convolutions, mul_small_red).  The default reduction of
+    the lazy pipeline: one carry round saved per product."""
+    wide = _carry(_pad(wide, 1), 2)
+    x = _fold_once(wide)
+    x = _carry(x, 1)
+    return _fold_top(x)
 
 
 # ---------- exact canonicalization & comparisons ----------

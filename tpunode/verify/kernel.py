@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..trace import span
+from . import bounds as _bounds
 from . import field as F
 from .curve import (
     B3,
@@ -61,6 +62,11 @@ log = logging.getLogger("tpunode.verify")
 __all__ = [
     "WINDOWS",
     "WINDOW_BITS",
+    "WINDOW_BITS_MODES",
+    "window_bits",
+    "windows",
+    "window_tables",
+    "set_kernel_modes",
     "LAMBDA",
     "BETA",
     "glv_split",
@@ -103,10 +109,21 @@ __all__ = [
 
 SELECT_MODES = ("tree", "onehot")
 POW_LADDER_MODES = ("scan", "unroll")
+# MSM window width (ISSUE 12): 4-bit keeps the r3 33-round / 16-entry
+# structure; 5-bit cuts the window rounds to 27 (4 fewer of everything
+# per half-scalar: doublings, selects, adds) at the cost of 32-entry
+# tables — the larger-VMEM-tables lever ROADMAP item 1 names.  The
+# constant-exponent pow ladders stay 4-bit regardless (their 64-digit
+# exponents are compile-time constants unrelated to the GLV windows).
+WINDOW_BITS_MODES = (4, 5)
+_WINDOWS_BY_BITS = {4: 33, 5: 27}  # ceil(~2^129 GLV halves / width) + slack
 
 _SELECT_MODE = F._env_mode("TPUNODE_SELECT16", SELECT_MODES, "tree")
 _POW_LADDER_MODE = F._env_mode(
     "TPUNODE_POW_LADDER", POW_LADDER_MODES, "scan"
+)
+_WINDOW_BITS = int(
+    F._env_mode("TPUNODE_WINDOW_BITS", ("4", "5"), "4")
 )
 
 
@@ -120,33 +137,54 @@ def pow_ladder_mode() -> str:
     return _POW_LADDER_MODE
 
 
+def window_bits() -> int:
+    """Active MSM window width in bits: 4 | 5 (ISSUE 12)."""
+    return _WINDOW_BITS
+
+
+def windows() -> int:
+    """Window rounds for the active width (33 at 4-bit, 27 at 5-bit)."""
+    return _WINDOWS_BY_BITS[_WINDOW_BITS]
+
+
 def set_kernel_modes(
-    select: Optional[str] = None, pow_ladder: Optional[str] = None
+    select: Optional[str] = None,
+    pow_ladder: Optional[str] = None,
+    window_bits: Optional[int] = None,
 ) -> tuple:
     """Select the kernel-structure formulations process-wide; returns the
-    previous (select_mode, pow_ladder_mode).  Validates BOTH before
-    mutating either (field.set_field_modes's contract)."""
-    global _SELECT_MODE, _POW_LADDER_MODE
+    previous (select_mode, pow_ladder_mode, window_bits).  Validates ALL
+    before mutating any (field.set_field_modes's contract)."""
+    global _SELECT_MODE, _POW_LADDER_MODE, _WINDOW_BITS
     if select is not None and select not in SELECT_MODES:
         raise ValueError(f"select mode {select!r} not in {SELECT_MODES}")
     if pow_ladder is not None and pow_ladder not in POW_LADDER_MODES:
         raise ValueError(
             f"pow ladder mode {pow_ladder!r} not in {POW_LADDER_MODES}"
         )
-    prev = (_SELECT_MODE, _POW_LADDER_MODE)
+    if window_bits is not None and window_bits not in WINDOW_BITS_MODES:
+        raise ValueError(
+            f"window bits {window_bits!r} not in {WINDOW_BITS_MODES}"
+        )
+    prev = (_SELECT_MODE, _POW_LADDER_MODE, _WINDOW_BITS)
     if select is not None:
         _SELECT_MODE = select
     if pow_ladder is not None:
         _POW_LADDER_MODE = pow_ladder
+    if window_bits is not None:
+        _WINDOW_BITS = window_bits
     return prev
 
 
 def kernel_modes() -> tuple:
     """Hashable static jit-cache key for EVERY program that embeds the
-    MSM: the field formulation (field.field_modes()), the point form
-    (curve.point_form()), and the select/ladder shapes above — all
-    process globals read at trace time, so they must force a retrace."""
-    return F.field_modes() + (point_form(), _SELECT_MODE, _POW_LADDER_MODE)
+    MSM: the field formulation (field.field_modes(), which carries the
+    ISSUE 12 reduce mode), the point form (curve.point_form()), and the
+    select/ladder/window-width shapes above — all process globals read
+    at trace time, so they must force a retrace."""
+    return F.field_modes() + (
+        point_form(), _SELECT_MODE, _POW_LADDER_MODE, _WINDOW_BITS,
+    )
 
 
 def structure_modes() -> tuple:
@@ -156,11 +194,14 @@ def structure_modes() -> tuple:
     there too would double-encode it and retrace the identical program
     under a second key whenever the explicit argument and the global
     disagree (review r8)."""
-    return F.field_modes() + (_SELECT_MODE, _POW_LADDER_MODE)
+    return F.field_modes() + (_SELECT_MODE, _POW_LADDER_MODE, _WINDOW_BITS)
 
+# Default (4-bit) structure constants: the pow ladders' window width is
+# ALWAYS 4 (compile-time 64-digit exponents); the MSM follows the
+# window_bits()/windows() accessors above.
 WINDOW_BITS = 4
 # GLV half-scalars are bounded by ~2^129 (asserted per-item in
-# prepare_batch): 33 windows cover 132 bits.
+# prepare_batch): 33 windows cover 132 bits at 4-bit width.
 WINDOWS = 33
 
 # --- the secp256k1 endomorphism (standard public constants) ---------------
@@ -202,14 +243,15 @@ def glv_split(k: int) -> tuple[int, int]:
     return k1, k2
 
 
-def _table_np(base: Point) -> np.ndarray:
-    """Constant table [O, P, 2P, ..., 15P] as projective limb points."""
+def _table_np(base: Point, entries: int = 16) -> np.ndarray:
+    """Constant table [O, P, 2P, ..., (entries-1)P] as projective limb
+    points."""
     from .ecdsa_cpu import INFINITY as OINF, point_add
 
-    table = np.zeros((16, 3, F.NLIMBS), dtype=np.int32)
+    table = np.zeros((entries, 3, F.NLIMBS), dtype=np.int32)
     table[0, 1, 0] = 1  # (0 : 1 : 0)
     acc = OINF
-    for k in range(1, 16):
+    for k in range(1, entries):
         acc = point_add(acc, base)
         table[k, 0] = F.to_limbs(acc.x)
         table[k, 1] = F.to_limbs(acc.y)
@@ -229,6 +271,26 @@ LG_TABLE = jnp.array(
 # through a branch-free select instead).
 G_TABLE_AFF = G_TABLE[:, :2]  # (16, 2, NLIMBS)
 LG_TABLE_AFF = LG_TABLE[:, :2]
+
+# Per-window-width constant tables (ISSUE 12), cached as PURE NUMPY:
+# the first fetch can happen inside a jit trace, where any jnp value
+# created (even from constants) is that trace's tracer — caching one
+# would poison every later trace.  Numpy constants lift cleanly into
+# whichever trace uses them.
+_WINDOW_TABLES: dict = {}
+
+
+def window_tables() -> tuple:
+    """(G, λG, G_affine, λG_affine) constant tables for the ACTIVE
+    window width — numpy, (2^wb, 3|2, NLIMBS) each."""
+    got = _WINDOW_TABLES.get(_WINDOW_BITS)
+    if got is None:
+        ent = 1 << _WINDOW_BITS
+        g = _table_np(GENERATOR, ent)
+        lg = _table_np(Point(BETA * GENERATOR.x % CURVE_P, GENERATOR.y), ent)
+        got = (g, lg, g[:, :2], lg[:, :2])
+        _WINDOW_TABLES[_WINDOW_BITS] = got
+    return got
 
 
 # One annotated list drives PreparedBatch.__slots__, the device_args order
@@ -310,10 +372,11 @@ def _batch_inverse_mod_n(values: list[int]) -> list[int]:
 
 
 def _digits_base16(v: int) -> list[int]:
-    """WINDOWS base-16 digits of a nonnegative int, most significant first."""
-    return [
-        (v >> (WINDOW_BITS * (WINDOWS - 1 - i))) & 0xF for i in range(WINDOWS)
-    ]
+    """windows() base-2^wb digits of a nonnegative int, most significant
+    first (historical name: base-16 under the default 4-bit width)."""
+    wb, nwin = _WINDOW_BITS, windows()
+    mask = (1 << wb) - 1
+    return [(v >> (wb * (nwin - 1 - i))) & mask for i in range(nwin)]
 
 
 def _ints_to_limbs_np(vals: list[int]) -> np.ndarray:
@@ -338,17 +401,22 @@ def _ints_to_limbs_np(vals: list[int]) -> np.ndarray:
 
 
 def _ints_to_digits_np(vals: list[int]) -> np.ndarray:
-    """Vectorized ``_digits_base16``: ints < 2^(4*WINDOWS) -> (len, WINDOWS)
-    int32, MSB-first.  4-bit digits never straddle 64-bit word edges."""
+    """Vectorized ``_digits_base16``: ints < 2^(wb*windows()) ->
+    (len, windows()) int32, MSB-first.  4-bit digits never straddle
+    64-bit word edges; 5-bit digits can, so the straddle path ORs in the
+    next word's low bits (same trick as ``_ints_to_limbs_np``)."""
+    wb, nwin = _WINDOW_BITS, windows()
+    mask = (1 << wb) - 1
     n = len(vals)
     buf = b"".join(v.to_bytes(24, "little") for v in vals)
     words = np.frombuffer(buf, dtype="<u8").reshape(n, 3)
-    out = np.zeros((n, WINDOWS), dtype=np.int32)
-    for j in range(WINDOWS):
-        w, off = divmod(WINDOW_BITS * (WINDOWS - 1 - j), 64)
-        out[:, j] = ((words[:, w] >> np.uint64(off)) & np.uint64(0xF)).astype(
-            np.int32
-        )
+    out = np.zeros((n, nwin), dtype=np.int32)
+    for j in range(nwin):
+        w, off = divmod(wb * (nwin - 1 - j), 64)
+        lo = words[:, w] >> np.uint64(off)
+        if off > 64 - wb and w + 1 < 3:  # digit straddles a word edge
+            lo = lo | (words[:, w + 1] << np.uint64(64 - off))
+        out[:, j] = (lo & np.uint64(mask)).astype(np.int32)
     return out
 
 
@@ -377,8 +445,18 @@ def prepare_batch(
     ``native=None`` auto-selects the C++ fast path (secp_prepare_batch in
     native/secp256k1 — batch inversion, GLV split, digit/limb conversion;
     bit-identical outputs, ~10x the Python rate) when the library loads;
-    ``native=False`` forces the pure-Python reference path.
+    ``native=False`` forces the pure-Python reference path.  The native
+    path emits the default 33x4-bit digit layout, so the 5-bit window
+    mode (ISSUE 12) always preps in Python — a documented host-prep cost
+    of the experiment, not a correctness fork.
     """
+    if native is not False and _WINDOW_BITS != 4:
+        if native is True:
+            raise RuntimeError(
+                "native prep emits 4-bit digits; window_bits="
+                f"{_WINDOW_BITS} requires the Python path"
+            )
+        native = False
     if native is not False:
         prep = _prepare_batch_native(items, pad_to)
         if prep is not None or native is True:
@@ -388,10 +466,11 @@ def prepare_batch(
     count = len(items)
     size = pad_to or count
     assert size >= count
-    d1a = np.zeros((size, WINDOWS), dtype=np.int32)
-    d1b = np.zeros((size, WINDOWS), dtype=np.int32)
-    d2a = np.zeros((size, WINDOWS), dtype=np.int32)
-    d2b = np.zeros((size, WINDOWS), dtype=np.int32)
+    nwin = windows()
+    d1a = np.zeros((size, nwin), dtype=np.int32)
+    d1b = np.zeros((size, nwin), dtype=np.int32)
+    d2a = np.zeros((size, nwin), dtype=np.int32)
+    d2b = np.zeros((size, nwin), dtype=np.int32)
     negs = np.zeros((4, size), dtype=bool)
     qx = np.zeros((size, F.NLIMBS), dtype=np.int32)
     qy = np.zeros((size, F.NLIMBS), dtype=np.int32)
@@ -425,7 +504,7 @@ def prepare_batch(
     inv_by_idx = dict(zip(s_idx, s_inv))
 
     digit_arrays = (d1a, d1b, d2a, d2b)
-    bound = 1 << (WINDOW_BITS * WINDOWS)
+    bound = 1 << (_WINDOW_BITS * nwin)
     # Gather per-valid-lane scalars, then convert in bulk with numpy
     # (the per-int Python limb/digit loops dominate prep otherwise).
     idxs: list[int] = []
@@ -452,7 +531,7 @@ def prepare_batch(
             if abs(k) >= bound:  # not assert: -O must not strip a consensus guard
                 raise ValueError(
                     f"GLV half-scalar out of window range: |{k}| >= 2^"
-                    f"{WINDOW_BITS * WINDOWS} (item {i}, half {j})"
+                    f"{_WINDOW_BITS * nwin} (item {i}, half {j})"
                 )
             negs[j, i] = k < 0
             half_abs[j].append(abs(k))
@@ -509,6 +588,8 @@ def _prepare_batch_native(
     """
     from .cpu_native import load_native_verifier
 
+    if _WINDOW_BITS != 4:  # native emits the 33x4-bit digit layout only
+        return None
     nv = load_native_verifier()
     if nv is None:
         return None
@@ -572,10 +653,11 @@ def prepare_batch_raw(raw, pad_to: Optional[int] = None) -> PreparedBatch:
     """Host prep from a packed :class:`tpunode.verify.raw.RawBatch` — the
     zero-Python-int path from the native extractor straight into
     ``secp_prepare_batch`` (which redoes all range checks on the raw rows).
-    Falls back to the tuple path when the native library is unavailable."""
+    Falls back to the tuple path when the native library is unavailable
+    or the active window width needs Python-side digits (ISSUE 12)."""
     from .cpu_native import load_native_verifier
 
-    nv = load_native_verifier()
+    nv = load_native_verifier() if _WINDOW_BITS == 4 else None
     if nv is None:
         return prepare_batch(raw.to_tuples(), pad_to=pad_to, native=False)
     count = len(raw)
@@ -613,15 +695,17 @@ def prepare_batch_raw(raw, pad_to: Optional[int] = None) -> PreparedBatch:
 
 
 def _build_q_table(qx: jnp.ndarray, qy: jnp.ndarray) -> jnp.ndarray:
-    """Per-signature table [O, Q, 2Q, ..., 15Q], shape (16, 3, L, B).
+    """Per-signature table [O, Q, 2Q, ..., (2^wb - 1)Q], shape
+    (2^wb, 3, L, B) — 16 entries at the default 4-bit width, 32 at 5-bit
+    (ISSUE 12).
 
     Under the ``unroll`` ladder mode the build is a de-scanned log-depth
-    double-and-add chain (ISSUE 8 lever 2): 7 complete doublings + 7
-    complete additions (vs the scan's 14 sequential adds — fewer field
-    muls AND a critical path of depth ~5 instead of 14).  ``scan`` (the
-    default — see the knob comment for the measured why) keeps the r3
-    sequential form.  Both are exact, so verdicts are bit-identical
-    either way."""
+    double-and-add chain (ISSUE 8 lever 2): complete doublings + complete
+    additions (vs the scan's sequential adds — fewer field muls AND a
+    much shorter critical path).  ``scan`` (the default — see the knob
+    comment for the measured why) keeps the r3 sequential form.  Both
+    are exact, so verdicts are bit-identical either way."""
+    ent_n = 1 << _WINDOW_BITS
     q1 = make_point(qx, qy, jnp.broadcast_to(F.ONE, qx.shape))
     inf = jnp.broadcast_to(INFINITY, q1.shape)
     if _POW_LADDER_MODE == "scan":
@@ -629,11 +713,11 @@ def _build_q_table(qx: jnp.ndarray, qy: jnp.ndarray) -> jnp.ndarray:
             nxt = pt_add(acc, q1)
             return nxt, nxt
 
-        _, multiples = lax.scan(step, q1, None, length=14)  # 2Q..15Q
+        _, multiples = lax.scan(step, q1, None, length=ent_n - 2)  # 2Q..
         return jnp.concatenate([inf[None], q1[None], multiples], axis=0)
-    ent: list = [None] * 16
+    ent: list = [None] * ent_n
     ent[0], ent[1] = inf, q1
-    for k in range(2, 16):
+    for k in range(2, ent_n):
         ent[k] = pt_double(ent[k // 2]) if k % 2 == 0 else pt_add(ent[k - 1], q1)
     return jnp.stack(ent, axis=0)
 
@@ -648,23 +732,29 @@ def _lambda_table(q_table: jnp.ndarray) -> jnp.ndarray:
 
 
 def _select_entry_onehot(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
-    """One-hot select: table (16, C, L, B) or (16, C, L), digits (B,) -> (C, L, B)."""
-    onehot = jax.nn.one_hot(digits, 16, dtype=jnp.int32).T  # (16, B)
+    """One-hot select: table (T, C, L, B) or (T, C, L), digits (B,) ->
+    (C, L, B); T = 2^window_bits entries."""
+    onehot = jax.nn.one_hot(
+        digits, int(table.shape[0]), dtype=jnp.int32
+    ).T  # (T, B)
     if table.ndim == 3:
         return jnp.einsum("tb,tcl->clb", onehot, table)
     return jnp.einsum("tb,tclb->clb", onehot, table)
 
 
 def select_tree16(entries: list, digits: jnp.ndarray) -> jnp.ndarray:
-    """THE balanced 4-level binary select-tree fold (ISSUE 8 lever 3):
-    15 wheres, level ``i`` resolving digit bit ``i``.  ``entries`` are
-    the 16 table entries (arrays or VMEM-ref reads), ``digits`` any
-    digit array that broadcasts against them under ``jnp.where``.
-    Shared by the XLA select below AND the Pallas ``_select16`` tree
-    branch so the two device paths cannot diverge (one fold, the same
-    way curve.py's formulas are shared via the ``F=`` namespace)."""
+    """THE balanced binary select-tree fold (ISSUE 8 lever 3): T-1
+    wheres over T entries (a power of two — 16 at 4-bit windows, 32 at
+    5-bit), level ``i`` resolving digit bit ``i``.  ``entries`` are the
+    table entries (arrays or VMEM-ref reads), ``digits`` any digit array
+    that broadcasts against them under ``jnp.where``.  Shared by the XLA
+    select below AND the Pallas ``_select16`` tree branch so the two
+    device paths cannot diverge (one fold, the same way curve.py's
+    formulas are shared via the ``F=`` namespace)."""
     level = list(entries)
-    for i in range(4):
+    depth = (len(level) - 1).bit_length()
+    assert len(level) == 1 << depth, "select tree needs 2^k entries"
+    for i in range(depth):
         bit = ((digits >> i) & 1) == 1
         level = [
             jnp.where(bit, level[2 * j + 1], level[2 * j])
@@ -674,14 +764,16 @@ def select_tree16(entries: list, digits: jnp.ndarray) -> jnp.ndarray:
 
 
 def _select_entry_tree(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
-    """Balanced select tree over a stacked table: 15 wheres moving 15
-    entry-volumes of data vs the one-hot form's 16 multiplies + 15 adds
+    """Balanced select tree over a stacked table: T-1 wheres moving T-1
+    entry-volumes of data vs the one-hot form's T multiplies + T-1 adds
     over the whole table — and no integer multiplies at all.  Identical
-    output to the one-hot select for digits in [0, 16)."""
-    if table.ndim == 3:  # constant (16, C, L) table: broadcast over lanes
+    output to the one-hot select for digits in [0, T)."""
+    if table.ndim == 3:  # constant (T, C, L) table: broadcast over lanes
         table = table[..., None]
     # digits (B,) broadcasts over each (C, L, B) entry
-    return select_tree16([table[t] for t in range(16)], digits)
+    return select_tree16(
+        [table[t] for t in range(int(table.shape[0]))], digits
+    )
 
 
 def _select_entry(table: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
@@ -720,15 +812,18 @@ def _normalize_q_table(
     masked by host_valid/on_curve in the verdict.
 
     ``F``/``pow_const`` parameterized like curve.py's formulas so the
-    roofline can count this function by executing it."""
+    roofline can count this function by executing it.  Entry count
+    follows the table's leading axis (16 at 4-bit windows, 32 at
+    5-bit)."""
     if pow_const is None:
         pow_const = _pow_const
-    zs = [q_table[k, 2] for k in range(2, 16)]  # (L, B) each
+    ent_n = int(q_table.shape[0])
+    zs = [q_table[k, 2] for k in range(2, ent_n)]  # (L, B) each
     prefix = [zs[0]]  # prefix[i] = z_2 * ... * z_{i+2}
     for z in zs[1:]:
         prefix.append(F.mul(prefix[-1], z))
-    inv = pow_const(prefix[-1], _PM2_DIGITS)  # ONE ladder for all 14
-    ent: list = [None] * 16
+    inv = pow_const(prefix[-1], _PM2_DIGITS)  # ONE ladder for the table
+    ent: list = [None] * ent_n
     shape = q_table.shape[-2:]
     ent[0] = jnp.stack(
         [jnp.broadcast_to(F.ZERO, shape), jnp.broadcast_to(F.ONE, shape)],
@@ -736,7 +831,7 @@ def _normalize_q_table(
     )
     ent[1] = q_table[1, :2]  # (qx, qy): affine by construction
     run = inv  # invariant entering entry k: run = (z_2 ... z_k)^-1
-    for k in range(15, 1, -1):
+    for k in range(ent_n - 1, 1, -1):
         zinv = F.mul(run, prefix[k - 3]) if k > 2 else run
         ent[k] = jnp.stack(
             [F.mul(q_table[k, 0], zinv), F.mul(q_table[k, 1], zinv)], axis=0
@@ -856,23 +951,45 @@ def verify_core(
     Montgomery-trick inversion per lane and runs the window loop on
     2-coordinate tables with the 11-mul complete MIXED add (digit 0 —
     the infinity entry, unrepresentable in affine — keeps the
-    accumulator through a branch-free select).  Verdicts are
-    bit-identical across forms (everything downstream is exact mod p).
+    accumulator through a branch-free select).  The MSM's window width
+    and reduction discipline follow ``window_bits()`` and
+    ``field.reduce_mode()`` (ISSUE 12) — per-window doublings equal the
+    width, table/select sizes equal 2^width.  Verdicts are bit-identical
+    across forms/widths/disciplines (everything downstream is exact
+    mod p).
     """
-    q_table = _build_q_table(qx, qy)  # (16, 3, L, B)
+    # Trace-time int32 safety audit of the live formulas under the
+    # active reduce mode (ISSUE 12): cached pure-Python bound replay —
+    # a formula edit that breaks headroom fails HERE, not on device.
+    _bounds.assert_formulas_safe()
+
+    # Trace-time data/mode consistency (the shape is static in a trace):
+    # digit rows prepped at one window width driven by another width's
+    # doubling count would be silently wrong verdicts, not an error.
+    if d1a.shape[0] != windows():
+        raise RuntimeError(
+            f"digit arrays carry {d1a.shape[0]} window rows but the "
+            f"active window_bits={_WINDOW_BITS} needs {windows()}: "
+            "re-prepare the batch under the active mode"
+        )
+
+    wb = _WINDOW_BITS
+    g_tab, lg_tab, g_aff, lg_aff = window_tables()
+    q_table = _build_q_table(qx, qy)  # (2^wb, 3, L, B)
 
     acc0 = jnp.broadcast_to(INFINITY, (3, F.NLIMBS, qx.shape[1]))
 
     if point_form() == "affine":
-        q_aff = _normalize_q_table(q_table)  # (16, 2, L, B)
+        q_aff = _normalize_q_table(q_table)  # (2^wb, 2, L, B)
         lq_aff = _lambda_table(q_aff)  # β-scaled X, same trick
 
         def window_step(acc, digits):
             da, db, dc, dd = digits
-            acc = pt_double(pt_double(pt_double(pt_double(acc))))
+            for _ in range(wb):
+                acc = pt_double(acc)
             for table, d, neg in (
-                (G_TABLE_AFF, da, n1a),
-                (LG_TABLE_AFF, db, n1b),
+                (g_aff, da, n1a),
+                (lg_aff, db, n1b),
                 (q_aff, dc, n2a),
                 (lq_aff, dd, n2b),
             ):
@@ -885,9 +1002,10 @@ def verify_core(
 
         def window_step(acc, digits):
             da, db, dc, dd = digits
-            acc = pt_double(pt_double(pt_double(pt_double(acc))))
-            acc = pt_add(acc, _signed(_select_entry(G_TABLE, da), n1a))
-            acc = pt_add(acc, _signed(_select_entry(LG_TABLE, db), n1b))
+            for _ in range(wb):
+                acc = pt_double(acc)
+            acc = pt_add(acc, _signed(_select_entry(g_tab, da), n1a))
+            acc = pt_add(acc, _signed(_select_entry(lg_tab, db), n1b))
             acc = pt_add(acc, _signed(_select_entry(q_table, dc), n2a))
             acc = pt_add(acc, _signed(_select_entry(lq_table, dd), n2b))
             return acc, None
@@ -1041,6 +1159,20 @@ def _pallas_usable(batch: int) -> bool:
 
 
 def _dispatch_prep(prep: PreparedBatch) -> tuple[jnp.ndarray, int]:
+    # window_bits is the one mode knob that changes HOST DATA layout
+    # (digit row count), not just the traced program: a batch prepped at
+    # one width then dispatched after the process-global flipped would
+    # run the wrong doubling count over the wrong digits — silently
+    # wrong verdicts, no shape error (the window loop takes its trip
+    # count from the data, the doubling count from the global).  Not
+    # assert: -O must not strip a consensus guard.
+    if prep.d1a.shape[0] != windows():
+        raise RuntimeError(
+            f"PreparedBatch has {prep.d1a.shape[0]} digit rows but the "
+            f"active window_bits={_WINDOW_BITS} needs {windows()}: the "
+            "window-width mode flipped between prep and dispatch — "
+            "re-prepare the batch under the active mode"
+        )
     # host->device transfer and kernel enqueue are separate spans so the
     # telemetry section can tell a slow tunnel from a slow program (both
     # are async under JAX dispatch: these time the enqueue, the blocking

@@ -245,6 +245,55 @@ def mul_small_red(a: jnp.ndarray, k: int) -> jnp.ndarray:
     return _fold_top(a * k)
 
 
+# ---------- lazy-reduction wide-accumulator API (ISSUE 12) ----------------
+# Mirrors field.py's wide API in concatenate form so curve.py's lazy
+# formula bodies run unchanged inside the Pallas kernel (the same ``F=``
+# seam).  Safety: identical op sequences, identical bounds — the ONE
+# bound-tracker audit (tpunode.verify.bounds) covers both namespaces.
+
+
+def mul_wide(a: jnp.ndarray, b_: jnp.ndarray) -> jnp.ndarray:
+    """field.mul_wide: mul minus the reduction tail -> (47, B) wide."""
+    return _convolve(_carry(a, 1), _carry(b_, 1))
+
+
+def mul_t_wide(a: jnp.ndarray, b_: jnp.ndarray) -> jnp.ndarray:
+    """field.mul_t_wide: pre-tight operands, bare convolution."""
+    return _convolve(a, b_)
+
+
+def sqr_wide(a: jnp.ndarray) -> jnp.ndarray:
+    """field.sqr_wide."""
+    return _square_conv(_carry(a, 1))
+
+
+def sqr_t_wide(a: jnp.ndarray) -> jnp.ndarray:
+    """field.sqr_t_wide."""
+    return _square_conv(a)
+
+
+def acc_add(*wides: jnp.ndarray) -> jnp.ndarray:
+    """field.acc_add: limb-wise sum of unreduced wides."""
+    out = wides[0]
+    for w in wides[1:]:
+        out = out + w
+    return out
+
+
+def reduce_wide(wide: jnp.ndarray) -> jnp.ndarray:
+    """field.reduce_wide: the one reduction a lazy expression pays."""
+    return _reduce_wide(wide)
+
+
+def reduce_wide_loose(wide: jnp.ndarray) -> jnp.ndarray:
+    """field.reduce_wide_loose: the reduction tail minus its final carry
+    round — the lazy pipeline's default reduction."""
+    wide = _carry(_pad(wide, 1), 2)
+    x = _fold_once(wide)
+    x = _carry(x, 1)
+    return _fold_top(x)
+
+
 # ---------- exact canonicalization & comparisons ----------
 
 

@@ -45,10 +45,11 @@ from .kernel import (
     G_TABLE_AFF,
     LG_TABLE,
     LG_TABLE_AFF,
-    WINDOWS,
     select_mode,
     select_tree16,
     structure_modes,
+    window_bits,
+    window_tables,
 )
 
 __all__ = ["verify_blocked", "verify_blocked_impl", "BLOCK"]
@@ -61,6 +62,8 @@ _SEVEN_LIMBS = [7] + [0] * (F.NLIMBS - 1)
 # Constant G / λG tables as host numpy, shape (16, 3, NLIMBS) — and their
 # 2-coordinate affine views (16, 2, NLIMBS) for the affine point form:
 # broadcast over lanes at trace time (compile-time constants in-kernel).
+# The 5-bit window mode fetches its 32-entry tables from
+# kernel.window_tables() instead (see _const_table).
 _G_NP = np.asarray(G_TABLE)
 _LG_NP = np.asarray(LG_TABLE)
 _G_AFF_NP = np.asarray(G_TABLE_AFF)
@@ -68,6 +71,14 @@ _LG_AFF_NP = np.asarray(LG_TABLE_AFF)
 
 
 def _const_table(tab_np: np.ndarray, b: int) -> jnp.ndarray:
+    """Constant window table operand.  4-bit windows keep the proven r3
+    layout: the (16, C, L) table broadcast over all ``b`` lanes.  5-bit
+    windows (ISSUE 12) pass ONE shared copy — shape (32, C, L, 1) — and
+    let the in-kernel selects broadcast it against the per-lane digit
+    rows: the per-lane duplication is pure VMEM waste, and at 32 entries
+    it would double a cost that was already ~1.2 MB per table."""
+    if window_bits() == 5:
+        return jnp.asarray(tab_np[:, :, :, None])
     return jnp.asarray(
         np.broadcast_to(tab_np[:, :, :, None], tab_np.shape + (b,))
     )
@@ -88,17 +99,22 @@ def _select16(table, digit_row):
     Entry 0 is the infinity point — under the projective form the
     complete RCB formulas make adding it a no-op; the affine window loop
     handles digit 0 with a keep-accumulator select instead.
+
+    Entry count follows the table's leading axis (16 at 4-bit windows,
+    32 at 5-bit — ISSUE 12).  A shared constant table with a 1-lane
+    trailing axis broadcasts against the digit row inside each where.
     """
+    ent_n = int(table.shape[0])
     if select_mode() == "onehot":
         out = None
-        for t in range(16):
+        for t in range(ent_n):
             m = digit_row == t  # (1, B), broadcasts over (C, L, B)
             contrib = jnp.where(m, table[t], 0)
             out = contrib if out is None else out + contrib
         return out
     # the ONE shared fold (kernel.select_tree16): digit_row (1, B)
     # broadcasts over each (C, L, B) entry exactly like the XLA path's
-    return select_tree16([table[t] for t in range(16)], digit_row)
+    return select_tree16([table[t] for t in range(ent_n)], digit_row)
 
 
 def _signed(entry: jnp.ndarray, neg_row: jnp.ndarray) -> jnp.ndarray:
@@ -147,6 +163,12 @@ def _kernel(
     else:
         euler_ref, out_ref, qtab_ref, lqtab_ref, powtab_ref = rest
     b = out_ref.shape[-1]
+    # MSM structure from the ref shapes (ISSUE 12): table entries and
+    # window width off the Q-table scratch, window rounds off the digit
+    # stream — so ONE kernel body serves both widths.
+    ent_n = int(qtab_ref.shape[0])
+    wbits = (ent_n - 1).bit_length()
+    nwin = int(d1a_ref.shape[0])
     L = F.NLIMBS
     zero = jnp.zeros((L, b), jnp.int32)
     one = jnp.concatenate(
@@ -207,7 +229,7 @@ def _kernel(
             ztab_ref[pl.ds(k, 1)] = nxt[2][None]
             return nxt
 
-        lax.fori_loop(2, 16, build_step, q1)
+        lax.fori_loop(2, ent_n, build_step, q1)
 
         # prefix products ptab[k] = z_2 * ... * z_k (ptab[1] = 1)
         ptab_ref[1] = one
@@ -219,15 +241,15 @@ def _kernel(
             )[None]
             return carry
 
-        lax.fori_loop(3, 16, prefix_step, 0)
+        lax.fori_loop(3, ent_n, prefix_step, 0)
 
-        # one shared Fermat ladder: (z_2 ... z_15)^(p-2)
-        pow_build_table(ptab_ref[15])
+        # one shared Fermat ladder: (z_2 ... z_{ent_n-1})^(p-2)
+        pow_build_table(ptab_ref[ent_n - 1])
         inv = lax.fori_loop(0, 64, pow_window_for(1), one)
 
         # suffix pass: entering k, run = (z_2 ... z_k)^-1
         def suffix_step(i, run):
-            k = 15 - i
+            k = ent_n - 1 - i
             zinv = PF.mul(run, ptab_ref[pl.ds(k - 1, 1)][0])
             e = qtab_ref[pl.ds(k, 1)][0]
             qtab_ref[pl.ds(k, 1)] = jnp.stack(
@@ -235,7 +257,7 @@ def _kernel(
             )[None]
             return PF.mul(run, ztab_ref[pl.ds(k, 1)][0])
 
-        lax.fori_loop(0, 14, suffix_step, inv)
+        lax.fori_loop(0, ent_n - 2, suffix_step, inv)
     else:
         qtab_ref[0] = inf
         qtab_ref[1] = q1
@@ -245,7 +267,7 @@ def _kernel(
             qtab_ref[pl.ds(k, 1)] = nxt[None]
             return nxt
 
-        lax.fori_loop(2, 16, build_step, q1)
+        lax.fori_loop(2, ent_n, build_step, q1)
 
     # ---- λQ table: the endomorphism is additive, so scale each X by β ----
     beta = PF.const_col(_BETA_LIMBS, b)
@@ -258,7 +280,7 @@ def _kernel(
         ]
         return carry
 
-    lax.fori_loop(0, 16, lam_step, 0)
+    lax.fori_loop(0, ent_n, lam_step, 0)
 
     g_tab = g_ref[:]
     lg_tab = lg_ref[:]
@@ -274,10 +296,8 @@ def _kernel(
         # infinity entry, unrepresentable in affine) keeps the
         # accumulator through a branch-free select
         def window(w, acc):
-            acc = pt_double(acc, F=PF)
-            acc = pt_double(acc, F=PF)
-            acc = pt_double(acc, F=PF)
-            acc = pt_double(acc, F=PF)
+            for _ in range(wbits):
+                acc = pt_double(acc, F=PF)
             for tab, dref, neg in (
                 (g_tab, d1a_ref, n1a),
                 (lg_tab, d1b_ref, n1b),
@@ -292,10 +312,8 @@ def _kernel(
 
     else:
         def window(w, acc):
-            acc = pt_double(acc, F=PF)
-            acc = pt_double(acc, F=PF)
-            acc = pt_double(acc, F=PF)
-            acc = pt_double(acc, F=PF)
+            for _ in range(wbits):
+                acc = pt_double(acc, F=PF)
             da = d1a_ref[pl.ds(w, 1)]
             db = d1b_ref[pl.ds(w, 1)]
             dc = d2a_ref[pl.ds(w, 1)]
@@ -306,7 +324,7 @@ def _kernel(
             acc = pt_add(acc, _signed(_select16(lqtab_ref, dd), n2b), F=PF)
             return acc
 
-    acc = lax.fori_loop(0, WINDOWS, window, inf)
+    acc = lax.fori_loop(0, nwin, window, inf)
 
     # ---- projective check x(R) ∈ {r, r+n} and curve membership ------------
     X, Y, Z = acc[0], acc[1], acc[2]
@@ -386,12 +404,43 @@ def verify_blocked_impl(
     forms."""
     if point_form is None:
         point_form = _active_point_form()
+    # Trace-time int32 bound audit of the live formulas (ISSUE 12): the
+    # Pallas and XLA programs share curve.py's bodies, so the one cached
+    # pure-Python replay covers this path too.
+    from . import bounds as _bounds
+
+    _bounds.assert_formulas_safe()
     affine = point_form == "affine"
     blk = block
     bsz = qx.shape[-1]
     if bsz % blk != 0:
         raise ValueError(f"batch {bsz} not a multiple of BLOCK={blk}")
     grid = bsz // blk
+    nwin = int(d1a.shape[0])
+    wb = window_bits()
+    ent_n = 1 << wb
+    from .kernel import windows as _windows
+
+    # data/mode consistency (same guard as the XLA path): digit rows
+    # prepped at one window width under another width's global would
+    # produce silently wrong verdicts, not a shape error.
+    if nwin != _windows():
+        raise RuntimeError(
+            f"digit arrays carry {nwin} window rows but the active "
+            f"window_bits={wb} needs {_windows()}: re-prepare the "
+            "batch under the active mode"
+        )
+    # Constant G/λG tables for the active width: 4-bit keeps the module
+    # constants; 5-bit fetches the 32-entry tables (ONE shared VMEM copy
+    # — see _const_table).
+    if wb == 4:
+        g_np = _G_AFF_NP if affine else _G_NP
+        lg_np = _LG_AFF_NP if affine else _LG_NP
+    else:
+        g_full, lg_full, g_aff, lg_aff = window_tables()
+        g_np = np.asarray(g_aff if affine else g_full)
+        lg_np = np.asarray(lg_aff if affine else lg_full)
+    tab_lanes = 1 if wb == 5 else blk
 
     negs = jnp.stack(
         [a.astype(jnp.int32) for a in (n1a, n1b, n2a, n2b)], axis=0
@@ -411,15 +460,15 @@ def verify_blocked_impl(
 
     coords = 2 if affine else 3
     tab_spec = pl.BlockSpec(
-        (16, coords, F.NLIMBS, blk), lambda i: (0, 0, 0, 0)
+        (ent_n, coords, F.NLIMBS, tab_lanes), lambda i: (0, 0, 0, 0)
     )
     in_specs = [
         tab_spec,
         tab_spec,
-        col(WINDOWS),
-        col(WINDOWS),
-        col(WINDOWS),
-        col(WINDOWS),
+        col(nwin),
+        col(nwin),
+        col(nwin),
+        col(nwin),
         col(4),
         col(F.NLIMBS),
         col(F.NLIMBS),
@@ -428,8 +477,8 @@ def verify_blocked_impl(
         col(4),
     ]
     operands = [
-        _const_table(_G_AFF_NP if affine else _G_NP, blk),
-        _const_table(_LG_AFF_NP if affine else _LG_NP, blk),
+        _const_table(g_np, blk),
+        _const_table(lg_np, blk),
         d1a.astype(jnp.int32),
         d1b.astype(jnp.int32),
         d2a.astype(jnp.int32),
@@ -442,8 +491,8 @@ def verify_blocked_impl(
         flags,
     ]
     scratch = [
-        pltpu.VMEM((16, coords, F.NLIMBS, blk), jnp.int32),
-        pltpu.VMEM((16, coords, F.NLIMBS, blk), jnp.int32),
+        pltpu.VMEM((ent_n, coords, F.NLIMBS, blk), jnp.int32),
+        pltpu.VMEM((ent_n, coords, F.NLIMBS, blk), jnp.int32),
     ]
     if affine or not schnorr_free:
         # Exponent digits live in SMEM: the kernel reads them with
@@ -465,12 +514,14 @@ def verify_blocked_impl(
         )
     if affine:
         # Z column + prefix-product tables for the batch inversion: the
-        # 2-coordinate main tables free exactly 2 x (16, L, blk) planes,
+        # 2-coordinate main tables free exactly 2 x (ent, L, blk) planes,
         # so the affine variant's VMEM high-water stays ~level with the
         # projective one's.
-        scratch.append(pltpu.VMEM((16, F.NLIMBS, blk), jnp.int32))
-        scratch.append(pltpu.VMEM((16, F.NLIMBS, blk), jnp.int32))
+        scratch.append(pltpu.VMEM((ent_n, F.NLIMBS, blk), jnp.int32))
+        scratch.append(pltpu.VMEM((ent_n, F.NLIMBS, blk), jnp.int32))
     if affine or not schnorr_free:
+        # pow-ladder table: ALWAYS 16 entries (the constant-exponent
+        # ladders stay 4-bit regardless of the MSM window width)
         scratch.append(pltpu.VMEM((16, F.NLIMBS, blk), jnp.int32))
     out = pl.pallas_call(
         partial(_kernel, schnorr_free=schnorr_free, point_form=point_form),
