@@ -122,6 +122,9 @@ T_PIPELINE = float(os.environ.get("TPUNODE_BENCH_PIPELINE_TIMEOUT", 240))
 # kill -9 mid-sync leg, over persistent LogKV stores.  jax is never
 # imported (backend="cpu" loads only the native verifier).
 T_IBD = float(os.environ.get("TPUNODE_BENCH_IBD_TIMEOUT", 420))
+# Pod-scale fleet-dispatcher scaling (ISSUE 13): 1/2/4/8-way sharding on
+# the cpu-native proxy plus the campaign bit-identity pass.
+T_MESH = float(os.environ.get("TPUNODE_BENCH_MESH_TIMEOUT", 300))
 # Total ceiling: probe (<=120s) + ladder (<=600s) + fallback (<=210s)
 # + mempool (<=150s) keeps the worst case ~18 min; r03's artifact
 # demonstrated the driver tolerating 810s, and the in-round watcher
@@ -1003,6 +1006,267 @@ def _worker_pipeline() -> None:
         print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
 
 
+def _worker_mesh() -> None:
+    """Pod-scale fleet-dispatcher scaling (ISSUE 13): e2e verify
+    throughput of the cross-host work-stealing fleet at 1/2/4/8-way
+    dispatch on the cpu-native proxy.
+
+    Each fleet host runs one dispatch worker whose cpu rung drives the
+    C++ engine with ONE OS thread (GIL-released), so k hosts = k cores
+    of native verification and the scaling curve prices the DISPATCHER
+    itself — lane packing, assignment, stealing, per-host bookkeeping —
+    not the device.  1-way is the plain single-host pipeline
+    (``mesh_hosts=0, pipeline_depth=1``), the honest serial baseline.
+    Acceptance floor (ISSUE 13): >= 0.8x ideal at 4-way.  The worker
+    also drives the adversarial campaign pool through the 4-way fleet
+    and cross-checks verdict bit-identity against the single-chip path
+    (a scheduler that drops, duplicates, or reorders slices would show
+    up here, not just in throughput).  Prints one JSON line; the parent
+    watchdog bounds it.
+    """
+    import asyncio
+
+    sigs = int(os.environ.get("TPUNODE_BENCH_MESH_SIGS", 24576))
+    ways_env = os.environ.get("TPUNODE_BENCH_MESH_WAYS_LIST", "1,2,4,8")
+    try:
+        from benchmarks.campaign import build_pool
+        from benchmarks.common import make_triples, tile
+        from tpunode.metrics import metrics
+        from tpunode.verify.cpu_native import load_native_verifier
+        from tpunode.verify.engine import VerifyConfig, VerifyEngine
+        from tpunode.verify.raw import pack_items
+
+        if load_native_verifier() is None:
+            print(json.dumps(
+                {"ok": False, "error": "native verifier unavailable"}
+            ))
+            return
+        ways_list = [int(w) for w in ways_env.split(",") if w.strip()]
+        _progress(f"generating {sigs} tiled sigs...")
+        uniq = make_triples(min(2048, sigs))
+        items = tile(uniq, sigs)
+        raw = pack_items(items)
+        # Submission grain chosen NOT to divide the 1024-item lane
+        # target, so slices genuinely straddle lane boundaries and the
+        # curve prices the packer's cross-submission bookkeeping too
+        # (review r13: 512 packed two whole submissions per lane).
+        sub = 500
+
+        async def run_way(hosts: int) -> dict:
+            metrics.reset()
+            cfg = VerifyConfig(
+                backend="cpu", batch_size=1024, max_wait=0.005,
+                pipeline_depth=1, cpu_threads=1, warmup=False,
+                mesh_hosts=hosts if hosts >= 2 else 0,
+            )
+            async with VerifyEngine(cfg) as eng:
+                t0 = time.perf_counter()
+                futs = [
+                    # gathered three lines down; a supervisor would just
+                    # add registry churn to the timed window
+                    asyncio.ensure_future(  # asyncsan: disable=raw-spawn
+                        eng.verify_raw(raw.slice(off, off + sub))
+                    )
+                    for off in range(0, len(raw), sub)
+                ]
+                got = await asyncio.gather(*futs)
+                dt = time.perf_counter() - t0
+                st = eng.stats()
+            n = sum(len(g) for g in got)
+            assert n == sigs
+            out = {
+                "hosts": hosts,
+                "wall_s": round(dt, 3),
+                "sigs_per_s": round(sigs / dt, 1) if dt else 0.0,
+            }
+            fleet = st.get("fleet")
+            if fleet:
+                out["steals"] = fleet["steals"]
+                out["requeued"] = fleet["requeued"]
+            return out
+
+        async def campaign_parity() -> dict:
+            import random as _random
+
+            items_c, shapes, expects = build_pool(
+                24, _random.Random(0x13E5)
+            )
+            async def through(hosts: int) -> list:
+                cfg = VerifyConfig(
+                    backend="cpu", batch_size=64, max_wait=0.005,
+                    pipeline_depth=1, warmup=False,
+                    mesh_hosts=hosts if hosts >= 2 else 0,
+                )
+                async with VerifyEngine(cfg) as eng:
+                    futs, k, i = [], 0, 0
+                    sizes = [37, 53, 11, 97, 5]
+                    while k < len(items_c):
+                        n = sizes[i % len(sizes)]
+                        i += 1
+                        # awaited in the return below (whole-list drain)
+                        futs.append(asyncio.ensure_future(  # asyncsan: disable=raw-spawn
+                            eng.verify(items_c[k : k + n])
+                        ))
+                        k += n
+                    return [v for f in futs for v in await f]
+
+            fleet_v = await through(4)
+            single_v = await through(0)
+            mism = [
+                (j, shapes[j])
+                for j, (g, e) in enumerate(zip(fleet_v, expects))
+                if g != e
+            ]
+            return {
+                "items": len(items_c),
+                "mismatches": len(mism),
+                "single_chip_identical": fleet_v == single_v,
+                "clean": not mism and fleet_v == single_v,
+                **({"first_mismatches": mism[:5]} if mism else {}),
+            }
+
+        async def run() -> dict:
+            ways: dict = {}
+            for k in ways_list:
+                _progress(f"{k}-way fleet...")
+                ways[str(k)] = await run_way(k)
+            # speedup/efficiency AFTER every way ran (review r13: a
+            # baseline-last or baseline-free TPUNODE_BENCH_MESH_WAYS_LIST
+            # must not silently skip the acceptance gate)
+            base_rate = ways.get("1", {}).get("sigs_per_s")
+            for k_str, cell in ways.items():
+                k = int(k_str)
+                if k != 1 and base_rate:
+                    cell["speedup"] = round(
+                        cell["sigs_per_s"] / base_rate, 3
+                    )
+                    cell["efficiency"] = round(
+                        cell["sigs_per_s"] / (k * base_rate), 3
+                    )
+            _progress("campaign parity through the 4-way fleet...")
+            camp = await campaign_parity()
+            eff4 = ways.get("4", {}).get("efficiency")
+            out = {
+                "ok": bool(camp["clean"]) and (
+                    eff4 is None or eff4 >= 0.8
+                ),
+                "proxy": "cpu-native",
+                "sigs": sigs,
+                "unique": len(uniq),
+                "submission_items": sub,
+                "ways": ways,
+                "scaling_floor": 0.8,
+                "scaling_at_4": eff4,
+                "campaign": camp,
+            }
+            if not camp["clean"]:
+                out["fatal"] = True  # verdict divergence, never mask
+                out["error"] = "fleet/single-chip verdict mismatch"
+            elif eff4 is not None and eff4 < 0.8:
+                out["error"] = (
+                    f"4-way scaling {eff4} below the 0.8x-ideal floor"
+                )
+            elif "4" in ways and eff4 is None:
+                # 4-way ran but no 1-way baseline: the floor cannot be
+                # evaluated — label it, never report a silent pass
+                out["ok"] = False
+                out["error"] = (
+                    "4-way ran without a 1-way baseline — the 0.8x "
+                    "scaling floor was not evaluated"
+                )
+            return out
+
+        print(json.dumps(asyncio.run(run())))
+    except Exception as e:  # noqa: BLE001 — worker reports, parent decides
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
+
+
+def _worker_mesh_device() -> None:
+    """One device-mesh sharding sample (ISSUE 13; the watcher's
+    ``kind="mesh"`` rungs): raw-batch dispatch through
+    ``multichip.dispatch_raw_sharded`` at ``TPUNODE_BENCH_MESH_WAYS``-way
+    sharding on the real device mesh (clamped to the visible device
+    count), oracle cross-checked, timed at steady state.  Env contract
+    mirrors ``--worker`` (TPUNODE_BENCH_REQUIRE_TPU, TPUNODE_BENCH_KERNEL,
+    TPUNODE_BENCH_BATCH per-way)."""
+    ways_req = int(os.environ.get("TPUNODE_BENCH_MESH_WAYS", 8))
+    batch = int(os.environ.get("TPUNODE_BENCH_BATCH", 4096))
+    require_tpu = os.environ.get("TPUNODE_BENCH_REQUIRE_TPU") == "1"
+    iters = int(os.environ.get("TPUNODE_BENCH_ITERS", TIMED_ITERS))
+    kernel = os.environ.get("TPUNODE_BENCH_KERNEL") or "auto"
+    try:
+        import jax
+
+        from tpunode.verify.engine import enable_compile_cache
+
+        enable_compile_cache()
+        t0 = time.perf_counter()
+        _progress("initializing backend (jax.devices may block)...")
+        devs = jax.devices()
+        init_s = time.perf_counter() - t0
+        platform = getattr(devs[0], "platform", "?")
+        if require_tpu and platform != "tpu":
+            print(json.dumps(
+                {"ok": False, "error": f"platform is {platform!r}, not tpu"}
+            ))
+            return
+        ways = min(ways_req, len(devs))
+        from benchmarks.common import device_kind, make_triples, tile
+        from tpunode.verify.cpu_native import load_native_verifier
+        from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+        from tpunode.verify.kernel import collect_verdicts
+        from tpunode.verify.multichip import (
+            dispatch_raw_sharded,
+            make_hybrid_mesh,
+        )
+        from tpunode.verify.raw import pack_items
+
+        mesh = make_hybrid_mesh(ways, 1)
+        base = make_triples(min(UNIQUE, batch))
+        raw = pack_items(tile(base, batch))
+        _progress(f"compiling {ways}-way sharded program at batch {batch}...")
+        t0 = time.perf_counter()
+        got = collect_verdicts(
+            *dispatch_raw_sharded(raw, mesh, pad_to=batch, kernel=kernel)
+        )[: len(base)]
+        compile_s = time.perf_counter() - t0
+        native = load_native_verifier()
+        expect = (
+            native.verify_batch(base)
+            if native is not None
+            else verify_batch_cpu(base)
+        )
+        if got != expect:
+            print(json.dumps(
+                {"ok": False, "fatal": True,
+                 "error": "mesh/oracle verdict mismatch"}
+            ))
+            return
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ok, _count = dispatch_raw_sharded(
+                raw, mesh, pad_to=batch, kernel=kernel
+            )
+            ok.block_until_ready()
+            times.append(time.perf_counter() - t0)
+        dt = statistics.median(times)
+        print(json.dumps({
+            "ok": True,
+            "rate": batch / dt,
+            "device": device_kind(),
+            "kernel": kernel,
+            "mesh_ways": ways,
+            "mesh_ways_requested": ways_req,
+            "batch": batch,
+            "step_ms": round(dt * 1e3, 3),
+            "compile_s": round(compile_s, 1),
+            "init_s": round(init_s, 1),
+        }))
+    except Exception as e:  # noqa: BLE001 — worker reports, parent decides
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
+
+
 def _worker_ibd() -> None:
     """Long-IBD replay A/B over the persistent store (ISSUE 11): a bare
     Node syncs a fakenet chain through the REAL fetch planner
@@ -1637,6 +1901,30 @@ def _ibd_section() -> dict:
     return res
 
 
+def _mesh_section() -> dict:
+    """The BENCH JSON ``mesh`` section (ISSUE 13): fleet-dispatcher
+    scaling at 1/2/4/8-way on the cpu-native proxy (acceptance floor
+    0.8x ideal at 4-way) plus the campaign verdict bit-identity pass vs
+    the single-chip path, from a bounded worker subprocess.  Always
+    returns a dict — a failed/timed-out scenario is labeled, never
+    masked (a campaign mismatch is additionally marked ``fatal`` so the
+    driver exits nonzero, exactly like the headline's)."""
+    res = _run_worker(
+        "--mesh", T_MESH,
+        # cpu proxy by construction: backend="cpu" never imports jax;
+        # the pin is belt-and-braces against future drift
+        {"JAX_PLATFORMS": "cpu"},
+    )
+    if not res.get("ok") and "error" in res:
+        out = {"ok": False, "error": str(res["error"])[:300]}
+        for k in ("ways", "scaling_at_4", "scaling_floor", "campaign",
+                  "fatal"):
+            if k in res:
+                out[k] = res[k]
+        return out
+    return res
+
+
 def _mempool_section() -> dict:
     """The BENCH JSON ``mempool`` section: ingest efficiency from the
     duplicate-heavy fan-in scenario, measured in a bounded worker
@@ -2032,6 +2320,10 @@ def _main_locked() -> None:
     # (native sharded + C++ connect vs serial Python) and the kill -9
     # resume leg — failure-labeled like the sections above.
     out["ibd"] = _ibd_section()
+    # Pod-scale mesh section (ISSUE 13): fleet-dispatcher scaling at
+    # 1/2/4/8-way on the cpu-native proxy (>= 0.8x ideal at 4-way) and
+    # the campaign bit-identity pass — failure-labeled like the others.
+    out["mesh"] = _mesh_section()
     # Kernel point-form A/B section (ISSUE 8): projective vs affine step
     # time on cpu-jax, failure-labeled per batch like the sections above.
     # Named "kernel_ab" because the top-level "kernel" key already names
@@ -2045,7 +2337,7 @@ def _main_locked() -> None:
         isinstance(cell, dict) and cell.get("fatal")
         for cell in out["kernel_ab"].values()
     )
-    if res.get("fatal") or kab_fatal:
+    if res.get("fatal") or kab_fatal or out["mesh"].get("fatal"):
         sys.exit(1)
 
 
@@ -2068,5 +2360,9 @@ if __name__ == "__main__":
         _worker_ibd_child()
     elif "--ibd" in sys.argv:
         _worker_ibd()
+    elif "--mesh-device" in sys.argv:
+        _worker_mesh_device()
+    elif "--mesh" in sys.argv:
+        _worker_mesh()
     else:
         main()

@@ -581,51 +581,90 @@ def config4() -> None:
 
 def config5() -> None:
     """32 MB-block stress (BASELINE.md config 5): ~150k signatures (tiled
-    from a unique pool — device work is identical) verified via shard_map
-    over every available chip; on the single-chip dev box the mesh has one
-    device, on CPU-jax runs set XLA_FLAGS=--xla_force_host_platform_device_count=8."""
+    from a unique pool — device work is identical) dispatched through the
+    POD-SCALE FLEET (ISSUE 13): an N-device box runs ``mesh_hosts=N``
+    single-chip fleet hosts pulling packed lanes from the work-stealing
+    dispatcher — the same scheduler production traffic uses — so the
+    first uptime window banks a real multi-chip number end to end (lane
+    packing + per-host dispatch included, not just the sharded kernel).
+    A 1-device box degrades to the single-host pipeline.  On CPU-jax
+    dryruns set XLA_FLAGS=--xla_force_host_platform_device_count=8; the
+    cpu-jax backend then stands in for the device (documented dryrun, the
+    device field says cpu:*)."""
     import jax
 
     from tpunode.verify.ecdsa_cpu import verify_batch_cpu
-    from tpunode.verify.multichip import make_mesh, verify_batch_sharded
+    from tpunode.verify.engine import VerifyEngine
+    from tpunode.verify.multichip import make_hybrid_mesh, verify_batch_sharded
 
     total = 1024 if SMALL else 153_600
     uniq = _make_triples(512 if not SMALL else 64, seed=0x32B)
     items = _tile(uniq, total)
-    mesh = make_mesh()
-    n_dev = mesh.devices.size
-    # correctness on a slice
+    devs = jax.devices()
+    n_dev = len(devs)
+    platform = getattr(devs[0], "platform", "?")
+    # SMALL caps the fleet at 2 hosts: each host's sub-mesh is its own
+    # compiled program, and an XLA-CPU smoke run must not pay 8 compiles
+    hosts = (min(n_dev, 2) if SMALL else n_dev) if n_dev >= 2 else 0
+    # correctness on a slice through the HYBRID mesh program first (the
+    # (hosts, 1) grid the fleet's sub-meshes are carved from)
+    mesh = make_hybrid_mesh(max(1, hosts or 1), 1)
     assert verify_batch_sharded(items[: 4 * n_dev], mesh=mesh) == verify_batch_cpu(
         items[: 4 * n_dev]
     )
     expected = _tile([bool(b) for b in verify_batch_cpu(uniq)], total)
-    # Mosaic-outage knob: one whole-batch program normally; during an
-    # outage the XLA fallback must not compile at the ~150k shape, so the
-    # batch is driven in fixed device_batch-sized chunks instead (one
-    # modest compile, reused).
-    db = _device_batch_override()
-    step = n_dev * db if db else total
+    # Mosaic-outage knob (via _verify_cfg): the XLA fallback must not
+    # compile at the ~150k shape — the engine's lane target (device_batch)
+    # already drives fixed-shape chunks, the override just shrinks them.
+    batch = 128 if SMALL else 4096
+    cfg = _verify_cfg(
+        backend="tpu" if platform == "tpu" else "auto",
+        batch_size=batch,
+        max_wait=0.005,
+        pipeline_depth=2,
+        min_tpu_batch=1,
+        mesh_hosts=hosts,
+        # one chip per fleet host (the hybrid rows the engine carves)
+        mesh_devices=hosts,
+        **({} if platform == "tpu" else {"warmup": False}),
+    )
+    if SMALL and not _device_batch_override():
+        cfg.device_batch = 1024
+    eng = VerifyEngine(cfg)
+    if platform != "tpu":
+        eng._device_state = "ready"  # cpu-jax dryrun: XLA-CPU is the device
 
-    def run_all():
-        out = []
-        for off in range(0, total, step):
-            out.extend(
-                verify_batch_sharded(
-                    items[off : off + step], mesh=mesh, pad_to=step
+    sub = max(batch // 2 + 1, 1)  # odd grain: lanes pack across boundaries
+
+    async def run_all() -> tuple[list, float]:
+        async with eng:
+            t0 = time.perf_counter()
+            futs = [
+                # gathered on the next line; supervision would only add
+                # registry churn inside the timed window
+                asyncio.ensure_future(  # asyncsan: disable=raw-spawn
+                    eng.verify(items[off : off + sub])
                 )
-            )
-        return out
+                for off in range(0, total, sub)
+            ]
+            got = await asyncio.gather(*futs)
+            warm = time.perf_counter() - t0
+            assert [v for g in got for v in g] == expected
+            # steady state AFTER the compile-bearing first pass
+            t0 = time.perf_counter()
+            futs = [
+                asyncio.ensure_future(  # asyncsan: disable=raw-spawn
+                    eng.verify(items[off : off + sub])
+                )
+                for off in range(0, total, sub)
+            ]
+            got = await asyncio.gather(*futs)
+            dt = time.perf_counter() - t0
+            assert [v for g in got for v in g] == expected
+            return [warm, dt], eng.stats()
 
-    # warm (compile) outside the timed window, then time steady state: the
-    # 32MB-block config measures sustained verify throughput, not XLA
-    t0 = time.perf_counter()
-    out = run_all()
-    compile_s = time.perf_counter() - t0
-    assert out == expected
-    t0 = time.perf_counter()
-    out = run_all()
-    dt = time.perf_counter() - t0
-    assert out == expected
+    (compile_s, dt), stats = asyncio.run(run_all())
+    fleet = stats.get("fleet") or {}
     _emit(
         {
             "metric": "config5_32mb_block_multichip",
@@ -633,6 +672,8 @@ def config5() -> None:
             "unit": "sigs/sec_total",
             "vs_baseline": round(total / dt / max(1, n_dev), 1),
             "devices": n_dev,
+            "fleet_hosts": hosts,
+            "steals": fleet.get("steals", 0),
             "device": _device_kind(),
             "sigs": total,
             "wall_s": round(dt, 3),

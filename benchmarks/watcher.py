@@ -112,6 +112,18 @@ LAZY_LADDER = (
     (32768, 360.0, None, "lazy", 4),
     (8192, 300.0, "xla", "lazy", 4),
 )
+# Pod-mesh rungs (ISSUE 13): once per round after the lazy slot, bank a
+# device number for 8/4/2-way sharded dispatch (kind="mesh" rows — the
+# headline fallback ignores them; bench.py --mesh-device clamps the way
+# count to the visible devices and reports the actual).  (ways, budget,
+# kernel): kernel None = auto (pallas on TPU); the XLA retry below is
+# the Mosaic-outage fallback, same discipline as the other experiment
+# ladders.
+MESH_LADDER = (
+    (8, 360.0, None),
+    (4, 300.0, None),
+    (2, 240.0, None),
+)
 CONFIG_BUDGETS = {"config2": 600.0, "config5": 900.0, "config3": 900.0}
 # Sweep order: config2 is cheap; config3 (full-node IBD on device) is
 # the VERDICT item-2 money shot and must be banked before config5,
@@ -167,6 +179,9 @@ _affine_pallas_broken = False
 # 32-entry tables — mosaic_diag's lazy_reduce/window5 cases) while the
 # eager flagship lowers fine.
 _lazy_pallas_broken = False
+# And for the MESH rungs (ISSUE 13): pallas-inside-shard_map may break
+# independently of the flagship single-chip program.
+_mesh_pallas_broken = False
 
 BENCH_LOCK = os.path.join(REPO, "benchmarks", ".bench_running")
 
@@ -398,6 +413,75 @@ def run_lazy() -> bool:
     return False
 
 
+def run_mesh() -> bool:
+    """One pass over the pod-mesh rungs (ISSUE 13): bank device numbers
+    for 8/4/2-way sharded dispatch (bench.py --mesh-device) as
+    ``kind="mesh"`` rows.  Returns True when at least one way was banked
+    (the once-per-round slot is then spent).  Same short-window
+    discipline and failure isolation as :func:`run_affine`: yield to
+    bench.py, abort on tunnel loss, fall back to the XLA program inside
+    shard_map when the MESH pallas program is broken/hanging (the
+    projective headline ladder is never degraded by it), and a fatal
+    mesh/oracle verdict mismatch poisons the round like the headline's."""
+    global _mesh_pallas_broken
+    banked = False
+    for ways, budget, kernel in MESH_LADDER:
+        while True:  # at most two attempts per way: pallas, then xla
+            if _mosaic_broken or _mesh_pallas_broken:
+                kernel = "xla"
+            if _bench_running():
+                _log("mesh: bench.py running — yielding the tunnel")
+                return banked
+            env = {
+                "TPUNODE_BENCH_MESH_WAYS": str(ways),
+                "TPUNODE_BENCH_BATCH": "4096",
+                "TPUNODE_BENCH_REQUIRE_TPU": "1",
+            }
+            if kernel:
+                env["TPUNODE_BENCH_KERNEL"] = kernel
+            label = f"mesh{ways}x{'-' + kernel if kernel else ''}@4096"
+            res = _run_json(
+                [sys.executable, "bench.py", "--mesh-device"], budget, env,
+            )
+            if res.get("ok"):
+                _record("mesh", {
+                    "metric": "sig_verify_throughput",
+                    "value": round(res["rate"], 1),
+                    "unit": "sigs/sec_total",
+                    "device": res.get("device"), "kernel": res.get("kernel"),
+                    "mesh_ways": res.get("mesh_ways"),
+                    "batch": res.get("batch"), "step_ms": res.get("step_ms"),
+                    "compile_s": res.get("compile_s"),
+                    "init_s": res.get("init_s"),
+                })
+                banked = True
+                break
+            err = str(res.get("error", ""))
+            _log(f"mesh {label}: {err or '?'}")
+            if res.get("fatal"):
+                # a mesh/oracle verdict mismatch is a kernel correctness
+                # failure like any other: poison the round's sampling
+                _record("fatal", {"error": res.get("error"),
+                                  "mesh_ways": ways})
+                raise FatalMismatch(res.get("error", "verdict mismatch"))
+            if "initializing backend" in err or "probing backend" in err:
+                _log("mesh: tunnel lost — back to probing")
+                return banked
+            if kernel is None and ("MosaicError" in err or "timed out" in err):
+                # retry THIS way on the XLA program before moving on
+                # (review r13: skipping it would silently drop the
+                # 8-way headline sample for the whole round — the other
+                # experiment ladders carry an explicit xla rung for
+                # exactly this case)
+                _log("mesh: pallas-inside-shard_map broken/hanging — "
+                     f"retrying {ways}-way on the XLA program "
+                     "(projective headline ladder unaffected)")
+                _mesh_pallas_broken = True
+                continue
+            break
+    return banked
+
+
 def run_config(name: str) -> dict | None:
     if _bench_running():
         _log(f"{name}: bench.py running — yielding the tunnel")
@@ -582,6 +666,7 @@ def handle_window(swept: set) -> float:
     """One live-window pass: headline sweep, same-window pallas upgrade,
     config sweep, once-per-round affine point-form sample (ISSUE 8),
     once-per-round lazy-reduction sample (ISSUE 12), once-per-round
+    pod-mesh sharding sample (ISSUE 13), once-per-round
     Mosaic diagnostic.  Mutates ``swept``
     (the on-device captures so far this round) and returns the sleep
     interval until the next probe.  Raises FatalMismatch to stop the
@@ -632,6 +717,11 @@ def handle_window(swept: set) -> float:
         # affine slot — same experiment-last discipline.
         if "lazy" not in swept and run_lazy():
             swept.add("lazy")
+        # Pod-mesh sample (ISSUE 13): once per round, after the lazy
+        # slot — 8/4/2-way sharded dispatch numbers (kind="mesh" rows)
+        # so the first uptime window converts the pod bet too.
+        if "mesh" not in swept and run_mesh():
+            swept.add("mesh")
     if (
         (why == "exhausted" or (head is not None and _mosaic_broken))
         and "mosaic_diag" not in swept

@@ -111,6 +111,28 @@ _IBD_OK = {
               "reverified_blocks": 0, "refetched_blocks": 0},
 }
 
+# Canned healthy pod-mesh fleet-scaling result (ISSUE 13; the real
+# subprocess path is covered by test_mesh_worker_subprocess).
+_MESH_OK = {
+    "ok": True, "proxy": "cpu-native", "sigs": 24576, "unique": 2048,
+    "submission_items": 500,
+    "ways": {
+        "1": {"hosts": 1, "wall_s": 5.617, "sigs_per_s": 4375.0},
+        "2": {"hosts": 2, "wall_s": 2.753, "sigs_per_s": 8927.0,
+              "steals": 0, "requeued": 0, "speedup": 2.04,
+              "efficiency": 1.02},
+        "4": {"hosts": 4, "wall_s": 1.427, "sigs_per_s": 17219.7,
+              "steals": 0, "requeued": 0, "speedup": 3.936,
+              "efficiency": 0.984},
+        "8": {"hosts": 8, "wall_s": 0.72, "sigs_per_s": 34147.7,
+              "steals": 0, "requeued": 0, "speedup": 7.805,
+              "efficiency": 0.976},
+    },
+    "scaling_floor": 0.8, "scaling_at_4": 0.984,
+    "campaign": {"items": 168, "mismatches": 0,
+                 "single_chip_identical": True, "clean": True},
+}
+
 # Canned healthy chaos-resilience result (the real subprocess path is
 # covered by test_chaos_worker_subprocess).
 _CHAOS_OK = {
@@ -158,6 +180,9 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         if mode == "--ibd":
             # likewise for the ride-along long-IBD section (ISSUE 11)
             return dict(_IBD_OK)
+        if mode == "--mesh":
+            # likewise for the ride-along pod-mesh section (ISSUE 13)
+            return dict(_MESH_OK)
         raise AssertionError(f"unexpected worker call: {mode} {env_extra}")
 
     monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
@@ -201,7 +226,7 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         c for c in calls
         if c[0] not in (
             "--mempool", "--chaos", "--kernel-ab", "--recovery",
-            "--pipeline", "--ibd",
+            "--pipeline", "--ibd", "--mesh",
         )
     ]
     return line, calls, rc
@@ -687,6 +712,139 @@ def test_pipeline_section_failure_labeled(monkeypatch):
     assert ps["ok"] is False
     assert "timed out" in ps["error"]
     assert ps["serial"]["sigs_per_s"] == 10.0
+
+
+def _is_mesh(mode, env):
+    return mode == "--mesh"
+
+
+def test_mesh_section_always_present(monkeypatch):
+    """ISSUE 13: the BENCH JSON carries a ``mesh`` section (fleet
+    scaling at 1/2/4/8-way + the campaign bit-identity pass) on every
+    run."""
+    bench = _load_bench()
+    line, _, _ = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 1.0, "device": "tpu:v5e"}),
+        ],
+    )
+    ms = line["mesh"]
+    assert ms["ok"] is True
+    assert set(ms["ways"]) == {"1", "2", "4", "8"}
+    for k, cell in ms["ways"].items():
+        assert cell["sigs_per_s"] > 0 and cell["hosts"] == int(k)
+    # the acceptance floor: >= 0.8x ideal at 4-way, explicitly recorded
+    assert ms["scaling_floor"] == 0.8
+    assert ms["scaling_at_4"] >= ms["scaling_floor"]
+    assert ms["campaign"]["clean"] is True
+    assert ms["campaign"]["mismatches"] == 0
+    assert ms["campaign"]["single_chip_identical"] is True
+
+
+def test_mesh_section_worker_env_is_device_free(monkeypatch):
+    """The mesh worker runs on the cpu-native proxy (backend="cpu"
+    never imports jax); its env pins cpu anyway."""
+    bench = _load_bench()
+    seen = []
+    monkeypatch.setattr(
+        bench, "_run_worker",
+        lambda mode, timeout, env=None: (
+            seen.append((mode, timeout, dict(env or {})))
+            or dict(_MESH_OK)
+        ),
+    )
+    assert bench._mesh_section()["ok"] is True
+    ((mode, timeout, env),) = seen
+    assert mode == "--mesh"
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert timeout == bench.T_MESH
+
+
+def test_mesh_section_failure_labeled(monkeypatch):
+    """A failed/timed-out mesh scenario is labeled — with whatever
+    partial scaling evidence it produced — never masked, and never takes
+    the headline down with it."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_mesh, {"ok": False,
+                        "error": "4-way scaling 0.61 below the 0.8x-ideal"
+                                 " floor",
+                        "scaling_at_4": 0.61, "scaling_floor": 0.8,
+                        "ways": {"1": {"hosts": 1, "sigs_per_s": 10.0}}}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 9.0  # headline survived
+    ms = line["mesh"]
+    assert ms["ok"] is False
+    assert "below the 0.8x-ideal floor" in ms["error"]
+    assert ms["scaling_at_4"] == 0.61
+    assert ms["ways"]["1"]["sigs_per_s"] == 10.0
+
+
+def test_mesh_section_fatal_mismatch_fails_the_run(monkeypatch):
+    """A fleet/single-chip verdict divergence is a kernel correctness
+    failure, not a perf miss: the section carries ``fatal`` and the
+    driver exits nonzero exactly like a headline mismatch."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_mesh, {"ok": False, "fatal": True,
+                        "error": "fleet/single-chip verdict mismatch",
+                        "campaign": {"items": 168, "mismatches": 3,
+                                     "clean": False}}),
+        ],
+    )
+    assert rc == 1
+    assert line["mesh"]["fatal"] is True
+    assert line["mesh"]["campaign"]["mismatches"] == 3
+
+
+@pytest.mark.slow  # four fleet runs + the campaign pass in a subprocess
+# (the tier-1 budget is seed-saturated on this box; the scripted pins
+# above cover the section contract)
+def test_mesh_worker_subprocess():
+    """The real ``--mesh`` worker end-to-end in a subprocess: every way
+    completes with exactly the submitted sigs verified, the campaign
+    parity pass is clean, and (with real cores to scale onto) multi-way
+    throughput beats 1-way."""
+    import subprocess
+    import sys as _sys
+
+    if (os.cpu_count() or 1) < 2:
+        pytest.skip("fleet scaling needs >= 2 cores")
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "bench.py"), "--mesh"],
+        env=dict(
+            os.environ,
+            TPUNODE_BENCH_MESH_SIGS="4096",
+            TPUNODE_BENCH_MESH_WAYS_LIST="1,2",
+            JAX_PLATFORMS="cpu",
+        ),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=200,
+    )
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["campaign"]["clean"] is True, line
+    assert set(line["ways"]) == {"1", "2"}
+    for cell in line["ways"].values():
+        assert cell["sigs_per_s"] > 0
+    if (os.cpu_count() or 1) >= 4:
+        assert line["ways"]["2"]["sigs_per_s"] > line["ways"]["1"]["sigs_per_s"]
 
 
 def _is_ibd(mode, env):
@@ -1466,11 +1624,12 @@ def _setup_window(monkeypatch, W, head, why, mosaic=False):
         lambda argv, t, env=None: diags.append(argv) or {"cases": ["x"]},
     )
     monkeypatch.setattr(W, "_record", lambda k, p: recs.append(k))
-    # the once-per-round affine (ISSUE 8) and lazy (ISSUE 12) samples
-    # have their own tests; stub them here so the diag/config call
-    # counts these scenarios pin stay exact
+    # the once-per-round affine (ISSUE 8), lazy (ISSUE 12) and mesh
+    # (ISSUE 13) samples have their own tests; stub them here so the
+    # diag/config call counts these scenarios pin stay exact
     monkeypatch.setattr(W, "run_affine", lambda: False)
     monkeypatch.setattr(W, "run_lazy", lambda: False)
+    monkeypatch.setattr(W, "run_mesh", lambda: False)
     return configs, diags, recs
 
 

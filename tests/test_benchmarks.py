@@ -480,6 +480,8 @@ def test_handle_window_skips_upgrade_after_pallas_failure(monkeypatch):
     watcher = _load_watcher()
     monkeypatch.setattr(watcher, "run_config", lambda name: None)
     monkeypatch.setattr(watcher, "run_affine", lambda: False)
+    monkeypatch.setattr(watcher, "run_lazy", lambda: False)
+    monkeypatch.setattr(watcher, "run_mesh", lambda: False)
     upgrade_calls = []
 
     def fake_run_headline(pallas_only=False):
@@ -630,6 +632,87 @@ def test_run_lazy_banks_kind_lazy(monkeypatch, tmp_path):
     watcher.run_lazy()
     assert len(calls) == 1
     assert calls[0].get("TPUNODE_BENCH_KERNEL") == "xla"
+
+
+def test_run_mesh_banks_kind_mesh(monkeypatch, tmp_path):
+    """ISSUE 13: the watcher's pod-mesh rungs bank ``kind="mesh"`` rows
+    (one per 8/4/2-way success, never the headline), drive bench.py
+    --mesh-device with the way count in env, keep only XLA programs
+    during a Mosaic outage, and a MosaicError sets only the mesh-local
+    broken flag."""
+    watcher = _load_watcher()
+    runs = tmp_path / "runs.jsonl"
+    monkeypatch.setattr(watcher, "RUNS_PATH", str(runs))
+    monkeypatch.setattr(watcher, "_bench_running", lambda: False)
+
+    calls = []
+
+    def fake_run_json(argv, timeout, env=None):
+        assert argv[-1] == "--mesh-device"
+        calls.append(env or {})
+        ways = int((env or {}).get("TPUNODE_BENCH_MESH_WAYS", 0))
+        return {"ok": True, "rate": 100000.0 * ways, "device": "tpu:v5e",
+                "kernel": env.get("TPUNODE_BENCH_KERNEL") or "auto",
+                "mesh_ways": ways, "batch": 4096}
+
+    monkeypatch.setattr(watcher, "_run_json", fake_run_json)
+    assert watcher.run_mesh() is True
+    assert [c.get("TPUNODE_BENCH_MESH_WAYS") for c in calls] == [
+        "8", "4", "2"
+    ]
+    assert all(c.get("TPUNODE_BENCH_REQUIRE_TPU") == "1" for c in calls)
+    rows = [json.loads(line) for line in open(runs)]
+    assert [r["kind"] for r in rows] == ["mesh"] * 3
+    assert [r["mesh_ways"] for r in rows] == [8, 4, 2]
+    # bench.py's headline fallback ignores mesh rows
+    import bench
+
+    assert bench._freshest_device_run(str(runs)) is None
+
+    # Mosaic outage: every way runs the XLA program inside shard_map
+    calls.clear()
+    watcher._mosaic_broken = True
+    assert watcher.run_mesh() is True
+    assert all(c.get("TPUNODE_BENCH_KERNEL") == "xla" for c in calls)
+    watcher._mosaic_broken = False
+
+    # a MosaicError on the mesh pallas program: mesh-local flag only
+    def fail_pallas(argv, timeout, env=None):
+        calls.append(env or {})
+        if env and env.get("TPUNODE_BENCH_KERNEL") == "xla":
+            return {"ok": True, "rate": 50000.0, "device": "tpu:v5e",
+                    "kernel": "xla",
+                    "mesh_ways": int(env["TPUNODE_BENCH_MESH_WAYS"]),
+                    "batch": 4096}
+        return {"ok": False,
+                "error": "MosaicError: cannot lower inside shard_map"}
+
+    monkeypatch.setattr(watcher, "_run_json", fail_pallas)
+    calls.clear()
+    assert watcher.run_mesh() is True
+    assert watcher._mesh_pallas_broken is True
+    assert watcher._mosaic_broken is False  # headline ladder unaffected
+    # review r13: the FAILED way itself retries on XLA in-round (the
+    # 8-way headline sample must not be dropped), then later ways go
+    # straight to XLA
+    assert [
+        (c.get("TPUNODE_BENCH_MESH_WAYS"), c.get("TPUNODE_BENCH_KERNEL"))
+        for c in calls
+    ] == [("8", None), ("8", "xla"), ("4", "xla"), ("2", "xla")]
+
+    # a fatal mesh/oracle mismatch poisons the round like the headline's
+    monkeypatch.setattr(
+        watcher, "_run_json",
+        lambda argv, timeout, env=None: {
+            "ok": False, "fatal": True,
+            "error": "mesh/oracle verdict mismatch",
+        },
+    )
+    watcher._mesh_pallas_broken = False
+    with pytest.raises(watcher.FatalMismatch):
+        watcher.run_mesh()
+    rows = [json.loads(line) for line in open(runs)]
+    assert rows[-1]["kind"] == "fatal"
 
 
 def test_run_affine_fatal_poisons_round(monkeypatch, tmp_path):

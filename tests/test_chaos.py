@@ -76,6 +76,33 @@ def test_plan_parse_roundtrip():
     ]
 
 
+def test_mesh_dispatch_point_and_partition():
+    """ISSUE 13: the ``mesh.dispatch`` point parses with all three
+    actions, partition raises ChaosPartition (a ChaosFault subclass the
+    fleet maps to HostLost), and match scopes it to one host label."""
+    from tpunode.chaos import ChaosPartition
+
+    plan = ChaosPlan.parse(
+        "seed=7;mesh.dispatch:partition:match=h3,n=1;"
+        "mesh.dispatch:device_loss:match=h1:tpu"
+    )
+    assert [f.action for f in plan.faults] == ["partition", "device_loss"]
+    chaos.install(plan)
+    try:
+        chaos.maybe_raise("mesh.dispatch", "h0:tpu:chips4")  # no match: quiet
+        with pytest.raises(ChaosPartition):
+            chaos.maybe_raise("mesh.dispatch", "h3:cpu:chips1")
+        chaos.maybe_raise("mesh.dispatch", "h3:cpu:chips1")  # n=1 spent
+        with pytest.raises(ChaosDeviceLoss):
+            chaos.maybe_raise("mesh.dispatch", "h1:tpu:chips2")
+    finally:
+        chaos.uninstall()
+    with pytest.raises(ValueError, match="no action"):
+        ChaosPlan.parse("mesh.dispatch:stall")
+    with pytest.raises(ValueError, match="no action"):
+        ChaosPlan.parse("engine.dispatch:partition")  # mesh-only action
+
+
 def test_plan_parse_rejects_typos():
     """A typo'd plan must fail loudly, never silently no-op."""
     with pytest.raises(ValueError, match="unknown chaos point"):

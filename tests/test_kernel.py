@@ -656,9 +656,12 @@ def test_window5_digits_and_tables(restore_issue12_modes):
 
 
 def test_window_bits_knob_validation_and_cache_key(restore_issue12_modes):
-    """set_kernel_modes validates window_bits, prep falls back to the
-    Python path at 5-bit (the native layout is 4-bit), and both new
-    knobs ride the jit cache key."""
+    """set_kernel_modes validates window_bits, the ISSUE 13 native w5
+    path closes the PR 12 gap (``native=True`` no longer raises at
+    5-bit with a current library; only a STALE pre-w5 .so falls back to
+    Python — and then ``native=True`` still fails loudly rather than
+    silently down-grading), and both knobs ride the jit cache key."""
+    from tpunode.verify import cpu_native as CN
     from tpunode.verify import field as F2
     from tpunode.verify import kernel as K
 
@@ -669,10 +672,105 @@ def test_window_bits_knob_validation_and_cache_key(restore_issue12_modes):
     assert K.kernel_modes() != before
     assert K.kernel_modes()[-1] == 5
     assert K.structure_modes()[-1] == 5
-    with pytest.raises(RuntimeError):
-        K.prepare_batch([], native=True)
+    nv = CN.load_native_verifier()
+    if nv is not None and nv.supports_window_bits(5):
+        # ISSUE 13 acceptance: native=True works at w5 on a current lib
+        prep = K.prepare_batch([], native=True)
+        assert prep.count == 0 and prep.d1a.shape[0] == 27
     F2.set_field_modes(reduce="lazy")
     assert "lazy" in K.kernel_modes()
+
+
+def test_window_bits_stale_native_lib_falls_back(
+    restore_issue12_modes, monkeypatch
+):
+    """A pre-w5 libsecp_cpu.so (no ``secp_prepare_batch_w`` symbol):
+    auto prep quietly takes the Python path at 5-bit, ``native=True``
+    raises loudly, and the binding itself refuses the width."""
+    from tpunode.verify import cpu_native as CN
+    from tpunode.verify import kernel as K
+
+    nv = CN.load_native_verifier()
+    if nv is None:
+        pytest.skip("native verifier unavailable")
+    K.set_kernel_modes(window_bits=5)
+    monkeypatch.setattr(type(nv), "supports_window_bits",
+                        lambda self, wb: wb == 4)
+    items, _ = _random_batch(2)
+    prep = K.prepare_batch(items, pad_to=8)  # auto: silent Python path
+    assert prep.d1a.shape == (27, 8)
+    with pytest.raises(RuntimeError, match="window_bits=5"):
+        K.prepare_batch(items, native=True)
+    with pytest.raises(RuntimeError, match="window_bits=5"):
+        nv.prepare_batch_arrays(
+            b"", b"", b"", b"", b"", b"", 0, 0, window_bits=5
+        )
+
+
+def test_native_w5_prep_bit_identical_to_python(restore_issue12_modes):
+    """ISSUE 13 satellite acceptance: the native 5-bit batch prep
+    (word-straddling digit extraction in C++) is bit-identical to the
+    Python ``_ints_to_digits_np`` layout over every PreparedBatch field
+    — ECDSA + both Schnorr variants + invalid/missing lanes, tuple AND
+    raw paths — and the width-mismatch dispatch guard covers batches
+    prepped natively."""
+    import numpy as np
+
+    from tpunode.verify import cpu_native as CN
+    from tpunode.verify import kernel as K
+    from tpunode.verify.raw import pack_items
+
+    from tpunode.verify.ecdsa_cpu import (
+        bip340_challenge,
+        lift_x,
+        schnorr_challenge,
+        sign_bip340,
+        sign_schnorr,
+    )
+
+    nv = CN.load_native_verifier()
+    if nv is None or not nv.supports_window_bits(5):
+        pytest.skip("w5-capable native library unavailable")
+    items, _ = _random_batch(24)
+    for i in range(12):  # both Schnorr variants exercise the u1/u2 path
+        priv = rng.getrandbits(256) % CURVE_N or 1
+        pub = point_mul(priv, GENERATOR)
+        m = rng.getrandbits(256)
+        if i % 2:
+            r, s = sign_schnorr(priv, m, rng.getrandbits(256))
+            items.append((pub, schnorr_challenge(r, pub, m), r, s, "schnorr"))
+        else:
+            r, s = sign_bip340(priv, m, rng.getrandbits(256))
+            items.append(
+                (lift_x(pub.x), bip340_challenge(r, pub.x, m), r, s, "bip340")
+            )
+    items.append((None, 1, 1, 1))  # missing pubkey: host_valid False
+    items.append((GENERATOR, 5, 0, 7))  # r=0: invalid by inspection
+    fields = (
+        "d1a", "d1b", "d2a", "d2b", "n1a", "n1b", "n2a", "n2b",
+        "qx", "qy", "r1", "r2", "r2_valid", "host_valid",
+        "schnorr", "bip340",
+    )
+    K.set_kernel_modes(window_bits=5)
+    pn = K.prepare_batch(items, pad_to=48, native=True)
+    pp = K.prepare_batch(items, pad_to=48, native=False)
+    assert pn.d1a.shape == (27, 48)
+    for f in fields:
+        assert np.array_equal(
+            np.asarray(getattr(pn, f), dtype=np.int64),
+            np.asarray(getattr(pp, f), dtype=np.int64),
+        ), f"w5 native/python diverge on {f}"
+    pr = K.prepare_batch_raw(pack_items(items), pad_to=48)
+    for f in fields:
+        assert np.array_equal(
+            np.asarray(getattr(pr, f), dtype=np.int64),
+            np.asarray(getattr(pp, f), dtype=np.int64),
+        ), f"w5 raw-native/python diverge on {f}"
+    # the width-mismatch guard covers NATIVE-prepped batches too: a w5
+    # native prep dispatched after the global flips back must raise
+    K.set_kernel_modes(window_bits=4)
+    with pytest.raises(RuntimeError, match="window"):
+        K._dispatch_prep(pn)
 
 
 def test_window_flip_between_prep_and_dispatch_raises(restore_issue12_modes):

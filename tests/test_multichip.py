@@ -35,6 +35,74 @@ def test_mesh_uses_all_devices():
     assert mesh.devices.size == len(jax.devices()) == 8
 
 
+def test_hybrid_mesh_topology():
+    """ISSUE 13: make_hybrid_mesh carves the (host, chip) grid — virtual
+    2x4 over the 8 CPU devices — with the per-host axis holding
+    contiguous local devices; host_submesh slices one row back out as a
+    1-D mesh; over-subscription fails loudly (a silently-shrunk pod must
+    not masquerade as the requested topology)."""
+    from tpunode.verify.multichip import (
+        HYBRID_AXES,
+        host_submesh,
+        make_hybrid_mesh,
+    )
+
+    mesh = make_hybrid_mesh(2, 4)
+    assert mesh.devices.shape == (2, 4)
+    assert tuple(mesh.axis_names) == HYBRID_AXES == ("host", "chip")
+    row1 = host_submesh(mesh, 1)
+    assert row1.devices.shape == (4,) and tuple(row1.axis_names) == ("batch",)
+    assert [d.id for d in row1.devices.flat] == [
+        d.id for d in mesh.devices[1]
+    ]
+    # defaults: one virtual host per device in a single process
+    assert make_hybrid_mesh().devices.shape == (8, 1)
+    # partial specs derive the other axis
+    assert make_hybrid_mesh(hosts=4).devices.shape == (4, 2)
+    assert make_hybrid_mesh(chips_per_host=2).devices.shape == (4, 2)
+    # a 1-D mesh is its own (only) row
+    lm = make_mesh(4)
+    assert host_submesh(lm, 0) is lm
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        make_hybrid_mesh(4, 4)
+
+
+@pytest.mark.slow  # two fresh XLA shard_map compiles (~2-3 min on this
+# box): the tier-1 870s budget is seed-saturated, so the hybrid parity
+# evidence lives in the slow tier (ran green this session) — the cheap
+# topology/cache pins above stay tier-1
+def test_hybrid_sharded_matches_oracle():
+    """Hybrid-mesh parity (CPU dryrun, the 2x4 virtual topology): the
+    batch axis shards over host AND chip jointly, verdicts are
+    bit-identical to the oracle, and the psum over both axes agrees."""
+    from tpunode.verify.multichip import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(2, 4)
+    items, expect = make_items(24)
+    got = verify_batch_sharded(items, mesh=mesh)
+    assert got == expect
+    assert any(got) and not all(got)
+    # ragged batch: mesh-quantum padding still rejects pad lanes for free
+    items2, expect2 = make_items(11)
+    assert verify_batch_sharded(items2, mesh=mesh) == expect2
+
+
+def test_hybrid_fn_cache_keys_on_mesh_topology():
+    """sharded_verify_fn caches per mesh topology: the 2x4 hybrid, the
+    8x1 hybrid and the 1-D local mesh are distinct compiled entries;
+    the same mesh hits its cache (no jit wrapper churn)."""
+    from tpunode.verify.multichip import make_hybrid_mesh, sharded_verify_fn
+
+    h24 = make_hybrid_mesh(2, 4)
+    h81 = make_hybrid_mesh(8, 1)
+    local = make_mesh()
+    f1 = sharded_verify_fn(h24, kernel="xla")
+    f2 = sharded_verify_fn(h81, kernel="xla")
+    f3 = sharded_verify_fn(local, kernel="xla")
+    assert len({id(f1), id(f2), id(f3)}) == 3
+    assert sharded_verify_fn(make_hybrid_mesh(2, 4), kernel="xla") is f1
+
+
 def test_sharded_matches_oracle():
     items, expect = make_items(24)
     got = verify_batch_sharded(items)
@@ -76,6 +144,58 @@ def test_dispatch_raw_sharded_matches_oracle():
     # pad_to below the batch is ignored; above it aligns up
     got2 = collect_verdicts(*dispatch_raw_sharded(raw, mesh, pad_to=64))
     assert got2 == expect
+
+
+@pytest.mark.slow  # full shard_map compile on the hybrid mesh (~90s):
+# same budget discipline as the raw-sharded pin above
+def test_dispatch_raw_sharded_hybrid_mesh():
+    """ISSUE 13: the raw-dispatch path over a HYBRID (2x4) mesh — the
+    fleet's whole-mesh rung — is bit-identical to the oracle, ragged
+    batches included."""
+    from tpunode.verify.kernel import collect_verdicts
+    from tpunode.verify.multichip import dispatch_raw_sharded, make_hybrid_mesh
+    from tpunode.verify.raw import pack_items
+
+    items, expect = make_items(21)  # NOT a multiple of the 8-device grid
+    raw = pack_items(items)
+    mesh = make_hybrid_mesh(2, 4)
+    got = collect_verdicts(*dispatch_raw_sharded(raw, mesh))
+    assert got == expect
+
+
+@pytest.mark.slow  # per-host sub-mesh compiles (~2 XLA shard_map
+# programs): the cheap fleet pins live in test_sched with the simulated
+# device; this is the REAL-compile parity evidence for the fleet rung
+def test_engine_fleet_serves_lanes_over_host_submeshes():
+    """ISSUE 13 engine wiring: with mesh_hosts=2 the device rung carves
+    the 2x4 hybrid rows and each host worker dispatches its lanes over
+    its own 4-device sub-mesh — verdicts match the per-item
+    expectations (device path simulated as in test_engine's affine pin:
+    state forced ready, cpu-jax IS the device)."""
+    import asyncio
+
+    from tpunode.verify.engine import VerifyConfig, VerifyEngine
+
+    items, expect = make_items(20)
+
+    async def run() -> list:
+        cfg = VerifyConfig(
+            backend="auto", batch_size=8, device_batch=8, min_tpu_batch=1,
+            max_wait=0.02, warmup=False, mesh_hosts=2, pipeline_depth=1,
+        )
+        eng = VerifyEngine(cfg)
+        eng._device_state = "ready"  # cpu-jax is the device
+        async with eng:
+            f1 = asyncio.ensure_future(eng.verify(items[:11]))
+            f2 = asyncio.ensure_future(eng.verify(items[11:]))
+            g1, g2 = await asyncio.gather(f1, f2)
+        assert eng._fleet_hybrid_state == "ready"
+        assert {hs.mesh_state for hs in eng._hosts.values()} <= {
+            "ready", "cold"  # a host that never dispatched stays cold
+        }
+        return g1 + g2
+
+    assert asyncio.run(run()) == expect
 
 
 @pytest.mark.slow  # same budget discipline as the raw-sharded pin above

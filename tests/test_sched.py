@@ -28,6 +28,7 @@ from tpunode.actors import Publisher, task_registry
 from tpunode.metrics import metrics
 from tpunode.verify.engine import VerifyConfig, VerifyEngine
 from tpunode.verify.sched import (
+    FleetDispatcher,
     LanePacker,
     PRIORITIES,
     Submission,
@@ -170,6 +171,185 @@ def test_slice_payload_list_and_raw():
     part = slice_payload(raw, 2, 5)
     assert len(part) == 3
     assert part.to_tuples() == raw.to_tuples()[2:5]
+
+
+# --- fleet dispatcher units (ISSUE 13) ---------------------------------------
+
+
+def _pop_assign(fleet, target=4):
+    lane = fleet.packer.pop_lane(target)
+    assert lane is not None
+    return lane, fleet.assign(lane)
+
+
+@pytest.mark.asyncio
+async def test_fleet_assign_shallowest_with_room():
+    """Lanes land on the shallowest ACTIVE host queue; a full fleet
+    reports no room (the scheduler's backpressure signal) and assign
+    refuses rather than piling deeper."""
+    f = FleetDispatcher(["h0", "h1"], max_queue=1)
+    f.push(_sub(12))
+    lane1, host1 = _pop_assign(f)
+    lane2, host2 = _pop_assign(f)
+    assert {host1, host2} == {"h0", "h1"}  # spread, not piled
+    assert not f.has_room()
+    lane3 = f.packer.pop_lane(4)
+    assert f.assign(lane3) is None  # both queues at max_queue
+    assert f.host_depths() == {"h0": 4, "h1": 4}
+    assert metrics.get("sched.host_depth", labels={"host": host1}) == 4.0
+    # consuming makes room again
+    assert f.take(host1) is lane1 if host1 == "h0" else lane2
+    assert f.has_room()
+
+    with pytest.raises(ValueError):
+        FleetDispatcher([])
+    with pytest.raises(ValueError):
+        FleetDispatcher(["a", "a"])
+
+
+@pytest.mark.asyncio
+async def test_fleet_steal_oldest_from_deepest():
+    """An idle host steals the OLDEST lane (queue head) of the DEEPEST
+    peer — lanes were cut in global priority order, so the head is the
+    fleet's most urgent queued work; sched.steals counts it."""
+    metrics.reset()
+    f = FleetDispatcher(["h0", "h1", "h2"], max_queue=4)
+    f.push(_sub(4, "block"))
+    f.push(_sub(4, "mempool"))
+    f.push(_sub(4, "bulk"))
+    lanes = []
+    for _ in range(3):
+        lane = f.packer.pop_lane(4)
+        f._queues["h0"].append(lane)  # pile everything on h0
+        lanes.append(lane)
+    f.push(_sub(2, "bulk"))
+    tail = f.packer.pop_lane(4)
+    f._queues["h1"].append(tail)  # h1 shallower than h0
+    # h2 is idle: steals h0's HEAD (the block lane), not h1's or a tail
+    got = f.take("h2")
+    assert got is lanes[0]
+    assert [s.priority for s, _, _ in got.slices] == ["block"]
+    assert f.steals == 1 and metrics.get("sched.steals") == 1
+    # next steal still prefers the deepest (h0 has 8 items vs h1's 2)
+    assert f.take("h2") is lanes[1]
+    # own queue outranks stealing
+    assert f.take("h1") is tail
+    # nothing anywhere -> None
+    f.take("h0"), f.take("h0")
+    assert f.take("h2") is None
+
+
+@pytest.mark.asyncio
+async def test_fleet_requeue_and_deactivate_redistribute():
+    """A lost host's queued lanes move (order-preserved) to active
+    peers; a re-queued in-flight lane goes to the FRONT of the
+    shallowest active peer; with no active peers lanes stay put for
+    steals / the local fallback."""
+    metrics.reset()
+    f = FleetDispatcher(["h0", "h1", "h2"], max_queue=8)
+    f.push(_sub(12))
+    l0 = f.packer.pop_lane(4)
+    l1 = f.packer.pop_lane(4)
+    l2 = f.packer.pop_lane(4)
+    f._queues["h0"].extend([l0, l1])
+    f._queues["h1"].append(l2)
+    moved = f.deactivate("h0")
+    assert moved == 2 and not f.is_active("h0")
+    assert f.active_hosts() == ["h1", "h2"]
+    assert f.host_lanes("h0") == 0
+    # the orphans spread to the shallowest peers, each at the FRONT
+    # (they are older than anything queued): l1 -> the empty h2, then
+    # l0 -> h1, AHEAD of the younger l2
+    assert list(f._queues["h2"]) == [l1]
+    assert list(f._queues["h1"]) == [l0, l2]
+    # review r13: redistribution counts in telemetry but does NOT
+    # consume the lanes' in-flight orbit budget
+    assert l0.requeues == 0 and l1.requeues == 0
+    assert f.requeued == 2 and metrics.get("sched.requeued") == 2
+    # an in-flight lane re-queued by a dying host jumps the peer's queue
+    f.deactivate("h2")  # moves l1 onto h1 too
+    assert list(f._queues["h1"])[0] is l1
+    back = f.requeue("h2", l0)
+    assert back == "h1" and list(f._queues["h1"])[0] is l0
+    assert l0.requeues == 1  # a real in-flight bounce DOES consume it
+    f._queues["h1"].popleft()  # undo the double-queue for the dark case
+    # every host dark: requeue REFUSES (returns None without queueing
+    # or counting) — ownership stays with the caller, which resolves
+    # the lane itself; queueing here too would leave two live copies
+    f.deactivate("h1")
+    before = list(f._queues["h1"])
+    requeued_before = f.requeued
+    assert f.requeue("h1", l0) is None
+    assert list(f._queues["h1"]) == before
+    assert f.requeued == requeued_before and l0.requeues == 1
+    # reactivation restores assignment
+    f.activate("h0")
+    assert f.active_hosts() == ["h0"]
+    # drain_lanes empties every queue (teardown contract)
+    drained = f.drain_lanes()
+    assert set(map(id, drained)) == {id(l0), id(l1), id(l2)}
+    assert f.queued_lanes() == 0
+
+
+@pytest.mark.asyncio
+async def test_fleet_priority_preserved_through_pack_order():
+    """block > mempool > ibd > bulk holds GLOBALLY through the fleet:
+    lanes are cut in priority order and per-host queues are FIFO, so
+    consuming any host's queue (or stealing) never serves a bulk lane
+    while a block lane cut earlier still waits."""
+    f = FleetDispatcher(["h0", "h1"], max_queue=4)
+    for prio in ("bulk", "ibd", "mempool", "block"):  # worst-case arrival
+        f.push(_sub(4, prio))
+    order = []
+    while True:
+        lane = f.packer.pop_lane(4)
+        if lane is None:
+            break
+        host = f.assign(lane)
+        assert host is not None
+        order.append([s.priority for s, _, _ in lane.slices])
+    assert order == [["block"], ["mempool"], ["ibd"], ["bulk"]]
+    # FIFO consumption per host preserves the cut order per queue
+    rank = {p: i for i, p in enumerate(PRIORITIES)}
+    for h in ("h0", "h1"):
+        served = []
+        while True:
+            lane = f.take(h, steal=False)
+            if lane is None:
+                break
+            served.extend(s.priority for s, _, _ in lane.slices)
+        assert [rank[p] for p in served] == sorted(rank[p] for p in served)
+
+
+@pytest.mark.asyncio
+async def test_fleet_stolen_lane_resolves_exactly_once():
+    """ISSUE 13 lane-requeue hardening (unit half): once host B steals a
+    lane, the lane lives ONLY with B — B's delivery resolves the
+    submission exactly once, and a late cancel/teardown on A has no lane
+    to double-resolve; a delivery into an already-cancelled future is a
+    no-op."""
+    f = FleetDispatcher(["hA", "hB"], max_queue=4)
+    sub = _sub(4)
+    f.push(sub)
+    lane = f.packer.pop_lane(4)
+    assert f.assign(lane) == "hA"
+    stolen = f.take("hB")  # B steals A's only lane
+    assert stolen is lane
+    assert f.take("hA", steal=False) is None  # A has nothing left
+    stolen and sub.deliver(0, [True, False, True, True])
+    assert await sub.fut == [True, False, True, True]
+    # teardown-after-delivery: cancel is a no-op on a resolved future
+    assert not sub.fut.cancel()
+
+    # the reverse race: teardown cancels the future while the stolen
+    # lane is still in flight — the late delivery must not blow up or
+    # resurrect it
+    sub2 = _sub(2)
+    f.push(sub2)
+    lane2 = f.packer.pop_lane(4)
+    sub2.fut.cancel()
+    lane2.slices[0][0].deliver(0, [True, True])  # no InvalidStateError
+    assert sub2.fut.cancelled()
 
 
 # --- engine pipeline ---------------------------------------------------------
@@ -387,6 +567,397 @@ def test_engine_mesh_gating(monkeypatch):
     monkeypatch.setattr(jax, "devices", lambda *a: devs[:1])
     assert eng3._mesh() is None  # 1 visible device: soft-off
     assert eng3._mesh_state == "failed"  # tried once, never again
+
+
+# --- fleet engine integration (ISSUE 13) -------------------------------------
+
+
+def _fake_fleet_device(monkeypatch):
+    """The chaos-sim device extended to the fleet's sharded rung: host
+    sub-meshes build for real (cheap — 1-D meshes over the virtual CPU
+    devices, no compile) but both device dispatch entry points compute
+    verdicts on the host, so fleet tests run the genuine tpu rung with
+    per-host breakers engaged and zero XLA compiles."""
+    import tpunode.verify.multichip as MC
+    from tests.test_chaos import _fake_device
+    from tpunode.verify.ecdsa_cpu import verify_batch_cpu
+
+    _fake_device(monkeypatch)
+    monkeypatch.setattr(
+        MC, "dispatch_raw_sharded",
+        lambda raw, mesh, pad_to=None, kernel="auto": (
+            verify_batch_cpu(raw.to_tuples()), len(raw)
+        ),
+    )
+
+
+@pytest.mark.asyncio
+async def test_fleet_engine_verdict_conservation():
+    """mesh_hosts=4 on the cpu rung: odd-sized concurrent submissions
+    slice across lanes dispatched by four host workers — every waiter
+    gets exactly its own items' verdicts and the fleet stats surface."""
+    metrics.reset()
+    sizes = [3, 9, 1, 7, 5, 2, 11, 4]
+    batches = [make_items(n, tamper_every=3) for n in sizes]
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=8, max_wait=0.02, pipeline_depth=1,
+            mesh_hosts=4, warmup=False,
+        )
+    ) as eng:
+        futs = [
+            asyncio.ensure_future(eng.verify(items))
+            for items, _ in batches
+        ]
+        got = await asyncio.gather(*futs)
+        st = eng.stats()["fleet"]
+    for (items, expected), out in zip(batches, got):
+        assert out == expected
+    assert st["hosts"] == 4 and len(st["active"]) == 4
+    assert metrics.get("sched.lanes") >= 2
+    assert metrics.get("verify.items") == sum(sizes)
+    assert task_registry.report_leaks() == []
+
+    with pytest.raises(ValueError, match="mesh_hosts"):
+        VerifyConfig(backend="cpu", warmup=False, mesh_hosts=1)
+    with pytest.raises(ValueError, match="fleet_queue"):
+        VerifyConfig(backend="cpu", warmup=False, mesh_hosts=2,
+                     fleet_queue=0)
+
+
+@pytest.mark.asyncio
+async def test_fleet_engine_steals_from_blocked_host():
+    """Work stealing end to end: with h0's dispatch wedged, its queued
+    lanes are stolen and served by h1 — throughput degrades to the
+    healthy host instead of queueing behind the sick one."""
+    metrics.reset()
+    gate = threading.Event()
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=4, max_wait=0.0, pipeline_depth=1,
+            mesh_hosts=2, fleet_queue=2, warmup=False,
+        )
+    ) as eng:
+        orig = eng._dispatch_multi
+
+        def gated(payloads, target=None, host=None, backend=None):
+            if host is not None and host.name == "h0":
+                gate.wait(10)
+            return orig(payloads, target, host=host, backend=backend)
+
+        eng._dispatch_multi = gated
+        batches = [make_items(4, tamper_every=3) for _ in range(8)]
+        futs = [
+            asyncio.ensure_future(eng.verify(items))
+            for items, _ in batches
+        ]
+        # h1 drains everything stealable while h0 wedges on (at most)
+        # its one in-flight lane
+        deadline = time.monotonic() + 10
+        while sum(f.done() for f in futs) < len(futs) - 1:
+            assert time.monotonic() < deadline, "h1 failed to steal"
+            await asyncio.sleep(0.01)
+        assert eng._fleet.steals >= 1
+        gate.set()
+        got = await asyncio.gather(*futs)
+    for (items, expected), out in zip(batches, got):
+        assert out == expected
+    assert metrics.get("sched.steals") >= 1
+
+
+@pytest.mark.asyncio
+async def test_fleet_partition_requeues_exactly_once_and_rejoins():
+    """ISSUE 13 degradation: an injected host partition deactivates the
+    host and re-queues its in-flight lane onto the peer — the lane
+    resolves exactly once (correct verdicts, no double delivery) — and
+    the cooldown-paced canary rejoins the host once the fault clears."""
+    from tpunode.chaos import ChaosPlan, chaos
+
+    metrics.reset()
+    chaos.install(ChaosPlan.parse(
+        "seed=3;mesh.dispatch:partition:match=h1,n=2"
+    ))
+    try:
+        async with VerifyEngine(
+            VerifyConfig(
+                backend="cpu", batch_size=8, max_wait=0.005,
+                pipeline_depth=1, mesh_hosts=2, warmup=False,
+                breaker_cooldown=0.1,
+            )
+        ) as eng:
+            downs = []
+            for _ in range(10):
+                batches = [make_items(6, tamper_every=3) for _ in range(6)]
+                got = await asyncio.gather(
+                    *(eng.verify(i) for i, _ in batches)
+                )
+                for (items, expected), out in zip(batches, got):
+                    assert out == expected  # requeued lanes: verdicts once
+                downs.append(len(eng._fleet.active_hosts()))
+                await asyncio.sleep(0.01)
+            assert min(downs) == 1, "partition never deactivated h1"
+            assert eng._fleet.requeued >= 1
+            assert metrics.get("mesh.host_losses") >= 1
+            # the plan is exhausted: the canary rejoin restores the fleet
+            deadline = time.monotonic() + 5
+            while (
+                len(eng._fleet.active_hosts()) < 2
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.02)
+            assert len(eng._fleet.active_hosts()) == 2
+        assert task_registry.report_leaks() == []
+    finally:
+        chaos.uninstall()
+
+
+@pytest.mark.asyncio
+async def test_fleet_dark_requeue_bound_serves_locally():
+    """Every host partitioned: new lanes take the scheduler's local
+    fallback, and a lane bouncing between dying hosts exhausts its
+    requeue bound and is served through the local cpu ladder — waiters
+    always resolve, nothing double-resolves, nothing strands."""
+    from tpunode.chaos import ChaosPlan, chaos
+
+    chaos.install(ChaosPlan.parse(
+        "seed=9;mesh.dispatch:partition:p=1"  # every fleet dispatch dies
+    ))
+    try:
+        async with VerifyEngine(
+            VerifyConfig(
+                backend="cpu", batch_size=8, max_wait=0.005,
+                pipeline_depth=1, mesh_hosts=2, warmup=False,
+                breaker_cooldown=0.05,
+            )
+        ) as eng:
+            batches = [make_items(5, tamper_every=2) for _ in range(8)]
+            async with asyncio.timeout(30):
+                got = await asyncio.gather(
+                    *(eng.verify(i) for i, _ in batches)
+                )
+            for (items, expected), out in zip(batches, got):
+                assert out == expected
+            assert eng.dispatch_inflight() == 0
+    finally:
+        chaos.uninstall()
+    assert task_registry.report_leaks() == []
+
+
+@pytest.mark.asyncio
+async def test_fleet_shutdown_cancels_queued_and_inflight():
+    """ISSUE 13 requeue hardening (teardown half): engine exit with a
+    wedged host cancels in-flight lanes' futures AND the futures of
+    lanes still sitting in host queues — no waiter hangs, no task
+    leaks, and late deliveries into cancelled futures are no-ops."""
+    gate = threading.Event()
+    eng = VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=4, max_wait=0.0, pipeline_depth=1,
+            mesh_hosts=2, fleet_queue=2, warmup=False,
+        )
+    )
+    futs = []
+    async with eng:
+        orig = eng._dispatch_multi
+
+        def wedged(payloads, target=None, host=None, backend=None):
+            gate.wait(10)
+            return orig(payloads, target, host=host, backend=backend)
+
+        eng._dispatch_multi = wedged
+        for _ in range(8):
+            items, _ = make_items(4)
+            futs.append(asyncio.ensure_future(eng.verify(items)))
+        while eng.dispatch_inflight() < 2:
+            await asyncio.sleep(0.005)
+        await asyncio.sleep(0.05)  # let the scheduler queue the rest
+    gate.set()  # unblock the abandoned dispatch threads
+    for f in futs:
+        with pytest.raises(asyncio.CancelledError):
+            await f
+    assert task_registry.report_leaks() == []
+
+
+@pytest.mark.asyncio
+async def test_fleet_chip_loss_shrinks_then_canary_regrows(monkeypatch):
+    """Chip-by-chip degradation: a device loss on one multi-chip host
+    halves that host's sub-mesh (largest still-healthy half) while the
+    OTHER host keeps its full row; the failed lane still resolves via
+    the ladder; the breaker's canary close re-grows the sub-mesh."""
+    from tpunode.chaos import ChaosPlan, chaos
+
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device conftest mesh")
+    _fake_fleet_device(monkeypatch)
+    chaos.install(ChaosPlan.parse(
+        "seed=5;mesh.dispatch:device_loss:match=h0:tpu,n=1"
+    ))
+    try:
+        async with VerifyEngine(
+            VerifyConfig(
+                backend="auto", batch_size=8, device_batch=8,
+                min_tpu_batch=1, max_wait=0.0, pipeline_depth=1,
+                mesh_hosts=2, warmup=True, breaker_threshold=1,
+                breaker_cooldown=0.05,
+            )
+        ) as eng:
+            assert eng._warmup_done.wait(5)
+            assert eng.device_state == "ready"
+            h0 = eng._hosts["h0"]
+            shrunk = False
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                items, expected = make_items(8, tamper_every=3)
+                assert await eng.verify(items) == expected
+                if h0.chips == 2:
+                    shrunk = True  # 4-chip row halved by the device loss
+                if shrunk and h0.chips == 4:
+                    break
+                await asyncio.sleep(0.01)
+            assert shrunk, "device loss never shrank h0's sub-mesh"
+            assert h0.chips == 4, "canary close never re-grew the mesh"
+            # the sick host degraded ALONE: h1's row was never shrunk
+            # (0 = not yet built, 4 = built at full width)
+            assert eng._hosts["h1"].chips in (0, 4)
+            assert metrics.get("mesh.shrinks") >= 1
+            assert metrics.get("mesh.regrows") >= 1
+    finally:
+        chaos.uninstall()
+
+
+@pytest.mark.asyncio
+async def test_fleet_chip_loss_regrows_without_breaker_open(monkeypatch):
+    """Review r13: at the DEFAULT breaker threshold a single device
+    loss only reaches 'degraded' — the shrink must still re-grow (via
+    the cooldown-paced success probe), not pin the host at half width
+    forever behind a breaker that reads 'ready'."""
+    from tpunode.chaos import ChaosPlan, chaos
+
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device conftest mesh")
+    _fake_fleet_device(monkeypatch)
+    chaos.install(ChaosPlan.parse(
+        "seed=6;mesh.dispatch:device_loss:match=h0:tpu,n=1"
+    ))
+    try:
+        async with VerifyEngine(
+            VerifyConfig(
+                backend="auto", batch_size=8, device_batch=8,
+                min_tpu_batch=1, max_wait=0.0, pipeline_depth=1,
+                mesh_hosts=2, warmup=True,
+                breaker_threshold=3,  # the default shape: loss => degraded
+                breaker_cooldown=0.05,
+            )
+        ) as eng:
+            assert eng._warmup_done.wait(5)
+            h0 = eng._hosts["h0"]
+            shrunk = False
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                items, expected = make_items(8, tamper_every=3)
+                assert await eng.verify(items) == expected
+                if h0.chips == 2:
+                    shrunk = True
+                    assert h0.breaker.state in ("degraded", "ready")
+                    assert eng.breaker.opens == 0  # global untouched
+                if shrunk and h0.chips == 4:
+                    break
+                await asyncio.sleep(0.01)
+            assert shrunk, "device loss never shrank h0's sub-mesh"
+            assert h0.chips == 4, (
+                "shrink without a breaker open never re-grew"
+            )
+            assert h0.breaker.opens == 0  # the gap scenario: no open ever
+    finally:
+        chaos.uninstall()
+
+
+@pytest.mark.asyncio
+async def test_fleet_mesh_shrink_soak(monkeypatch):
+    """ISSUE 13 acceptance SOAK: 8 fleet hosts under staged partitions —
+    the active set shrinks 8 -> ... -> 1 (h0 is never partitioned) while
+    traffic flows, then re-grows to 8 as the canaries clear.  Every
+    unique item gets exactly one clean verdict across the whole
+    degradation cycle, and zero tasks leak."""
+    from tpunode.chaos import ChaosPlan, chaos
+
+    _fake_fleet_device(monkeypatch)
+    # Staged losses: four hosts die on their first dispatch, two more
+    # after a couple of rounds, one last — each stays dead for n fires
+    # of its canary probes, then recovers.  h0 survives throughout.
+    plan = ";".join(
+        ["seed=1337"]
+        + [f"mesh.dispatch:partition:match=h{i},n=14" for i in (4, 5, 6, 7)]
+        + [f"mesh.dispatch:partition:match=h{i},after=2,n=12" for i in (2, 3)]
+        + ["mesh.dispatch:partition:match=h1,after=4,n=10"]
+    )
+    chaos.install(ChaosPlan.parse(plan))
+    # The shrink trajectory is read from the mesh.host_down/host_up
+    # events (each carries the post-transition active_hosts count), NOT
+    # by sampling active_hosts() on a timer — under suite load a whole
+    # loss cascade can complete between two wall-clock samples (review
+    # r13: the sampled variant flaked with observed={1, 8}).
+    from tpunode.events import events as _events
+
+    sizes: list[int] = []
+    unsub = _events.subscribe(
+        lambda ev: sizes.append(ev["active_hosts"])
+        if ev.get("type") in ("mesh.host_down", "mesh.host_up")
+        else None
+    )
+    try:
+        async with VerifyEngine(
+            VerifyConfig(
+                backend="auto", batch_size=8, device_batch=8,
+                min_tpu_batch=1, max_wait=0.002, pipeline_depth=1,
+                mesh_hosts=8, warmup=True, breaker_threshold=2,
+                breaker_cooldown=0.05, fleet_queue=1,
+            )
+        ) as eng:
+            assert eng._warmup_done.wait(5)
+            deadline = time.monotonic() + 40
+            rounds = 0
+            while time.monotonic() < deadline:
+                batches = [
+                    make_items(6, tamper_every=3) for _ in range(10)
+                ]
+                got = await asyncio.gather(
+                    *(eng.verify(i) for i, _ in batches)
+                )
+                for (items, expected), out in zip(batches, got):
+                    # exactly-once, clean: gather returning the right
+                    # verdict lists IS verdict conservation — a dropped
+                    # slice hangs the future, a doubled one corrupts it
+                    assert out == expected
+                rounds += 1
+                if (
+                    sizes
+                    and min(sizes) == 1
+                    and len(eng._fleet.active_hosts()) == 8
+                ):
+                    break
+            assert sizes and min(sizes) == 1, (
+                f"fleet never shrank to 1: {sorted(set(sizes))}"
+            )
+            # staged: the transition log passes through several distinct
+            # fleet sizes on the way down (7 hosts die one by one)
+            assert len(set(sizes)) >= 3, f"expected staged shrink: {sizes}"
+            assert len(eng._fleet.active_hosts()) == 8, "never re-grew"
+            assert eng._fleet.requeued >= 1
+            assert metrics.get("mesh.host_losses") >= 7
+            assert eng.dispatch_inflight() == 0
+            # NOTE: no minimum-round assert — under full-suite load two
+            # slow rounds can span the whole 8→1→8 cycle, and the
+            # conservation proof is per-submission regardless (a round
+            # count is traffic volume, not an invariant; it flaked at
+            # rounds==2 on a loaded box)
+            assert rounds >= 1
+    finally:
+        unsub()
+        chaos.uninstall()
+    assert task_registry.report_leaks() == []
 
 
 # --- acceptance: fakenet node through the full pipeline ----------------------

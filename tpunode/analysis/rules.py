@@ -476,6 +476,9 @@ KNOWN_LAYERS = frozenset({
     "events",     # event-log self-metrics (tpunode/events.py)
     "ibd",        # block-fetch-driven IBD planner (tpunode/ibd.py, ISSUE 11)
     "mempool",    # mempool subsystem (tpunode/mempool.py)
+    "mesh",       # pod-scale fleet: host health, sub-mesh shrink/regrow
+                  # (tpunode/verify/engine.py, ISSUE 13; also the
+                  # chaos mesh.dispatch injection point)
     "node",       # node composition/ingest (tpunode/node.py)
     "peer",       # wire sessions (tpunode/peer.py)
     "peermgr",    # fleet manager (tpunode/peermgr.py)

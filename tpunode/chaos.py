@@ -32,6 +32,15 @@ point                     actions
 ``engine.dispatch``       ``error`` (batch failure), ``device_loss``
                           (raise ChaosDeviceLoss — the breaker's signal)
 ``engine.warmup``         ``error`` (device warmup/compile failure)
+``mesh.dispatch``         ``error``, ``device_loss`` (one host's chip/
+                          sub-mesh fails — that host's breaker degrades
+                          it alone), ``partition`` (raise ChaosPartition
+                          — the whole host is unreachable: the fleet
+                          dispatcher re-queues its lanes and drops it
+                          from the active set until a canary rejoins
+                          it).  ``match`` scopes the fault to one host
+                          and/or rung: the site label is
+                          ``<host>:<rung>:chips<n>`` (ISSUE 13)
 ========================  =================================================
 
 A fault plan is a seed plus a list of :class:`FaultSpec`, parsed from
@@ -78,6 +87,7 @@ __all__ = [
     "POINTS",
     "ChaosDeviceLoss",
     "ChaosFault",
+    "ChaosPartition",
     "ChaosPlan",
     "FaultSpec",
     "chaos",
@@ -100,6 +110,13 @@ class ChaosDeviceLoss(ChaosFault):
     the engine's device rung (the circuit breaker's trigger)."""
 
 
+class ChaosPartition(ChaosFault):
+    """Injected host partition (ISSUE 13): the WHOLE host is gone, so
+    the dispatch ladder must not serve the lane locally — the fleet
+    dispatcher re-queues it onto a healthy peer and deactivates the
+    host until a canary re-probe succeeds."""
+
+
 #: Injection-point catalog: point -> allowed actions (ROBUSTNESS.md is
 #: the user-facing version).  ``parse`` validates against this.
 POINTS: dict[str, tuple[str, ...]] = {
@@ -112,6 +129,7 @@ POINTS: dict[str, tuple[str, ...]] = {
     "store.compact": ("error", "crash"),
     "engine.dispatch": ("error", "device_loss"),
     "engine.warmup": ("error",),
+    "mesh.dispatch": ("error", "device_loss", "partition"),
 }
 
 
@@ -317,6 +335,8 @@ class Chaos:
         msg = f"chaos[{spec.describe()}] at {label or point}"
         if spec.action == "device_loss":
             raise ChaosDeviceLoss(msg)
+        if spec.action == "partition":
+            raise ChaosPartition(msg)
         raise ChaosFault(msg)
 
     def garbage(self, n: int) -> bytes:

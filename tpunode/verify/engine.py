@@ -22,6 +22,20 @@ partial lane finally dispatches.  With ``mesh_devices > 1`` the device
 rung shards packed lanes over a local device mesh
 (:func:`multichip.dispatch_raw_sharded`).
 
+Pod scale (ISSUE 13): ``mesh_hosts >= 2`` promotes the pipeline into a
+cross-host fleet — the device set is carved into that many host groups
+(a ``(host, chip)`` hybrid mesh, :func:`multichip.make_hybrid_mesh`),
+each host runs ``pipeline_depth`` dispatch workers pulling packed lanes
+from a work-stealing :class:`sched.FleetDispatcher` (idle hosts steal
+whole lanes from the deepest peer queue), and each host carries its OWN
+circuit breaker and device sub-mesh so one sick host degrades alone.
+Degradation is chip-by-chip: a device loss shrinks that host's sub-mesh
+to the largest still-healthy half (re-grown when its breaker's canary
+closes); a host partition re-queues the lane onto a healthy peer
+(exactly once — the lane delivered nothing), deactivates the host, and
+a cooldown-paced canary rejoin re-grows the fleet.  With every host
+dark, lanes fall through the local ladder so waiters still resolve.
+
 Device survival discipline (VERDICT r2 item 4 + ISSUE 7): the TPU path is
 only used after an off-queue **warmup** (backend init + XLA compile at the
 fixed batch shape + a verdict cross-check against the oracle) completes in
@@ -61,7 +75,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
 from ..actors import spawn_supervised
-from ..chaos import chaos
+from ..chaos import ChaosPartition, chaos
 from ..events import events
 from ..metrics import metrics
 from ..trace import span
@@ -70,6 +84,7 @@ from .ecdsa_cpu import Point, verify_batch_cpu
 from .raw import as_raw_batch, concat_raw
 from .sched import (
     OCCUPANCY_BUCKETS as _OCCUPANCY_BUCKETS,
+    FleetDispatcher,
     LanePacker,
     PackedLane,
     Submission,
@@ -77,11 +92,20 @@ from .sched import (
 
 __all__ = [
     "CircuitBreaker",
+    "HostLost",
     "VerifyConfig",
     "VerifyEngine",
     "VerifyItem",
     "enable_compile_cache",
 ]
+
+
+class HostLost(RuntimeError):
+    """A fleet host is unreachable (ISSUE 13): the dispatch ladder must
+    NOT serve the lane locally on this host's behalf — the worker
+    re-queues it onto a healthy peer and deactivates the host.  Today
+    raised for an injected ``mesh.dispatch:partition``; a real pod's
+    RPC/transport failures map here too."""
 
 # (pubkey, z, r, s) for ECDSA; 5-tuples append "schnorr" (BCH) or
 # "bip340" (taproot) with the precomputed challenge in the z position.
@@ -238,11 +262,19 @@ class CircuitBreaker:
     STATES = ("ready", "degraded", "open", "probing")
 
     def __init__(
-        self, threshold: int = 3, window: float = 30.0, cooldown: float = 5.0
+        self,
+        threshold: int = 3,
+        window: float = 30.0,
+        cooldown: float = 5.0,
+        name: str = "",
     ):
         self.threshold = max(1, threshold)
         self.window = window
         self.cooldown = cooldown
+        # Fleet host identity (ISSUE 13): named breakers label their
+        # gauge/events with host= so one sick host's transitions don't
+        # masquerade as engine-wide device health.
+        self.name = name
         self._lock = threading.Lock()
         self._state = "ready"
         self._failures: collections.deque[float] = collections.deque()
@@ -273,23 +305,28 @@ class CircuitBreaker:
                 return True
             return False
 
-    def record_success(self) -> None:
-        """A device batch completed: close toward ``ready``."""
+    def record_success(self) -> bool:
+        """A device batch completed: close toward ``ready``.  Returns
+        True when this success CLOSED an open/probing breaker (the
+        fleet's re-grow hook — a successful canary restores the host's
+        full sub-mesh)."""
         with self._lock:
             self._failures.clear()
             if self._state == "ready":
-                return
+                return False
             fields = {}
             if self._opened_at is not None:
                 recovery = time.monotonic() - self._opened_at
                 metrics.observe("verify.breaker_recovery_seconds", recovery)
                 fields["recovery_seconds"] = round(recovery, 3)
-            if self._state in ("open", "probing"):
+            closed = self._state in ("open", "probing")
+            if closed:
                 self.closes += 1
                 metrics.inc("verify.breaker_closes")
             self._opened_at = None
             self._last_error = None
             self._transition("ready", **fields)
+            return closed
 
     def record_failure(self, error: str = "") -> None:
         """A device batch failed (the ladder already re-dispatched it)."""
@@ -317,12 +354,30 @@ class CircuitBreaker:
                     "degraded", failures=len(self._failures), error=error,
                 )
 
+    def trip(self, error: str = "") -> None:
+        """Force the breaker OPEN immediately (ISSUE 13: a host
+        partition is not three strikes — the host is gone NOW; the
+        cooldown/canary recovery machinery applies unchanged)."""
+        with self._lock:
+            now = time.monotonic()
+            self._failures.append(now)
+            self._last_error = error or None
+            self._opened_at = now
+            if self._state != "open":
+                self.opens += 1
+                metrics.inc("verify.breaker_opens")
+                self._transition("open", error=error, forced=True)
+
     def _transition(self, to: str, **fields) -> None:
         # lock held by the caller
         frm, self._state = self._state, to
         metrics.set_gauge(
-            "verify.breaker_state", float(self.STATES.index(to))
+            "verify.breaker_state",
+            float(self.STATES.index(to)),
+            labels={"host": self.name} if self.name else None,
         )
+        if self.name:
+            fields = {"host": self.name, **fields}
         log.warning("[Engine] breaker %s -> %s %s", frm, to, fields or "")
         events.emit("verify.breaker", **{"from": frm, "to": to, **fields})
 
@@ -368,6 +423,21 @@ class VerifyConfig:
     # program compiles on first dispatch (warmup compiles the single-chip
     # shapes only).
     mesh_devices: int = 0
+    # Pod-scale fleet dispatch (ISSUE 13): >= 2 carves the device set
+    # into this many host groups (a (host, chip) hybrid mesh —
+    # multichip.make_hybrid_mesh; with mesh_devices set, only that many
+    # devices are carved) and runs pipeline_depth work-stealing dispatch
+    # workers PER HOST (sched.FleetDispatcher), each host with its own
+    # circuit breaker and device sub-mesh so one sick host degrades
+    # alone.  0 (default) keeps the single-host pipeline.  1 is
+    # rejected: a one-host fleet is the single-host pipeline.
+    mesh_hosts: int = 0
+    # Per-host assigned-lane cap (lanes): how deep the scheduler may
+    # pre-assign packed lanes onto one host's queue before waiting.
+    # Shallow queues keep late high-priority submissions packing ahead
+    # of un-cut work; the work-stealing makes depth mostly latency, not
+    # throughput.
+    fleet_queue: int = 2
     # Below this, the CPU engine beats a device step padded to batch_size:
     # the device pays one full fixed-shape step regardless of occupancy,
     # while the C++ engine verifies ~4.8k sigs/s — crossover near
@@ -417,8 +487,9 @@ class VerifyConfig:
     field_reduce: Optional[str] = None
     # MSM window width (ISSUE 12): None keeps the process-wide mode
     # (TPUNODE_WINDOW_BITS env knob); 4 keeps the 33-round/16-entry r3
-    # structure, 5 runs 27 rounds over 32-entry tables (host prep falls
-    # back to the Python path — the native layout is 4-bit).
+    # structure, 5 runs 27 rounds over 32-entry tables (the native prep
+    # emits both layouts since ISSUE 13; only a stale libsecp_cpu.so
+    # preps w5 batches in Python).
     window_bits: Optional[int] = None
 
     def __post_init__(self):
@@ -426,6 +497,12 @@ class VerifyConfig:
             self.device_batch = self.batch_size
         if self.pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
+        if self.mesh_hosts == 1 or self.mesh_hosts < 0:
+            raise ValueError(
+                "mesh_hosts: 0 disables the fleet, >= 2 enables it"
+            )
+        if self.fleet_queue < 1:
+            raise ValueError("fleet_queue must be >= 1")
         if (
             self.field_mul is not None
             or self.field_sqr is not None
@@ -446,6 +523,39 @@ class VerifyConfig:
             from . import kernel as _kernel
 
             _kernel.set_kernel_modes(window_bits=self.window_bits)
+
+
+class _HostState:
+    """Per-host fleet state (ISSUE 13): its breaker, its device
+    sub-mesh (with the current healthy width), and the lost/rejoin
+    machinery.  Mesh fields are guarded by the engine's ``_mesh_lock``
+    (dispatch worker threads race on first build / shrink / re-grow);
+    ``lost`` is written on the event loop and in dispatch threads but
+    only ever flips through the engine's ``_host_down`` /
+    ``_host_rejoin`` which the worker task serializes per host."""
+
+    __slots__ = (
+        "name", "index", "breaker", "lost", "lost_at",
+        "mesh", "mesh_state", "chips", "full_chips", "shrunk_at", "event",
+    )
+
+    def __init__(self, name: str, index: int, cfg: "VerifyConfig"):
+        self.name = name
+        self.index = index
+        self.breaker = CircuitBreaker(
+            threshold=cfg.breaker_threshold,
+            window=cfg.breaker_window,
+            cooldown=cfg.breaker_cooldown,
+            name=name,
+        )
+        self.lost = False
+        self.lost_at = 0.0
+        self.mesh = None  # lazily-built 1-D sub-mesh over this host's row
+        self.mesh_state = "cold"  # cold -> ready | failed (soft: single-chip)
+        self.chips = 0  # current healthy sub-mesh width (0 = not built yet)
+        self.full_chips = 0  # the full row width (re-grow target)
+        self.shrunk_at = 0.0  # last shrink time (paces the re-grow probe)
+        self.event: Optional[asyncio.Event] = None  # lane-assigned wakeup
 
 
 class VerifyEngine:
@@ -489,6 +599,27 @@ class VerifyEngine:
         self._mesh_obj = None
         self._mesh_state = "cold"
         self._mesh_lock = threading.Lock()
+        # Pod-scale fleet (ISSUE 13, cfg.mesh_hosts >= 2): per-host
+        # states + the work-stealing dispatcher, built in __aenter__;
+        # the hybrid mesh's device rows are carved lazily on the first
+        # device dispatch (guarded by _mesh_lock).
+        self._fleet: Optional[FleetDispatcher] = None
+        self._hosts: dict[str, _HostState] = {}
+        self._fleet_hybrid = None  # the (host, chip) Mesh, carved lazily
+        self._fleet_hybrid_state = "cold"
+        self._room: Optional[asyncio.Event] = None
+        if self.cfg.mesh_hosts >= 2:
+            self._hosts = {
+                f"h{i}": _HostState(f"h{i}", i, self.cfg)
+                for i in range(self.cfg.mesh_hosts)
+            }
+            self._fleet = FleetDispatcher(
+                list(self._hosts), self._packer,
+                max_queue=self.cfg.fleet_queue,
+            )
+            metrics.set_gauge(
+                "mesh.active_hosts", float(len(self._hosts))
+            )
         self._cpu = None
         if self.cfg.backend in ("auto", "cpu"):
             from .cpu_native import load_native_verifier
@@ -667,6 +798,21 @@ class VerifyEngine:
             "failovers": metrics.get("verify.failovers"),
             "breaker": self._breaker.stats(),
         }
+        if self._fleet is not None:
+            out["fleet"] = {
+                "hosts": len(self._hosts),
+                "active": self._fleet.active_hosts(),
+                "depths": self._fleet.host_depths(),
+                "steals": self._fleet.steals,
+                "requeued": self._fleet.requeued,
+                "breakers": {
+                    name: hs.breaker.state
+                    for name, hs in self._hosts.items()
+                },
+                "chips": {
+                    name: hs.chips for name, hs in self._hosts.items()
+                },
+            }
         occ = metrics.histogram("verify.occupancy")
         if occ is not None:
             out["occupancy"] = occ.summary()
@@ -684,6 +830,18 @@ class VerifyEngine:
         self._kick = asyncio.Event()
         self._slots = asyncio.Semaphore(self.cfg.pipeline_depth)
         self._closing = False  # task-registry owner convention (actors.py)
+        if self._fleet is not None:
+            self._room = asyncio.Event()
+            for hs in self._hosts.values():
+                hs.event = asyncio.Event()
+                for _ in range(self.cfg.pipeline_depth):
+                    t = spawn_supervised(
+                        self._host_worker(hs),
+                        name=f"verify-host-{hs.name}",
+                        owner=self,
+                    )
+                    self._lane_tasks.add(t)
+                    t.add_done_callback(self._lane_tasks.discard)
         # ISSUE 3 satellite: the queue loop was a bare create_task handle —
         # registry-supervised now, cancelled+awaited in __aexit__ below
         self._task = spawn_supervised(
@@ -697,15 +855,26 @@ class VerifyEngine:
             self._task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await self._task
-        # in-flight lanes: cancel + await (their dispatch threads finish
-        # behind the cancelled await; verdicts for cancelled lanes are
-        # dropped with the futures below)
+        # in-flight lanes + fleet workers: cancel + await (their dispatch
+        # threads finish behind the cancelled await; verdicts for
+        # cancelled lanes are dropped with the futures below)
         for t in list(self._lane_tasks):
             t.cancel()
         for t in list(self._lane_tasks):
             with contextlib.suppress(asyncio.CancelledError, Exception):
                 await t
         self._lane_tasks.clear()
+        # lanes still assigned to host queues (cut from the packer but
+        # never taken — incl. lanes re-queued mid-steal by a dying host):
+        # cancel their carried futures exactly like queued submissions;
+        # Submission.deliver tolerates a done/cancelled future, so a
+        # concurrent late delivery cannot double-resolve (ISSUE 13
+        # lane-requeue hardening).
+        if self._fleet is not None:
+            for lane in self._fleet.drain_lanes():
+                for sub, _, _ in lane.slices:
+                    if not sub.fut.done():
+                        sub.fut.cancel()
         # fail any stragglers still queued (or partially claimed)
         for sub in self._packer.drain():
             if not sub.fut.done():
@@ -768,7 +937,10 @@ class VerifyEngine:
         """Pipeline scheduler loop: linger toward full lanes, then keep up
         to ``pipeline_depth`` packed lanes in flight (each in its own
         dispatch thread — lane N+1's host prep and transfer overlap lane
-        N's kernel under JAX async dispatch)."""
+        N's kernel under JAX async dispatch).  In fleet mode the same
+        linger feeds the work-stealing dispatcher instead: each cut lane
+        is assigned to the shallowest active host queue, and the per-host
+        workers (not this loop) own dispatch."""
         assert self._kick is not None and self._slots is not None
         while True:
             # wait for work
@@ -797,6 +969,9 @@ class VerifyEngine:
                 self._kick.clear()
             if not self._packer.pending():
                 continue
+            if self._fleet is not None:
+                await self._feed_fleet()
+                continue
             # admission: a free pipeline slot (more work keeps queueing —
             # and packing fuller lanes — while every slot is busy)
             await self._slots.acquire()
@@ -804,18 +979,99 @@ class VerifyEngine:
             if lane is None:
                 self._slots.release()
                 continue
-            task = spawn_supervised(
-                self._dispatch_lane(lane), name="verify-lane", owner=self
-            )
-            self._lane_tasks.add(task)
-            task.add_done_callback(self._lane_tasks.discard)
+            self._spawn_lane_task(lane)
 
-    async def _dispatch_lane(self, lane: PackedLane) -> None:
+    def _spawn_lane_task(self, lane: PackedLane) -> None:
+        """Spawn one locally-dispatched lane task (the caller holds a
+        pipeline slot; _dispatch_lane releases it)."""
+        task = spawn_supervised(
+            self._dispatch_lane(lane), name="verify-lane", owner=self
+        )
+        self._lane_tasks.add(task)
+        task.add_done_callback(self._lane_tasks.discard)
+
+    async def _feed_fleet(self) -> None:
+        """Cut ONE lane and hand it to the fleet (ISSUE 13).  Admission
+        is queue room on some active host (shallow queues keep late
+        high-priority submissions packing ahead of un-cut work); with
+        every host lost, the lane is served through the LOCAL ladder
+        under the ordinary pipeline slots — a fully-dark fleet still
+        produces verdicts."""
+        assert self._fleet is not None and self._room is not None
+        assert self._slots is not None
+        while not self._fleet.has_room() and self._fleet.active_hosts():
+            self._room.clear()
+            await self._room.wait()
+        lane = self._packer.pop_lane(self._lane_target())
+        if lane is None:
+            return
+        host = self._fleet.assign(lane)
+        if host is None:
+            # no active host at all: local fallback, traffic never stops
+            await self._slots.acquire()
+            self._spawn_lane_task(lane)
+            return
+        self._wake_fleet()
+
+    def _wake_fleet(self) -> None:
+        """Wake every host worker (a new/re-queued lane may be stolen by
+        ANY idle host, not just the one it was assigned to)."""
+        for hs in self._hosts.values():
+            if hs.event is not None:
+                hs.event.set()
+
+    async def _host_worker(self, hs: _HostState) -> None:
+        """One host's dispatch worker (``pipeline_depth`` of these run
+        per host): pull lanes — own queue first, then steal from the
+        deepest peer — and dispatch them over this host's sub-mesh with
+        this host's breaker.  A lost host's workers pace the canary
+        rejoin instead of pulling work."""
+        assert self._fleet is not None and self._room is not None
+        while True:
+            if hs.lost:
+                # cooldown-paced rejoin, anchored on the LOSS time (not
+                # on when this worker noticed — several workers share
+                # one host): after breaker_cooldown the host re-enters
+                # the active set with its breaker open — the next lane
+                # a worker takes is the half-open canary, and a
+                # still-dead host just gets deactivated again.
+                remain = (
+                    hs.lost_at + self.cfg.breaker_cooldown
+                    - time.monotonic()
+                )
+                await asyncio.sleep(max(0.01, remain))
+                if hs.lost:
+                    self._host_rejoin(hs)
+                continue
+            lane = self._fleet.take(hs.name)
+            if lane is None:
+                self._room.set()
+                assert hs.event is not None
+                await hs.event.wait()
+                hs.event.clear()
+                continue
+            self._room.set()
+            await self._dispatch_lane(lane, host=hs, slot=False)
+
+    async def _dispatch_lane(
+        self,
+        lane: PackedLane,
+        host: Optional[_HostState] = None,
+        slot: bool = True,
+    ) -> None:
         """Run one packed lane end to end: dispatch in a worker thread
         (the ladder/breaker/failover semantics of :meth:`_run_ladder`
         apply per in-flight lane), then deliver each slice's verdicts to
         its submission.  A lane that fails on every rung fails exactly
-        the submissions it carries slices of."""
+        the submissions it carries slices of.
+
+        Fleet mode (``host`` set): the lane runs with that host's
+        breaker and sub-mesh; a :class:`HostLost` deactivates the host
+        and RE-QUEUES the lane onto a healthy peer — exactly once, since
+        nothing was delivered and the lane now lives in exactly one peer
+        queue.  A lane that has already bounced through every host (or
+        finds no healthy peer) falls through the LOCAL cpu ladder so its
+        waiters still resolve."""
         assert self._kick is not None and self._slots is not None
         payloads = lane.payloads()
         total = lane.total
@@ -827,9 +1083,26 @@ class VerifyEngine:
             token = self._inflight_seq
             self._inflight[token] = time.monotonic()
         try:
-            results = await asyncio.to_thread(
-                self._dispatch_traced, payloads, lane.target, lane.act0
-            )
+            try:
+                results = await asyncio.to_thread(
+                    self._dispatch_traced, payloads, lane.target, lane.act0,
+                    host,
+                )
+            except HostLost as e:
+                assert host is not None and self._fleet is not None
+                self._host_down(host, str(e))
+                if (
+                    lane.requeues < len(self._hosts)
+                    and self._fleet.requeue(host.name, lane) is not None
+                ):
+                    self._wake_fleet()
+                    return
+                # no healthy peer (or the lane is orbiting dying hosts):
+                # serve it locally, skipping the device rungs entirely
+                results = await asyncio.to_thread(
+                    self._dispatch_traced, payloads, lane.target, lane.act0,
+                    None, "cpu" if self._cpu is not None else "oracle",
+                )
         except asyncio.CancelledError:
             # engine teardown mid-dispatch: waiters must not hang on a
             # future nobody will resolve
@@ -845,7 +1118,10 @@ class VerifyEngine:
         finally:
             with self._inflight_lock:
                 self._inflight.pop(token, None)
-            self._slots.release()
+            if slot:
+                self._slots.release()
+            if self._room is not None:
+                self._room.set()
             self._kick.set()  # a freed slot may unblock the scheduler
         pos = 0
         for sub, lo, hi in lane.slices:
@@ -857,20 +1133,32 @@ class VerifyEngine:
         return self._dispatch_multi([payload])
 
     def _dispatch_traced(
-        self, payloads: list, target: Optional[int], act: Optional[tuple]
+        self,
+        payloads: list,
+        target: Optional[int],
+        act: Optional[tuple],
+        host: Optional[_HostState] = None,
+        backend: Optional[str] = None,
     ) -> list[bool]:
         """Worker-thread entry: re-activate the submitting item's trace
         (contextvars do not cross ``to_thread`` from the queue loop — the
         loop's own context has no trace) so the dispatch/prepare/transfer/
         kernel/readback spans land in the item's pipeline tree."""
         with _activate_trace(act):
-            return self._dispatch_multi(payloads, target)
+            if host is None and backend is None:
+                # keep the 2-arg call shape: tests (and subclasses) spy
+                # on _dispatch_multi with (payloads, target) signatures
+                return self._dispatch_multi(payloads, target)
+            return self._dispatch_multi(
+                payloads, target, host=host, backend=backend
+            )
 
-    def _pick(self, n: int) -> str:
+    def _pick(self, n: int, host: Optional[_HostState] = None) -> str:
         """Resolve the starting backend rung for one batch.  Never blocks
         except for the forced-tpu backend, which waits (bounded) for
         warmup.  The device path additionally passes through the circuit
-        breaker: open = cpu, one canary batch while probing."""
+        breaker — the HOST's own breaker in fleet mode, so one sick
+        host degrades alone: open = cpu, one canary batch while probing."""
         backend = self.cfg.backend
         if (
             backend in ("auto", "tpu")
@@ -894,10 +1182,11 @@ class VerifyEngine:
             return "tpu"
         if backend != "auto":
             return backend
+        breaker = host.breaker if host is not None else self._breaker
         if (
             n >= self.cfg.min_tpu_batch
             and self._device_state == "ready"
-            and self._breaker.allow_device()
+            and breaker.allow_device()
         ):
             return "tpu"
         if (
@@ -914,12 +1203,19 @@ class VerifyEngine:
     OCCUPANCY_BUCKETS = _OCCUPANCY_BUCKETS
 
     def _dispatch_multi(
-        self, payloads: list, target: Optional[int] = None
+        self,
+        payloads: list,
+        target: Optional[int] = None,
+        host: Optional[_HostState] = None,
+        backend: Optional[str] = None,
     ) -> list[bool]:
         """Verify a coalesced batch of payloads (tuple lists and/or raw
         batches) on one backend; results are in payload order.  ``target``
         is the fill goal the queue lingered for (None on the synchronous
-        paths) — it sizes the occupancy observation."""
+        paths) — it sizes the occupancy observation.  ``host`` routes the
+        batch through that fleet host's breaker and sub-mesh (ISSUE 13);
+        ``backend`` forces the starting rung (the fleet's local-fallback
+        path pins "cpu" so a dark fleet never re-enters device picks)."""
         with span("verify.dispatch"):
             total = sum(len(p) for p in payloads)
             occupancy = total / target if target else None
@@ -929,15 +1225,16 @@ class VerifyEngine:
                     min(1.0, occupancy),
                     buckets=self.OCCUPANCY_BUCKETS,
                 )
-            backend = self._pick(total)
+            picked = backend or self._pick(total, host)
             t0 = time.perf_counter()
-            out, backend = self._run_ladder(backend, payloads, total)
+            out, served = self._run_ladder(picked, payloads, total, host)
             dt = time.perf_counter() - t0
             metrics.inc("verify.seconds", dt)
             events.emit(
-                "verify.dispatch", backend=backend, size=total,
+                "verify.dispatch", backend=served, size=total,
                 occupancy=round(occupancy, 4) if occupancy is not None else None,
                 seconds=round(dt, 6),
+                **({"host": host.name} if host is not None else {}),
             )
             return out
 
@@ -948,14 +1245,28 @@ class VerifyEngine:
     _LADDER = ("tpu", "cpu", "oracle")
 
     def _run_ladder(
-        self, backend: str, payloads: list, total: int
+        self,
+        backend: str,
+        payloads: list,
+        total: int,
+        host: Optional[_HostState] = None,
     ) -> tuple[list[bool], str]:
         """Run one coalesced batch starting at ``backend``, re-dispatching
         the SAME batch down the ladder on failure.  Device-rung outcomes
-        feed the circuit breaker.  Returns (results, rung that served).
-        Only a batch that fails on every rung raises — and then fails
-        just this batch's waiters; the queue loop survives (pinned by
-        tests/test_engine.py)."""
+        feed the circuit breaker (the HOST's in fleet mode).  Returns
+        (results, rung that served).  Only a batch that fails on every
+        rung raises — and then fails just this batch's waiters; the
+        queue loop survives (pinned by tests/test_engine.py).
+
+        Fleet specifics (ISSUE 13): a host partition
+        (:class:`HostLost` / injected ``mesh.dispatch:partition``)
+        escapes the ladder immediately — the host's CPU is as gone as
+        its chips, so laddering down locally would serve a dead host's
+        lane; the worker re-queues it instead.  A device LOSS on a
+        multi-chip host additionally shrinks its sub-mesh to the largest
+        still-healthy half before the ladder re-serves the batch on cpu;
+        a successful canary re-grows it."""
+        breaker = host.breaker if host is not None else self._breaker
         start = self._LADDER.index(backend) if backend in self._LADDER else 0
         rungs = [
             r
@@ -964,18 +1275,45 @@ class VerifyEngine:
         ]
         for i, rung in enumerate(rungs):
             try:
-                if chaos.on:  # injected batch/device failure (ISSUE 7)
+                if chaos.on:  # injected batch/device failure (ISSUE 7/13)
+                    if host is not None:
+                        chaos.maybe_raise(
+                            "mesh.dispatch",
+                            f"{host.name}:{rung}:chips{host.chips}",
+                        )
                     chaos.maybe_raise("engine.dispatch", rung)
-                out = self._run_backend(rung, payloads, total)
+                # 3-arg call shape kept when hostless: tests (and
+                # subclasses) wrap _run_backend with (rung, payloads,
+                # total) signatures
+                out = (
+                    self._run_backend(rung, payloads, total)
+                    if host is None
+                    else self._run_backend(rung, payloads, total, host)
+                )
+            except HostLost:
+                raise
+            except ChaosPartition as e:
+                raise HostLost(str(e)) from e
             except Exception as e:
                 err = f"{type(e).__name__}: {e}"[:300]
                 metrics.inc("verify.dispatch_errors")
                 events.emit(
                     "verify.failure", where="dispatch", backend=rung,
                     size=total, error=err,
+                    **({"host": host.name} if host is not None else {}),
                 )
                 if rung == "tpu":
-                    self._breaker.record_failure(err)
+                    breaker.record_failure(err)
+                    if host is not None:
+                        # ANY device-rung failure on a multi-chip fleet
+                        # host probes the smaller sub-mesh — real device
+                        # losses surface as assorted XLA runtime errors
+                        # that cannot be reliably classified (review
+                        # r13: keying on ChaosDeviceLoss alone left real
+                        # hardware pinned at CPU speed).  A wrong shrink
+                        # self-heals via the cooldown-paced re-grow; a
+                        # missed one parks the host on the cpu rung.
+                        self._host_shrink(host)
                 if i + 1 >= len(rungs):
                     raise  # every rung failed: the waiters learn it
                 metrics.inc("verify.failovers")
@@ -989,14 +1327,38 @@ class VerifyEngine:
                 )
                 continue
             if rung == "tpu":
-                self._breaker.record_success()
+                closed = breaker.record_success()
+                if host is not None and (
+                    closed
+                    or (
+                        # Re-grow is NOT gated on a full breaker
+                        # open/close cycle (review r13: a single device
+                        # loss shrinks from 'degraded', which closes
+                        # with closed=False — the host would run at
+                        # half capacity forever): any device success on
+                        # a shrunken host re-probes the full row once
+                        # per breaker cooldown; a repeat loss just
+                        # shrinks again.
+                        0 < host.chips < host.full_chips
+                        and time.monotonic() - host.shrunk_at
+                        >= self.cfg.breaker_cooldown
+                    )
+                ):
+                    self._host_regrow(host)
             return out, rung
         raise RuntimeError("no verify backend available")  # unreachable
 
-    def _run_backend(self, rung: str, payloads: list, total: int) -> list[bool]:
+    def _run_backend(
+        self,
+        rung: str,
+        payloads: list,
+        total: int,
+        host: Optional[_HostState] = None,
+    ) -> list[bool]:
         """Execute one ladder rung over the coalesced payloads."""
         if rung == "tpu":
-            return self._run_tpu(payloads)  # counts tpu/cpu items per chunk
+            # counts tpu/cpu items per chunk
+            return self._run_tpu(payloads, host)
         if rung == "cpu" and self._cpu is not None:
             out = self._cpu.verify_raw(
                 concat_raw([as_raw_batch(p) for p in payloads]),
@@ -1051,11 +1413,177 @@ class VerifyEngine:
                     return None
             return self._mesh_obj
 
-    def _dispatch_chunk(self, chunk, pad_to: int):
+    # -- fleet host health / sub-meshes (ISSUE 13) ---------------------------
+
+    def _host_down(self, hs: _HostState, error: str) -> None:
+        """Deactivate a lost host: trip its breaker (instant open — the
+        cooldown/canary recovery machinery applies unchanged), move its
+        queued lanes to active peers, and wake the fleet.  Idempotent —
+        concurrent lanes observing the same partition deactivate once."""
+        assert self._fleet is not None
+        if hs.lost:
+            return
+        hs.lost = True
+        hs.lost_at = time.monotonic()
+        hs.breaker.trip(error[:300])
+        moved = self._fleet.deactivate(hs.name)
+        active = len(self._fleet.active_hosts())
+        metrics.inc("mesh.host_losses")
+        metrics.set_gauge("mesh.active_hosts", float(active))
+        events.emit(
+            "mesh.host_down", host=hs.name, error=error[:200],
+            requeued_lanes=moved, active_hosts=active,
+        )
+        log.warning(
+            "[Engine] fleet host %s lost (%d active): %s",
+            hs.name, active, error,
+        )
+        self._wake_fleet()
+        if self._room is not None:
+            self._room.set()
+
+    def _host_rejoin(self, hs: _HostState) -> None:
+        """Cooldown elapsed: the host re-enters the active set with its
+        breaker open — the first lane it takes is the half-open canary
+        (success closes the breaker and re-grows the sub-mesh; a
+        still-dead host is deactivated again by the next HostLost)."""
+        assert self._fleet is not None
+        hs.lost = False
+        self._fleet.activate(hs.name)
+        active = len(self._fleet.active_hosts())
+        metrics.set_gauge("mesh.active_hosts", float(active))
+        events.emit("mesh.host_up", host=hs.name, active_hosts=active,
+                    probing=True)
+        self._wake_fleet()
+        if self._room is not None:
+            self._room.set()
+
+    def _host_shrink(self, hs: _HostState) -> None:
+        """Device loss on a multi-chip host: rebuild its sub-mesh as the
+        largest still-healthy half (8→4→2→1 chips) instead of failing
+        soft to single-chip in one step.  The failed batch itself is
+        re-served by the ladder's cpu rung; later lanes use the smaller
+        mesh."""
+        with self._mesh_lock:
+            if not hs.full_chips:
+                # the loss can precede the first sub-mesh build (chips
+                # still 0): resolve this host's row width so there is a
+                # known-good whole to halve
+                hybrid = self._fleet_hybrid_mesh()
+                if hybrid is not None:
+                    hs.full_chips = int(hybrid.devices.shape[-1])
+                    hs.chips = hs.full_chips
+            if hs.chips <= 1:
+                return
+            hs.chips //= 2
+            hs.shrunk_at = time.monotonic()
+            hs.mesh = None  # rebuilt lazily at the new width
+            hs.mesh_state = "cold"
+            chips = hs.chips
+        metrics.inc("mesh.shrinks")
+        events.emit("mesh.shrink", host=hs.name, chips=chips)
+        log.warning(
+            "[Engine] host %s sub-mesh shrunk to %d chip(s)", hs.name, chips
+        )
+
+    def _host_regrow(self, hs: _HostState) -> None:
+        """Restore the host's full device row — on a breaker canary
+        close, or (review r13) on any device success once a breaker
+        cooldown has passed since the shrink, so a loss that never
+        opened the breaker (degraded at the default threshold) cannot
+        pin the host at reduced width forever.  The chips that caused
+        the shrink get re-probed by ordinary traffic — a repeat loss
+        just shrinks again, at most once per cooldown."""
+        with self._mesh_lock:
+            if not hs.full_chips or hs.chips >= hs.full_chips:
+                return
+            hs.chips = hs.full_chips
+            hs.mesh = None
+            hs.mesh_state = "cold"
+            chips = hs.chips
+        metrics.inc("mesh.regrows")
+        events.emit("mesh.regrow", host=hs.name, chips=chips)
+        log.info(
+            "[Engine] host %s sub-mesh re-grown to %d chip(s)", hs.name, chips
+        )
+
+    def _fleet_hybrid_mesh(self):
+        """The fleet's (host, chip) hybrid mesh, carved lazily on first
+        device dispatch.  Caller holds ``_mesh_lock``.  None = hybrid
+        construction failed (hosts fall back to single-chip
+        default-device dispatch — the mesh is an upgrade, never a
+        gate)."""
+        if self._fleet_hybrid_state == "failed":
+            return None
+        if self._fleet_hybrid is None:
+            try:
+                import jax
+
+                from .multichip import make_hybrid_mesh
+
+                n = len(jax.devices())
+                if self.cfg.mesh_devices:
+                    n = min(n, self.cfg.mesh_devices)
+                hosts = self.cfg.mesh_hosts
+                chips = max(1, n // hosts)
+                self._fleet_hybrid = make_hybrid_mesh(hosts, chips)
+                self._fleet_hybrid_state = "ready"
+                events.emit(
+                    "verify.mesh", state="ready", hosts=hosts,
+                    chips_per_host=chips,
+                )
+            except Exception as e:  # mesh is an upgrade, never a gate
+                self._fleet_hybrid_state = "failed"
+                log.warning(
+                    "[Engine] hybrid fleet mesh unavailable, per-host "
+                    "single-chip dispatch: %s", e,
+                )
+                events.emit(
+                    "verify.mesh", state="failed", error=str(e)[:300]
+                )
+                return None
+        return self._fleet_hybrid
+
+    def _host_mesh(self, hs: _HostState):
+        """This host's 1-D device sub-mesh at its current healthy width
+        (its hybrid-mesh row via :func:`multichip.host_submesh`; None =
+        single-chip dispatch).  Thread-safe: dispatch worker threads
+        race on first build and after shrink/re-grow."""
+        if hs.mesh_state == "ready":
+            return hs.mesh
+        if hs.mesh_state == "failed":
+            return None
+        with self._mesh_lock:
+            if hs.mesh_state != "cold":
+                return hs.mesh if hs.mesh_state == "ready" else None
+            hybrid = self._fleet_hybrid_mesh()
+            if hybrid is None:
+                hs.mesh_state = "failed"
+                return None
+            try:
+                from .multichip import host_submesh
+
+                if not hs.full_chips:
+                    hs.full_chips = int(hybrid.devices.shape[-1])
+                    hs.chips = hs.full_chips
+                hs.mesh = host_submesh(hybrid, hs.index, chips=hs.chips)
+                hs.mesh_state = "ready"
+                return hs.mesh
+            except Exception as e:
+                hs.mesh_state = "failed"
+                events.emit(
+                    "verify.mesh", state="failed", host=hs.name,
+                    error=str(e)[:300],
+                )
+                return None
+
+    def _dispatch_chunk(self, chunk, pad_to: int,
+                        host: Optional[_HostState] = None):
         """Async device dispatch of one fixed-shape chunk: sharded over
-        the mesh when configured, single-chip otherwise.  Returns the
-        (device array, count) handle for :func:`collect_verdicts`."""
-        mesh = self._mesh()
+        the host's sub-mesh in fleet mode, the local mesh when
+        configured, single-chip otherwise.  Returns the (device array,
+        count) handle for :func:`collect_verdicts`."""
+        mesh = self._host_mesh(host) if host is not None else self._mesh()
         if mesh is not None:
             from .multichip import dispatch_raw_sharded
 
@@ -1064,7 +1592,9 @@ class VerifyEngine:
 
         return dispatch_batch_tpu_raw(chunk, pad_to=pad_to)
 
-    def _run_tpu(self, payloads: list) -> list[bool]:
+    def _run_tpu(
+        self, payloads: list, host: Optional[_HostState] = None
+    ) -> list[bool]:
         """Device dispatch in fixed-size chunks: every call is one of the
         two shapes the warmup compiled (``device_batch`` steady-state,
         ``batch_size`` for small tails) — no surprise recompiles on the hot
@@ -1095,7 +1625,8 @@ class VerifyEngine:
                 # empty device_batch step
                 pad = B if len(chunk) > self.cfg.batch_size else self.cfg.batch_size
                 pending.append(
-                    (chunk, pad, self._dispatch_chunk(chunk, pad_to=pad))
+                    (chunk, pad, self._dispatch_chunk(chunk, pad_to=pad,
+                                                      host=host))
                 )
                 metrics.inc("verify.tpu_items", len(chunk))
         out: list[bool] = []
@@ -1113,7 +1644,7 @@ class VerifyEngine:
                     raise
                 out.extend(
                     collect_verdicts(
-                        *self._dispatch_chunk(chunk, pad_to=pad)
+                        *self._dispatch_chunk(chunk, pad_to=pad, host=host)
                     )
                 )
         return out

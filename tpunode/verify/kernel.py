@@ -442,21 +442,26 @@ def prepare_batch(
     shapes stay static.  ``pad_to`` pads the batch to a fixed size to avoid
     recompilation across batches.
 
-    ``native=None`` auto-selects the C++ fast path (secp_prepare_batch in
-    native/secp256k1 — batch inversion, GLV split, digit/limb conversion;
-    bit-identical outputs, ~10x the Python rate) when the library loads;
-    ``native=False`` forces the pure-Python reference path.  The native
-    path emits the default 33x4-bit digit layout, so the 5-bit window
-    mode (ISSUE 12) always preps in Python — a documented host-prep cost
-    of the experiment, not a correctness fork.
+    ``native=None`` auto-selects the C++ fast path (secp_prepare_batch_w
+    in native/secp256k1 — batch inversion, GLV split, digit/limb
+    conversion; bit-identical outputs, ~10x the Python rate) when the
+    library loads AND supports the active window width (ISSUE 13 closed
+    the PR 12 gap: the native layer now emits the 5-bit word-straddling
+    digit layout too; only a stale pre-w5 .so falls back to Python);
+    ``native=False`` forces the pure-Python reference path.
     """
     if native is not False and _WINDOW_BITS != 4:
-        if native is True:
-            raise RuntimeError(
-                "native prep emits 4-bit digits; window_bits="
-                f"{_WINDOW_BITS} requires the Python path"
-            )
-        native = False
+        from .cpu_native import load_native_verifier
+
+        nv = load_native_verifier()
+        if nv is None or not nv.supports_window_bits(_WINDOW_BITS):
+            if native is True:
+                raise RuntimeError(
+                    "native prep does not support window_bits="
+                    f"{_WINDOW_BITS} (stale libsecp_cpu.so? run "
+                    "`make -C native`) — the Python path handles it"
+                )
+            native = False
     if native is not False:
         prep = _prepare_batch_native(items, pad_to)
         if prep is not None or native is True:
@@ -588,10 +593,8 @@ def _prepare_batch_native(
     """
     from .cpu_native import load_native_verifier
 
-    if _WINDOW_BITS != 4:  # native emits the 33x4-bit digit layout only
-        return None
     nv = load_native_verifier()
-    if nv is None:
+    if nv is None or not nv.supports_window_bits(_WINDOW_BITS):
         return None
     count = len(items)
     size = pad_to or count
@@ -627,6 +630,7 @@ def _prepare_batch_native(
         bytes(present),
         count,
         size,
+        window_bits=_WINDOW_BITS,
     )
     return PreparedBatch(
         d1a=out["d1a"],
@@ -654,11 +658,12 @@ def prepare_batch_raw(raw, pad_to: Optional[int] = None) -> PreparedBatch:
     zero-Python-int path from the native extractor straight into
     ``secp_prepare_batch`` (which redoes all range checks on the raw rows).
     Falls back to the tuple path when the native library is unavailable
-    or the active window width needs Python-side digits (ISSUE 12)."""
+    or too old to emit the active window width's digit layout (ISSUE 13:
+    a current build handles both 4- and 5-bit)."""
     from .cpu_native import load_native_verifier
 
-    nv = load_native_verifier() if _WINDOW_BITS == 4 else None
-    if nv is None:
+    nv = load_native_verifier()
+    if nv is None or not nv.supports_window_bits(_WINDOW_BITS):
         return prepare_batch(raw.to_tuples(), pad_to=pad_to, native=False)
     count = len(raw)
     size = pad_to or count
@@ -672,6 +677,7 @@ def prepare_batch_raw(raw, pad_to: Optional[int] = None) -> PreparedBatch:
         raw.present.tobytes(),
         count,
         size,
+        window_bits=_WINDOW_BITS,
     )
     return PreparedBatch(
         d1a=out["d1a"],

@@ -16,6 +16,18 @@ here and documented as such), so the multi-chip design is pure DP:
   (and the host, reading one scalar) agrees on the batch verdict count —
   the only collective the algorithm needs.
 
+Pod scale (ISSUE 13): :func:`make_hybrid_mesh` generalizes the 1-D local
+mesh to a ``(host, chip)`` grid following the t5x
+``create_hybrid_device_mesh`` exemplar (SNIPPETS.md [1]) — data-parallel
+lane sharding across hosts with the per-host axis kept local, so the
+slow DCN hop only ever carries the batch split and the one verdict-count
+psum, never table traffic.  ``sharded_verify_fn`` / ``dispatch_raw_sharded``
+accept either mesh shape (the batch axis shards over ALL mesh axes
+jointly); :func:`host_submesh` slices one host's device row back out as
+a 1-D mesh — the fleet dispatcher's per-host device rung
+(engine ``mesh_hosts``).  The CPU dryrun path (conftest's 8 virtual host
+devices) pins every spec without TPU hardware.
+
 Replaces the capability of the reference's process-parallel verification
 (one libsecp256k1 call per tx input across peer threads) at chip scale.
 """
@@ -49,7 +61,10 @@ from .kernel import (
 )
 
 __all__ = [
+    "HYBRID_AXES",
     "make_mesh",
+    "make_hybrid_mesh",
+    "host_submesh",
     "sharded_verify_fn",
     "verify_batch_sharded",
     "dispatch_raw_sharded",
@@ -62,6 +77,86 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     if n_devices is not None:
         devs = devs[:n_devices]
     return Mesh(np.array(devs), ("batch",))
+
+
+#: Axis names of a hybrid (multi-host) mesh: ``host`` is the slow
+#: (DCN/cross-host) axis, ``chip`` the fast per-host (ICI/local) axis.
+HYBRID_AXES = ("host", "chip")
+
+
+def make_hybrid_mesh(
+    hosts: Optional[int] = None, chips_per_host: Optional[int] = None
+) -> Mesh:
+    """A ``(hosts, chips_per_host)`` mesh with the per-host axis kept
+    local (the t5x ``create_hybrid_device_mesh`` shape).
+
+    On a real multi-host pod (``jax.process_count() > 1``) the grid comes
+    from ``mesh_utils.create_hybrid_device_mesh`` so the ``host`` axis
+    follows DCN connectivity and each row holds exactly one process's
+    local chips.  In a single process — the CPU dryrun, or a virtual
+    topology carved out of one host's chips — local devices are reshaped
+    into the requested grid instead (tests pin the 2x4 virtual topology
+    on the conftest 8-device CPU mesh).
+
+    Defaults: ``hosts`` = the process count (single-process: one host
+    per device), ``chips_per_host`` = the per-host device count.  Raises
+    when the requested grid needs more devices than are visible — a pod
+    that silently shrank must not masquerade as the requested topology
+    (the engine's fleet layer handles shrinking explicitly).
+    """
+    devs = jax.devices()
+    nproc = getattr(jax, "process_count", lambda: 1)()
+    if nproc > 1:  # pragma: no cover - real pod only (no CI multi-host)
+        hosts = nproc if hosts is None else hosts
+        if chips_per_host is None:
+            chips_per_host = max(1, len(devs) // nproc)
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            (1, chips_per_host), (hosts, 1), devices=devs
+        )
+        return Mesh(grid, HYBRID_AXES)
+    n = len(devs)
+    if hosts is None and chips_per_host is None:
+        hosts, chips_per_host = n, 1
+    elif hosts is None:
+        hosts = max(1, n // chips_per_host)
+    elif chips_per_host is None:
+        chips_per_host = max(1, n // hosts)
+    need = hosts * chips_per_host
+    if need > n:
+        raise ValueError(
+            f"hybrid mesh {hosts}x{chips_per_host} needs {need} devices, "
+            f"only {n} visible"
+        )
+    grid = np.array(devs[:need]).reshape(hosts, chips_per_host)
+    return Mesh(grid, HYBRID_AXES)
+
+
+def host_submesh(
+    mesh: Mesh, host_index: int, chips: Optional[int] = None
+) -> Mesh:
+    """One host's device row of a hybrid mesh as a 1-D local mesh — the
+    fleet dispatcher's per-host device rung dispatches whole lanes over
+    this (zero cross-host traffic per lane).  ``chips`` keeps only the
+    leading that-many devices of the row (the engine's chip-by-chip
+    degradation rebuilds here at the largest still-healthy width).  A
+    1-D mesh is its own (only) full-width row."""
+    if mesh.devices.ndim == 1 and chips is None:
+        return mesh
+    row = mesh.devices if mesh.devices.ndim == 1 else mesh.devices[host_index]
+    devs = list(row.flat)
+    if chips is not None:
+        devs = devs[:chips]
+    return Mesh(np.array(devs), ("batch",))
+
+
+def _batch_axes(mesh: Mesh):
+    """The axis-name spec entry sharding the batch dimension: the single
+    name on a 1-D mesh, the name tuple on a hybrid mesh (the batch axis
+    shards over host AND chip jointly — pure DP, ISSUE 13)."""
+    names = tuple(mesh.axis_names)
+    return names if len(names) > 1 else names[0]
 
 
 _FN_CACHE: dict = {}
@@ -117,9 +212,12 @@ def sharded_verify_fn(
     cached = _FN_CACHE.get(key)
     if cached is not None:
         return cached
-    # limb-major layout: batch is the trailing axis of the 2-D arrays
-    spec_2d = P(None, "batch")
-    spec_1d = P("batch")
+    # limb-major layout: batch is the trailing axis of the 2-D arrays.
+    # On a hybrid mesh the batch dimension shards over host AND chip
+    # jointly (axis-name tuple) — same program, wider denominator.
+    axes = _batch_axes(mesh)
+    spec_2d = P(None, axes)
+    spec_1d = P(axes)
     in_specs = tuple(spec_2d if is2d else spec_1d for is2d in ARG_IS_2D)
 
     if use_pallas:
@@ -142,7 +240,7 @@ def sharded_verify_fn(
 
     def step(*args):
         ok = _core(*args)
-        total = lax.psum(jnp.sum(ok.astype(jnp.int32)), "batch")
+        total = lax.psum(jnp.sum(ok.astype(jnp.int32)), axes)
         return ok, total
 
     # check_vma off: verify_core's scan carry starts from a broadcast
@@ -205,8 +303,9 @@ def dispatch_raw_sharded(
     size = (size + quantum - 1) // quantum * quantum
     with span("verify.prepare"):
         prep = prepare_batch_raw(raw, pad_to=size)
-    shard_2d = NamedSharding(mesh, P(None, "batch"))
-    shard_1d = NamedSharding(mesh, P("batch"))
+    axes = _batch_axes(mesh)
+    shard_2d = NamedSharding(mesh, P(None, axes))
+    shard_1d = NamedSharding(mesh, P(axes))
     with span("verify.transfer"):
         args = [
             jax.device_put(np.asarray(a), shard_2d if is2d else shard_1d)
@@ -237,8 +336,9 @@ def verify_batch_sharded(
     size = (size + quantum - 1) // quantum * quantum
     prep = prepare_batch(items, pad_to=size)
 
-    shard_2d = NamedSharding(mesh, P(None, "batch"))
-    shard_1d = NamedSharding(mesh, P("batch"))
+    axes = _batch_axes(mesh)
+    shard_2d = NamedSharding(mesh, P(None, axes))
+    shard_1d = NamedSharding(mesh, P(axes))
     args = [
         jax.device_put(np.asarray(a), shard_2d if is2d else shard_1d)
         for a, is2d in zip(prep.device_args, ARG_IS_2D)

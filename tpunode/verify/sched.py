@@ -28,10 +28,25 @@ This module owns the queue instead:
 The packer is plain data + arithmetic on the event loop; the engine's
 pipeline (``VerifyConfig.pipeline_depth``) pulls lanes from it.
 
+Pod scale (ISSUE 13): :class:`FleetDispatcher` promotes the packer into
+a cross-host work-stealing dispatcher — one lane queue per mesh host,
+fed from the shared packer in global priority order (block > mempool >
+ibd > bulk is preserved because lanes are CUT in priority order and
+every per-host queue is FIFO), with idle hosts stealing whole packed
+lanes from the deepest peer queue.  Steals move the OLDEST lane (queue
+head): verification lanes have no cache locality worth protecting, so
+unlike classic tail-stealing the head steal strictly improves the
+highest-priority lane's latency.  Lane granularity keeps verdict
+conservation intact — a stolen or re-queued lane still resolves its
+carried submissions exactly once, because a lane lives in exactly one
+queue (or exactly one host's in-flight set) at a time and
+:class:`Submission` bookkeeping is slice-indexed, not host-indexed.
+
 Telemetry: ``sched.queue_depth{priority=}`` gauges, the
-``sched.pack_efficiency`` histogram (lane occupancy at dispatch), and
-``sched.lanes`` / ``sched.packed_submissions`` counters
-(OBSERVABILITY.md).
+``sched.pack_efficiency`` histogram (lane occupancy at dispatch),
+``sched.lanes`` / ``sched.packed_submissions`` counters, and the fleet
+surface — ``sched.host_depth{host=}`` gauges, ``sched.steals`` /
+``sched.requeued`` counters, ``sched.steal`` events (OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -41,6 +56,7 @@ import collections
 import time
 from typing import Optional, Sequence
 
+from ..events import events
 from ..metrics import metrics
 
 __all__ = [
@@ -49,6 +65,7 @@ __all__ = [
     "Submission",
     "PackedLane",
     "LanePacker",
+    "FleetDispatcher",
 ]
 
 # Dispatch order under saturation: live block ingest outranks mempool
@@ -129,9 +146,12 @@ class Submission:
 
 class PackedLane:
     """One dispatchable lane: ``(submission, lo, hi)`` slices summing to
-    ``total`` items (≤ the pack target)."""
+    ``total`` items (≤ the pack target).  ``requeues`` counts fleet
+    re-queues after a host loss (ISSUE 13) — the engine bounds it so a
+    lane bouncing between dying hosts eventually falls through the
+    local ladder instead of orbiting forever."""
 
-    __slots__ = ("slices", "total", "target")
+    __slots__ = ("slices", "total", "target", "requeues")
 
     def __init__(
         self, slices: list[tuple[Submission, int, int]], target: int
@@ -139,6 +159,7 @@ class PackedLane:
         self.slices = slices
         self.total = sum(hi - lo for _, lo, hi in slices)
         self.target = target
+        self.requeues = 0
 
     @property
     def occupancy(self) -> float:
@@ -275,4 +296,225 @@ class LanePacker:
                 "sched.queue_depth", 0.0, labels={"priority": p}
             )
         self._pending_items = 0
+        return out
+
+
+class FleetDispatcher:
+    """Cross-host work-stealing lane dispatcher (ISSUE 13).
+
+    One FIFO lane queue per mesh host, fed from a shared
+    :class:`LanePacker` in global priority order; idle hosts steal the
+    OLDEST lane from the deepest peer queue.  Lane granularity preserves
+    verdict conservation: a lane lives in exactly one queue at a time,
+    so a steal or a host-loss re-queue moves the whole resolution
+    responsibility with it — its carried submissions still resolve
+    exactly once.
+
+    Host health is the ENGINE's business (per-host circuit breakers,
+    canary re-probes); this class only tracks the active set so
+    assignment and re-queueing skip lost hosts.  Not thread-safe by
+    design: every method runs on the event loop, like the packer.
+    """
+
+    def __init__(
+        self,
+        hosts,
+        packer: Optional[LanePacker] = None,
+        max_queue: int = 2,
+    ):
+        hosts = list(hosts)
+        if not hosts:
+            raise ValueError("FleetDispatcher needs at least one host")
+        if len(set(hosts)) != len(hosts):
+            raise ValueError(f"duplicate host names: {hosts}")
+        self.hosts = hosts
+        self.packer = packer if packer is not None else LanePacker()
+        self.max_queue = max(1, max_queue)
+        self._queues: dict = {h: collections.deque() for h in hosts}
+        self._active: dict = {h: True for h in hosts}
+        self.steals = 0
+        self.requeued = 0
+
+    # -- intake ---------------------------------------------------------------
+
+    def push(self, sub: Submission) -> None:
+        self.packer.push(sub)
+
+    # -- introspection --------------------------------------------------------
+
+    def is_active(self, host: str) -> bool:
+        return self._active[host]
+
+    def active_hosts(self) -> list:
+        return [h for h in self.hosts if self._active[h]]
+
+    def host_depth(self, host: str) -> int:
+        """Queued ITEMS on one host (the steal victim metric)."""
+        return sum(lane.total for lane in self._queues[host])
+
+    def host_lanes(self, host: str) -> int:
+        return len(self._queues[host])
+
+    def host_depths(self) -> dict:
+        return {h: self.host_depth(h) for h in self.hosts}
+
+    def queued_lanes(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def pending(self) -> int:
+        """Unclaimed packer items + items already cut into host lanes."""
+        return self.packer.pending() + sum(
+            lane.total for q in self._queues.values() for lane in q
+        )
+
+    def has_room(self) -> bool:
+        """May the scheduler cut + assign another lane?  (Backpressure:
+        keeping assignment shallow lets late high-priority submissions
+        pack ahead of work that hasn't been cut into lanes yet.)"""
+        return any(
+            self._active[h] and len(self._queues[h]) < self.max_queue
+            for h in self.hosts
+        )
+
+    def _gauge(self, host: str) -> None:
+        metrics.set_gauge(
+            "sched.host_depth",
+            float(self.host_depth(host)),
+            labels={"host": host},
+        )
+
+    # -- assignment / consumption ---------------------------------------------
+
+    def _shallowest(
+        self, exclude: Optional[str] = None, respect_cap: bool = False
+    ) -> Optional[str]:
+        """The shallowest-by-items ACTIVE host (ties -> first in host
+        order), optionally excluding one host and/or skipping queues at
+        ``max_queue`` — the one selection policy behind assignment AND
+        re-queueing (review r13: two hand-rolled copies would fork)."""
+        best = None
+        for h in self.hosts:
+            if h == exclude or not self._active[h]:
+                continue
+            if respect_cap and len(self._queues[h]) >= self.max_queue:
+                continue
+            if best is None or self.host_depth(h) < self.host_depth(best):
+                best = h
+        return best
+
+    def assign(self, lane: PackedLane) -> Optional[str]:
+        """Queue ``lane`` on the shallowest active host with room; None
+        when every active queue is full (caller waits) or no host is
+        active (caller must dispatch locally — traffic never stops)."""
+        best = self._shallowest(respect_cap=True)
+        if best is None:
+            return None
+        self._queues[best].append(lane)
+        self._gauge(best)
+        return best
+
+    def take(self, host: str, steal: bool = True) -> Optional[PackedLane]:
+        """Next lane for ``host``: its own queue head, else (``steal``)
+        the OLDEST lane of the deepest peer queue.  The deque pop is the
+        atomic hand-off — once taken, no other host can reach this lane."""
+        q = self._queues[host]
+        if q:
+            lane = q.popleft()
+            self._gauge(host)
+            return lane
+        if not steal:
+            return None
+        return self._steal_for(host)
+
+    def _steal_for(self, thief: str) -> Optional[PackedLane]:
+        # Deepest queue by ITEMS, scanned over every host (a lost host's
+        # orphaned lanes are legitimate loot too).  Head steal: lanes
+        # were cut in global priority order, so the victim's oldest lane
+        # is the whole fleet's most urgent queued work.
+        victim = None
+        depth = 0
+        for h in self.hosts:
+            if h == thief or not self._queues[h]:
+                continue
+            d = self.host_depth(h)
+            if d > depth:
+                victim, depth = h, d
+        if victim is None:
+            return None
+        lane = self._queues[victim].popleft()
+        self.steals += 1
+        metrics.inc("sched.steals")
+        events.emit(
+            "sched.steal", thief=thief, victim=victim, items=lane.total,
+        )
+        self._gauge(victim)
+        return lane
+
+    # -- degradation (ISSUE 13: one sick host degrades alone) -----------------
+
+    def requeue(self, host: str, lane: PackedLane) -> Optional[str]:
+        """Give a lost host's IN-FLIGHT lane to a peer (FRONT of the
+        shallowest active queue — it is older than anything queued).
+        Returns the host it landed on, or None WITHOUT queueing (and
+        without counting — review r13: a refused requeue placed
+        nothing) when no peer is active: ownership stays with the
+        caller, which must resolve the lane itself (queueing it here
+        too would leave two live copies — the double-resolution hazard
+        the ISSUE 13 requeue audit exists to rule out).  Only THESE
+        in-flight bounces consume ``lane.requeues`` (the engine's orbit
+        bound); queued-lane redistribution at deactivation does not."""
+        best = self._shallowest(exclude=host)
+        if best is None:
+            return None
+        lane.requeues += 1
+        self.requeued += 1
+        metrics.inc("sched.requeued")
+        self._queues[best].appendleft(lane)
+        self._gauge(best)
+        return best
+
+    def deactivate(self, host: str) -> int:
+        """Mark ``host`` lost and redistribute its queued lanes to the
+        active peers (order preserved, each to the FRONT of the
+        shallowest peer — they are older than anything queued; with no
+        active peer they stay put for steals / the engine's local
+        fallback).  A redistribution is NOT an in-flight bounce: it
+        counts in ``sched.requeued`` telemetry but never consumes
+        ``lane.requeues`` — a lane that merely sat queued on dying
+        hosts must arrive at its first real dispatch with its full
+        orbit budget (review r13).  Returns how many lanes moved.
+        Idempotent."""
+        if not self._active[host]:
+            return 0
+        self._active[host] = False
+        moved = 0
+        lanes = list(self._queues[host])
+        self._queues[host].clear()
+        self._gauge(host)
+        for lane in reversed(lanes):
+            target = self._shallowest(exclude=host)
+            if target is None:
+                self._queues[host].appendleft(lane)
+                continue
+            self._queues[target].appendleft(lane)
+            self._gauge(target)
+            self.requeued += 1
+            metrics.inc("sched.requeued")
+            moved += 1
+        self._gauge(host)
+        return moved
+
+    def activate(self, host: str) -> None:
+        self._active[host] = True
+
+    # -- shutdown -------------------------------------------------------------
+
+    def drain_lanes(self) -> list[PackedLane]:
+        """Remove and return every queued lane (engine teardown: the
+        caller cancels their carried futures)."""
+        out: list[PackedLane] = []
+        for h, q in self._queues.items():
+            out.extend(q)
+            q.clear()
+            self._gauge(h)
         return out
