@@ -125,6 +125,10 @@ T_IBD = float(os.environ.get("TPUNODE_BENCH_IBD_TIMEOUT", 420))
 # Pod-scale fleet-dispatcher scaling (ISSUE 13): 1/2/4/8-way sharding on
 # the cpu-native proxy plus the campaign bit-identity pass.
 T_MESH = float(os.environ.get("TPUNODE_BENCH_MESH_TIMEOUT", 300))
+# Observability overhead (ISSUE 16): timeline-sampler tick cost and
+# flight-recorder bundle build, measured over a synthetic registry.
+# jax is never imported (timeseries/blackbox are stdlib-only).
+T_OBS = float(os.environ.get("TPUNODE_BENCH_OBS_TIMEOUT", 90))
 # Total ceiling: probe (<=120s) + ladder (<=600s) + fallback (<=210s)
 # + mempool (<=150s) keeps the worst case ~18 min; r03's artifact
 # demonstrated the driver tolerating 810s, and the in-round watcher
@@ -311,8 +315,23 @@ def _worker_bench() -> None:
         from tpunode.tracectx import start_trace, tracer
         from tpunode.verify.engine import VerifyEngine
 
+        # Device-profile capture (ISSUE 16): TPUNODE_PROFILE keeps its
+        # exact legacy meaning (capture into that directory); with
+        # TPUNODE_PROFILE_DIR set instead, each run captures into its own
+        # labeled subdirectory and the path rides along in the JSON so
+        # the watcher can bank profiles alongside verdicts.
+        prof_dir = os.environ.get("TPUNODE_PROFILE")
+        profile_path = None
+        if not prof_dir:
+            prof_base = os.environ.get("TPUNODE_PROFILE_DIR")
+            if prof_base:
+                profile_path = os.path.join(
+                    prof_base,
+                    f"bench-{kernel_name}-b{batch}-{int(time.time())}",
+                )
+                prof_dir = profile_path
         times = []
-        with profile_to(os.environ.get("TPUNODE_PROFILE")):
+        with profile_to(prof_dir):
             for _ in range(iters):
                 # each timed step is one causal trace: the slowest land in
                 # the artifact's slowest_traces section, so a straggler
@@ -329,12 +348,15 @@ def _worker_bench() -> None:
                     1.0,  # the bench pads with real (tiled) items
                     buckets=VerifyEngine.OCCUPANCY_BUCKETS,
                 )
+        if profile_path is not None and not os.path.isdir(profile_path):
+            profile_path = None  # profiler unavailable: nothing captured
         dt = statistics.median(times)
         print(
             json.dumps(
                 {
                     "ok": True,
                     "rate": batch / dt,
+                    "profile_path": profile_path,
                     "device": device_kind(),
                     "kernel": kernel_name,
                     "point_form": _point_form(),
@@ -1941,6 +1963,85 @@ def _mempool_section() -> dict:
     return res
 
 
+def _worker_observability() -> None:
+    """Observability-overhead micro-bench (ISSUE 16).
+
+    Populates a realistic registry (~100 unlabeled series, an 8-host
+    fleet's labeled gauges, a busy histogram), then measures: the
+    timeline sampler's per-tick cost (median), the off-switch tick cost
+    (must be ~an attribute read), and one flight-recorder bundle build.
+    Never imports jax — timeseries/blackbox are stdlib-only by contract.
+    """
+    try:
+        import statistics as _stats
+
+        from tpunode.blackbox import FlightRecorder, FlightRecorderConfig
+        from tpunode.metrics import metrics
+        from tpunode.timeseries import Timeline
+
+        for i in range(100):
+            metrics.inc("bench.obs_series_%d" % i, i + 1)
+        for h in range(8):
+            host = {"host": "h%d" % h}
+            metrics.set_gauge("sched.host_depth", float(h), labels=host)
+            metrics.set_gauge("verify.breaker_state", 0.0, labels=host)
+            metrics.set_gauge("mesh.host_chips", 4.0, labels=host)
+        for i in range(64):
+            metrics.observe("verify.occupancy", (i % 20) / 20.0)
+
+        def tick_median(tl: "Timeline", n: int = 300) -> float:
+            xs = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                tl.tick()
+                xs.append(time.perf_counter() - t0)
+            return _stats.median(xs)
+
+        timeline = Timeline(interval=1.0, disabled=False)
+        timeline.tick()  # warm the rings (first tick allocates deques)
+        tick_s = tick_median(timeline)
+        off = Timeline(interval=1.0, disabled=True)
+        off_s = tick_median(off)
+
+        recorder = FlightRecorder(
+            FlightRecorderConfig(min_interval=0.0), timeline=timeline
+        )
+        t0 = time.perf_counter()
+        bundle = recorder.record("bench.observability", force=True)
+        build_ms = (time.perf_counter() - t0) * 1e3
+        print(
+            json.dumps(
+                {
+                    "ok": True,
+                    "sampler": {
+                        "tick_us_p50": round(tick_s * 1e6, 2),
+                        "disabled_tick_us_p50": round(off_s * 1e6, 4),
+                        "series": timeline.stats()["series"],
+                    },
+                    "blackbox": {
+                        "build_ms": round(build_ms, 3),
+                        "bundle_keys": sorted(bundle or {}),
+                    },
+                }
+            )
+        )
+    except Exception as e:  # noqa: BLE001 — worker reports, parent decides
+        print(
+            json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]})
+        )
+
+
+def _observability_section() -> dict:
+    """The BENCH JSON ``observability`` section (ISSUE 16): sampler tick
+    cost (enabled + off-switch) and flight-recorder bundle build time
+    from a bounded, jax-free worker subprocess.  Always returns a dict —
+    a failed/timed-out scenario is labeled, never masked."""
+    res = _run_worker("--observability", T_OBS, {"JAX_PLATFORMS": "cpu"})
+    if not res.get("ok") and "error" in res:
+        return {"ok": False, "error": str(res["error"])[:300]}
+    return res
+
+
 def _run_worker(
     mode: str, timeout: float, env_extra: dict | None = None
 ) -> dict:
@@ -2261,7 +2362,8 @@ def _main_locked() -> None:
     if watcher_run is not None:
         out["measured_at"] = watcher_run["ts"]
         out["measured_age_s"] = int(time.time() - watcher_run["unix"])
-    for k in ("kernel", "batch", "step_ms", "compile_s", "init_s", "error"):
+    for k in ("kernel", "batch", "step_ms", "compile_s", "init_s", "error",
+              "profile_path"):
         if k in res and res[k] is not None:
             out[k] = res[k]
     if probe.get("init_s") is not None:
@@ -2329,6 +2431,11 @@ def _main_locked() -> None:
     # Named "kernel_ab" because the top-level "kernel" key already names
     # the program (pallas/xla) that produced the headline.
     out["kernel_ab"] = _kernel_section()
+    # Observability-overhead section (ISSUE 16): timeline sampler tick
+    # cost (on + off-switch) and flight-recorder bundle build cost, so
+    # the retrospective stack's overhead is a tracked number —
+    # failure-labeled like the others.
+    out["observability"] = _observability_section()
     print(json.dumps(out))
     # A fatal anywhere is a kernel correctness failure (device/oracle or
     # affine/oracle verdict mismatch) and must not look like success —
@@ -2364,5 +2471,7 @@ if __name__ == "__main__":
         _worker_mesh_device()
     elif "--mesh" in sys.argv:
         _worker_mesh()
+    elif "--observability" in sys.argv:
+        _worker_observability()
     else:
         main()

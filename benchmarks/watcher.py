@@ -18,7 +18,14 @@ sampling into a round-long process instead of a round-end event:
   uptime window so the freshest entry stays recent, and log every
   probe so a tunnel that never comes up leaves evidence (the probe
   log, e.g. ``benchmarks/watcher_r5.log`` — parsed into bench.py's
-  ``watcher_evidence`` artifact field).
+  ``watcher_evidence`` artifact field);
+* fold each rotated-away round's banked samples into per-kind median
+  rows in ``benchmarks/bench_history.jsonl`` and flag any fresh sample
+  falling beyond the last rounds' spread as a ``kind="regression"`` row
+  + ``bench.regression`` event (ISSUE 16) — a silent perf cliff
+  surfaces in the round it happens.  With ``TPUNODE_PROFILE_DIR`` set,
+  workers capture a device profile per banked run and the verdict rows
+  carry its path (``profile_path``).
 
 Single-core box discipline: when the tunnel is down the watcher is a
 sleeping process plus one network-blocked probe subprocess — no CPU
@@ -38,6 +45,7 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -51,6 +59,19 @@ from benchmarks.common import (  # noqa: E402
 
 RUNS_PATH = os.path.join(REPO, "benchmarks", "device_runs.jsonl")
 PREV_RUNS_PATH = RUNS_PATH + ".prev"
+
+# Cross-round BENCH history (ISSUE 16): at each round rotation the
+# rotated-away round's banked samples are folded into ONE per-kind
+# median row here, and every fresh in-round sample is compared against
+# the last HISTORY_ROUNDS rounds' medians — a sample falling beyond the
+# historical spread is flagged as a kind="regression" row plus a
+# bench.regression event, so a silent perf cliff (kernel change, tunnel
+# degradation) surfaces in the round summary instead of months later.
+HISTORY_PATH = os.path.join(REPO, "benchmarks", "bench_history.jsonl")
+HISTORY_ROUNDS = 5
+# Below (median - max(spread, MIN_BAND*median)) flags: the band floor
+# keeps a tightly-clustered history (spread ~0) from flagging noise.
+REGRESSION_MIN_BAND = 0.05
 
 # Uptime windows can be ~9 min (observed r5): a 240s gap between probes
 # could eat half a window, so probe every 150s (each probe is mostly a
@@ -137,6 +158,110 @@ def _log(msg: str) -> None:
           flush=True)
 
 
+def _history_key(kind: str, payload: dict) -> str:
+    """Series key for cross-round comparison.  Mesh rows bank several
+    way-counts per round with very different totals (8-way vs 2-way);
+    mixing them would inflate the spread until nothing ever flags, so
+    the way-count is part of the key."""
+    ways = payload.get("mesh_ways")
+    return f"{kind}@{ways}w" if ways else kind
+
+
+def _fold_history(rows: list[dict]) -> None:
+    """Append one per-kind median row for a rotated-away round's banked
+    samples.  Best-effort: a history write failure must never block the
+    rotation (the runs file is the artifact of record)."""
+    by_key: dict[str, list[float]] = {}
+    for row in rows:
+        v = row.get("value")
+        kind = row.get("kind")
+        if kind and kind != "regression" and isinstance(v, (int, float)):
+            by_key.setdefault(_history_key(kind, row), []).append(float(v))
+    if not by_key:
+        return
+    hist = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "unix": int(time.time()),
+            "medians": {k: round(statistics.median(vs), 3)
+                        for k, vs in sorted(by_key.items())}}
+    try:
+        with open(HISTORY_PATH, "a", encoding="utf-8") as f:
+            f.write(json.dumps(hist) + "\n")
+        _log(f"folded round history: {len(by_key)} series "
+             f"-> {HISTORY_PATH}")
+    except OSError:
+        pass
+
+
+def _load_history(n: int = HISTORY_ROUNDS) -> list[dict]:
+    """Last ``n`` per-round median rows (oldest first); [] when absent."""
+    try:
+        with open(HISTORY_PATH, encoding="utf-8") as f:
+            rows = []
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(row, dict) and isinstance(
+                    row.get("medians"), dict
+                ):
+                    rows.append(row)
+    except OSError:
+        return []
+    return rows[-n:]
+
+
+def detect_regression(
+    key: str, value: float, history: list[dict]
+) -> dict | None:
+    """Flag ``value`` when it falls below the historical band for
+    ``key``: past rounds' medians' median, minus the larger of their
+    spread and a 5% floor.  Needs >=3 rounds of history (one or two
+    medians give no spread estimate worth alarming on).  Returns the
+    regression payload, or None when the sample is in-band."""
+    meds = [float(h["medians"][key]) for h in history
+            if isinstance(h["medians"].get(key), (int, float))]
+    if len(meds) < 3:
+        return None
+    center = statistics.median(meds)
+    if center <= 0:
+        return None
+    spread = max(meds) - min(meds)
+    floor = center - max(spread, REGRESSION_MIN_BAND * center)
+    if value >= floor:
+        return None
+    return {
+        "key": key, "value": round(value, 3),
+        "baseline": round(center, 3), "spread": round(spread, 3),
+        "floor": round(floor, 3), "rounds": len(meds),
+        "drop_pct": round(100.0 * (center - value) / center, 1),
+    }
+
+
+def _check_regression(kind: str, payload: dict) -> None:
+    """Compare a freshly-banked sample against the cross-round history;
+    called from _record for every row EXCEPT regression rows themselves
+    (no self-feedback).  A flag is both a kind="regression" row (lands
+    in the round summary with the rest of the runs file) and a
+    bench.regression event (the in-process observability channel)."""
+    v = payload.get("value")
+    if not isinstance(v, (int, float)):
+        return
+    reg = detect_regression(
+        _history_key(kind, payload), float(v), _load_history()
+    )
+    if reg is None:
+        return
+    _log(f"REGRESSION {reg['key']}: {reg['value']} vs baseline "
+         f"{reg['baseline']} (-{reg['drop_pct']}%, floor {reg['floor']})")
+    _record("regression", reg)
+    try:
+        from tpunode.events import events  # stdlib-only import, kept lazy
+        events.emit("bench.regression", **reg)
+    except Exception:
+        pass  # the runs-file row is the artifact of record
+
+
 def _record(kind: str, payload: dict) -> None:
     row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "unix": int(time.time()), "kind": kind}
@@ -145,6 +270,8 @@ def _record(kind: str, payload: dict) -> None:
         f.write(json.dumps(row) + "\n")
     _log(f"recorded {kind}: value={payload.get('value')} "
          f"device={payload.get('device')}")
+    if kind != "regression":
+        _check_regression(kind, payload)
 
 
 def _run_json(argv: list[str], timeout: float,
@@ -259,6 +386,7 @@ def run_headline(
                 "batch": res.get("batch"), "step_ms": res.get("step_ms"),
                 "compile_s": res.get("compile_s"),
                 "init_s": res.get("init_s"),
+                "profile_path": res.get("profile_path"),
             })
             return res, "banked", pallas_failed
         err = str(res.get("error", ""))
@@ -336,6 +464,7 @@ def run_affine() -> bool:
                 "batch": res.get("batch"), "step_ms": res.get("step_ms"),
                 "compile_s": res.get("compile_s"),
                 "init_s": res.get("init_s"),
+                "profile_path": res.get("profile_path"),
             })
             return True
         err = str(res.get("error", ""))
@@ -392,6 +521,7 @@ def run_lazy() -> bool:
                 "batch": res.get("batch"), "step_ms": res.get("step_ms"),
                 "compile_s": res.get("compile_s"),
                 "init_s": res.get("init_s"),
+                "profile_path": res.get("profile_path"),
             })
             return True
         err = str(res.get("error", ""))
@@ -453,6 +583,7 @@ def run_mesh() -> bool:
                     "batch": res.get("batch"), "step_ms": res.get("step_ms"),
                     "compile_s": res.get("compile_s"),
                     "init_s": res.get("init_s"),
+                    "profile_path": res.get("profile_path"),
                 })
                 banked = True
                 break
@@ -611,6 +742,7 @@ def _rotate_runs_file() -> list[dict]:
     keep = os.environ.get("TPUNODE_WATCHER_KEEP_RUNS", "") == "1"
     fatals: list[dict] = []
     kept_rows: list[str] = []   # in-window rows, verbatim
+    parsed: list[dict] = []     # same rows, decoded (history folding)
     dropped = 0
     now = time.time()
     try:
@@ -628,6 +760,7 @@ def _rotate_runs_file() -> list[dict]:
                     dropped += 1
                     continue
                 kept_rows.append(line)
+                parsed.append(row)
                 if row.get("kind") == "fatal":
                     fatals.append(row)
     except OSError:
@@ -652,6 +785,10 @@ def _rotate_runs_file() -> list[dict]:
              + (f", {len(fatals)} fatal row(s) still poison sampling)"
                 if fatals else ")"))
         return fatals
+    # The rotated-away round is over: fold its banked samples into the
+    # cross-round history BEFORE they leave the runs file, so the next
+    # round's fresh samples have a baseline to regress against.
+    _fold_history(parsed)
     os.replace(RUNS_PATH, PREV_RUNS_PATH)
     _log(f"rotated stale {RUNS_PATH} -> {PREV_RUNS_PATH}")
     if fatals:

@@ -128,6 +128,14 @@ from tpunode.events import events
 def record():
     events.emit("stats")
 """,
+    # schema-valid, registered layer, but absent from OBSERVABILITY.md's
+    # inventory (ISSUE 16 doc-drift gate)
+    "doc-drift": """\
+from tpunode.metrics import metrics
+
+def record():
+    metrics.inc("node.fixture_undocumented")
+""",
 }
 
 
@@ -412,6 +420,54 @@ def test_inc_batch_layer_must_be_registered():
     )
     (f,) = analyze_source(src)
     assert f.rule == "metric-name" and "unregistered layer" in f.message
+
+
+def test_doc_drift_documented_names_are_clean():
+    """Names with an OBSERVABILITY.md inventory row pass (metric, span
+    and event forms alike)."""
+    src = (
+        "from tpunode.metrics import metrics\n"
+        "from tpunode import trace\n"
+        "def f(log):\n"
+        "    metrics.inc('mempool.dedup_hits')\n"
+        "    log.emit('node.stats')\n"
+        "    with trace.span('verify.dispatch'):\n"
+        "        pass\n"
+    )
+    assert analyze_source(src) == []
+
+
+def test_doc_drift_covers_event_and_inc_batch_forms():
+    """ISSUE 16: the rule lints the same call sites as
+    metric-name/event-name — an undocumented (but schema-valid) event
+    type and inc_batch tuple both flag as doc-drift."""
+    src_event = "def f(log):\n    log.emit('node.fixture_undocumented')\n"
+    src_batch = (
+        "from tpunode.metrics import metrics\n"
+        "def f():\n"
+        "    metrics.inc_batch((('node.fixture_undocumented', 1.0, None),))\n"
+    )
+    for src in (src_event, src_batch):
+        (f,) = analyze_source(src)
+        assert f.rule == "doc-drift" and "OBSERVABILITY.md" in f.message
+
+
+def test_doc_drift_never_double_reports_schema_violations():
+    """A malformed or unregistered-layer name is metric-name/event-name's
+    finding alone — one mistake, one finding."""
+    src = (
+        "from tpunode.metrics import metrics\n"
+        "def f():\n    metrics.inc('mempol.dedup_hits')\n"
+    )
+    (f,) = analyze_source(src)
+    assert f.rule == "metric-name"
+
+
+def test_doc_drift_new_layers_registered():
+    """ISSUE 16 registers the two new subsystems' layers."""
+    from tpunode.analysis.rules import KNOWN_LAYERS
+
+    assert "tsdb" in KNOWN_LAYERS and "blackbox" in KNOWN_LAYERS
 
 
 def test_syntax_error_is_a_finding_not_a_crash():

@@ -133,6 +133,18 @@ _MESH_OK = {
                  "single_chip_identical": True, "clean": True},
 }
 
+# Canned healthy observability-overhead result (ISSUE 16; the real
+# subprocess path is covered by test_observability_worker_subprocess).
+_OBS_OK = {
+    "ok": True,
+    "sampler": {"tick_us_p50": 315.4, "disabled_tick_us_p50": 0.2,
+                "series": 128},
+    "blackbox": {"build_ms": 7.7,
+                 "bundle_keys": ["chaos", "event_counts", "events",
+                                 "fleet_history", "path", "reason",
+                                 "timeline", "traces", "trigger", "ts"]},
+}
+
 # Canned healthy chaos-resilience result (the real subprocess path is
 # covered by test_chaos_worker_subprocess).
 _CHAOS_OK = {
@@ -183,6 +195,9 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         if mode == "--mesh":
             # likewise for the ride-along pod-mesh section (ISSUE 13)
             return dict(_MESH_OK)
+        if mode == "--observability":
+            # likewise for the ride-along observability section (ISSUE 16)
+            return dict(_OBS_OK)
         raise AssertionError(f"unexpected worker call: {mode} {env_extra}")
 
     monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
@@ -226,7 +241,7 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         c for c in calls
         if c[0] not in (
             "--mempool", "--chaos", "--kernel-ab", "--recovery",
-            "--pipeline", "--ibd", "--mesh",
+            "--pipeline", "--ibd", "--mesh", "--observability",
         )
     ]
     return line, calls, rc
@@ -815,6 +830,124 @@ def test_mesh_section_fatal_mismatch_fails_the_run(monkeypatch):
 @pytest.mark.slow  # four fleet runs + the campaign pass in a subprocess
 # (the tier-1 budget is seed-saturated on this box; the scripted pins
 # above cover the section contract)
+def test_profile_path_passthrough(monkeypatch):
+    """ISSUE 16: a worker that captured a device profile (armed via
+    TPUNODE_PROFILE_DIR) reports its path, and the artifact line carries
+    it; workers that captured nothing add no key."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e",
+                             "profile_path": "/p/bench-pallas-b32768-1"}),
+        ],
+    )
+    assert rc == 0
+    assert line["profile_path"] == "/p/bench-pallas-b32768-1"
+
+    line, _, _ = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e",
+                             "profile_path": None}),
+        ],
+    )
+    assert "profile_path" not in line
+
+
+def _is_obs(mode, env):
+    return mode == "--observability"
+
+
+def test_observability_section_always_present(monkeypatch):
+    """ISSUE 16: the BENCH JSON carries an ``observability`` section
+    (sampler tick cost, off-switch cost, flight-recorder bundle build)
+    on every run."""
+    bench = _load_bench()
+    line, _, _ = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 1.0, "device": "tpu:v5e"}),
+        ],
+    )
+    obs = line["observability"]
+    assert obs["ok"] is True
+    assert obs["sampler"]["tick_us_p50"] > 0
+    assert obs["sampler"]["disabled_tick_us_p50"] < obs["sampler"]["tick_us_p50"]
+    assert obs["blackbox"]["build_ms"] > 0
+    assert "timeline" in obs["blackbox"]["bundle_keys"]
+
+
+def test_observability_section_worker_env_is_device_free(monkeypatch):
+    """The overhead micro-bench must never depend on the tunnel: the
+    section launches the worker with jax pinned to cpu (the worker never
+    imports jax anyway — timeseries/blackbox are stdlib-only)."""
+    bench = _load_bench()
+    seen = []
+    monkeypatch.setattr(
+        bench, "_run_worker",
+        lambda mode, timeout, env=None: (
+            seen.append((mode, timeout, dict(env or {}))) or dict(_OBS_OK)
+        ),
+    )
+    assert bench._observability_section()["ok"] is True
+    ((mode, timeout, env),) = seen
+    assert mode == "--observability"
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert timeout == bench.T_OBS
+
+
+def test_observability_section_failure_labeled(monkeypatch):
+    """A failed/timed-out observability scenario is labeled in the
+    artifact, never masked — and never takes the headline down."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_obs, {"ok": False, "error": "timed out after 90s"}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 9.0  # headline survived
+    assert line["observability"] == {
+        "ok": False, "error": "timed out after 90s",
+    }
+
+
+def test_observability_worker_subprocess():
+    """The real ``--observability`` worker end-to-end: reports sampler
+    tick cost under the ISSUE 16 budget (<1% of a bench step: 1.5ms at
+    1Hz) with a ~free off-switch, and a complete bundle key set."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "bench.py"), "--observability"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=150,
+    )
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["ok"] is True, line
+    assert 0 < line["sampler"]["tick_us_p50"] < 1500.0
+    assert line["sampler"]["disabled_tick_us_p50"] < 50.0
+    assert line["sampler"]["series"] >= 100
+    assert line["blackbox"]["build_ms"] > 0
+    assert {"reason", "events", "timeline", "fleet_history", "chaos",
+            "traces", "trigger"} <= set(line["blackbox"]["bundle_keys"])
+
+
 def test_mesh_worker_subprocess():
     """The real ``--mesh`` worker end-to-end in a subprocess: every way
     completes with exactly the submitted sigs verified, the campaign
@@ -1778,6 +1911,9 @@ def test_rotate_runs_file_keep_flag(tmp_path, monkeypatch):
     prev = tmp_path / "device_runs.jsonl.prev"
     monkeypatch.setattr(W, "RUNS_PATH", str(runs))
     monkeypatch.setattr(W, "PREV_RUNS_PATH", str(prev))
+    # rotation folds round medians into the history file (ISSUE 16) —
+    # keep the real benchmarks/bench_history.jsonl out of the test
+    monkeypatch.setattr(W, "HISTORY_PATH", str(tmp_path / "hist.jsonl"))
     now = int(_time.time())
     sample = {"kind": "headline", "device": "tpu:v5e", "unix": now,
               "ts": "t", "value": 41000.0}
@@ -1840,6 +1976,7 @@ def test_claim_pidfile_lifecycle(tmp_path, monkeypatch):
     removes only our own registration."""
     import subprocess
     import sys as _sys
+    import time
 
     from benchmarks import watcher as W
 
@@ -1865,6 +2002,18 @@ def test_claim_pidfile_lifecycle(tmp_path, monkeypatch):
         stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
     try:
+        # wait out the fork->exec window: until exec lands, the child's
+        # /proc cmdline is still the parent image (no "benchmarks.watcher")
+        # and the liveness probe would call the claim stale
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                with open(f"/proc/{proc.pid}/cmdline", "rb") as f:
+                    if b"benchmarks.watcher" in f.read():
+                        break
+            except OSError:
+                pass
+            time.sleep(0.01)
         pidfile.write_text(f"{proc.pid}\n")
         assert not W._claim_pidfile(retries=2, wait_s=0.01)
         assert pidfile.read_text().strip() == str(proc.pid)  # untouched
@@ -2024,6 +2173,7 @@ def test_rotate_keep_drops_stale_rows(tmp_path, monkeypatch):
     runs = tmp_path / "device_runs.jsonl"
     monkeypatch.setattr(W, "RUNS_PATH", str(runs))
     monkeypatch.setattr(W, "PREV_RUNS_PATH", str(runs) + ".prev")
+    monkeypatch.setattr(W, "HISTORY_PATH", str(tmp_path / "hist.jsonl"))
     now = int(_time.time())
     fresh = {"kind": "headline", "device": "tpu:v5e", "unix": now - 60,
              "ts": "new", "value": 41000.0}

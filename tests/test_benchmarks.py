@@ -806,3 +806,134 @@ def test_cpu_single_core_stats_median_and_spread():
     rate, engine, out = cpu_single_core_bench(sample, runs=3)
     assert rate > 0 and engine in ("native-cpp", "python-oracle")
     assert len(out) == len(sample)
+
+
+# ---------- watcher: cross-round history + regression detector -------------
+
+
+def test_detect_regression_needs_three_rounds_and_flags_drops():
+    watcher = _load_watcher()
+    hist = [{"medians": {"headline": m}} for m in (1000.0, 1010.0, 990.0)]
+    # fewer than 3 rounds of history for the key: never flags
+    assert watcher.detect_regression("headline", 1.0, hist[:2]) is None
+    assert watcher.detect_regression("other_key", 1.0, hist) is None
+    # in-band sample (floor = 1000 - max(20, 50) = 950): clean
+    assert watcher.detect_regression("headline", 955.0, hist) is None
+    # the synthetic -20% regression (ISSUE 16 acceptance)
+    reg = watcher.detect_regression("headline", 800.0, hist)
+    assert reg is not None
+    assert reg["key"] == "headline" and reg["value"] == 800.0
+    assert reg["baseline"] == 1000.0 and reg["rounds"] == 3
+    assert reg["floor"] == 950.0
+    assert reg["drop_pct"] == 20.0
+
+
+def test_history_key_separates_mesh_way_counts():
+    watcher = _load_watcher()
+    assert watcher._history_key("headline", {"value": 1.0}) == "headline"
+    assert watcher._history_key(
+        "mesh", {"value": 1.0, "mesh_ways": 8}
+    ) == "mesh@8w"
+
+
+def test_record_folds_history_and_banks_regression_row(
+    tmp_path, monkeypatch
+):
+    """ISSUE 16 acceptance end-to-end: three rounds folded into the
+    history file, then a -20% banked sample produces a
+    ``kind="regression"`` row in the runs file AND a bench.regression
+    event; an in-band sample stays clean."""
+    watcher = _load_watcher()
+    runs = tmp_path / "device_runs.jsonl"
+    monkeypatch.setattr(watcher, "RUNS_PATH", str(runs))
+    monkeypatch.setattr(
+        watcher, "HISTORY_PATH", str(tmp_path / "hist.jsonl")
+    )
+    for rate in (1000.0, 1010.0, 990.0):
+        watcher._fold_history([
+            {"kind": "headline", "value": rate},
+            {"kind": "headline", "value": rate + 2.0},
+            {"kind": "mesh", "value": rate * 8, "mesh_ways": 8},
+            {"kind": "regression", "value": 1.0},  # never folded
+            {"kind": "fatal", "error": "x"},  # no value: ignored
+        ])
+    hist = watcher._load_history()
+    assert len(hist) == 3
+    assert set(hist[0]["medians"]) == {"headline", "mesh@8w"}
+
+    from tpunode.events import events
+
+    seq0 = events.seq()
+    # in-band sample: only the headline row itself lands
+    watcher._record("headline", {"value": 1005.0, "device": "tpu:v5e"})
+    rows = [json.loads(x) for x in runs.read_text().splitlines()]
+    assert [r["kind"] for r in rows] == ["headline"]
+
+    # the -20% sample: headline row + regression row + event
+    watcher._record("headline", {"value": 800.0, "device": "tpu:v5e"})
+    rows = [json.loads(x) for x in runs.read_text().splitlines()]
+    assert [r["kind"] for r in rows] == [
+        "headline", "headline", "regression",
+    ]
+    reg = rows[-1]
+    assert reg["key"] == "headline" and reg["value"] == 800.0
+    assert reg["floor"] > 800.0 and reg["rounds"] == 3
+    assert reg["drop_pct"] == pytest.approx(20.1, abs=0.2)
+    evs = [
+        e for e in events.tail_since(seq0)
+        if e["type"] == "bench.regression"
+    ]
+    assert len(evs) == 1 and evs[0]["key"] == "headline"
+
+    # a mesh sample regresses against its own way-count series
+    watcher._record(
+        "mesh", {"value": 6000.0, "mesh_ways": 8, "device": "tpu:v5e"}
+    )
+    rows = [json.loads(x) for x in runs.read_text().splitlines()]
+    assert rows[-1]["kind"] == "regression"
+    assert rows[-1]["key"] == "mesh@8w"
+    # a way count with no history never flags
+    watcher._record(
+        "mesh", {"value": 10.0, "mesh_ways": 2, "device": "tpu:v5e"}
+    )
+    rows = [json.loads(x) for x in runs.read_text().splitlines()]
+    assert rows[-1]["kind"] == "mesh"
+
+
+def test_load_history_caps_rounds_and_skips_garbage(tmp_path, monkeypatch):
+    watcher = _load_watcher()
+    hist = tmp_path / "hist.jsonl"
+    monkeypatch.setattr(watcher, "HISTORY_PATH", str(hist))
+    assert watcher._load_history() == []  # absent file
+    lines = ["not json", json.dumps({"medians": "nope"})]
+    lines += [
+        json.dumps({"unix": i, "medians": {"headline": 1000.0 + i}})
+        for i in range(8)
+    ]
+    hist.write_text("\n".join(lines) + "\n")
+    rows = watcher._load_history()
+    assert len(rows) == watcher.HISTORY_ROUNDS  # capped at the last N
+    assert rows[-1]["unix"] == 7  # newest retained
+
+
+def test_banked_headline_carries_profile_path(tmp_path, monkeypatch):
+    """ISSUE 16: the watcher banks the worker's device-profile path
+    alongside the verdict row, linking each sample in the runs file to
+    its captured profile directory."""
+    watcher = _load_watcher()
+    runs = tmp_path / "device_runs.jsonl"
+    monkeypatch.setattr(watcher, "RUNS_PATH", str(runs))
+    monkeypatch.setattr(
+        watcher, "HISTORY_PATH", str(tmp_path / "hist.jsonl")
+    )
+    monkeypatch.setattr(watcher, "_bench_running", lambda: False)
+    watcher._headline_banked = True
+    monkeypatch.setattr(watcher, "_run_json", lambda *a, **k: {
+        "ok": True, "rate": 30000.0, "device": "tpu:v5e", "kernel": "xla",
+        "batch": 8192, "profile_path": "/p/bench-xla-b8192-7",
+    })
+    head, why, _ = watcher.run_headline()
+    assert why == "banked"
+    rows = [json.loads(x) for x in runs.read_text().splitlines()]
+    assert rows[0]["kind"] == "headline"
+    assert rows[0]["profile_path"] == "/p/bench-xla-b8192-7"

@@ -1,0 +1,480 @@
+"""Flight recorder tests (ISSUE 16): trigger matrix, bundle contents,
+rate limiting, disk bundles, node wiring, and the fleet chaos
+acceptance — an injected host partition produces exactly ONE complete
+post-mortem bundle."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from tpunode.blackbox import FlightRecorder, FlightRecorderConfig, TRIGGERS
+from tpunode.events import EventLog
+from tpunode.metrics import Metrics, metrics
+from tpunode.timeseries import Timeline
+from tpunode.tracectx import Tracer
+
+
+def _recorder(**kw) -> tuple[EventLog, FlightRecorder]:
+    log = EventLog()
+    kw.setdefault("min_interval", 0.0)
+    rec = FlightRecorder(FlightRecorderConfig(**kw), log_=log)
+    rec.attach()
+    return log, rec
+
+
+# --- trigger matrix ----------------------------------------------------------
+
+
+def test_every_trigger_type_records():
+    for type_ in sorted(TRIGGERS):
+        log, rec = _recorder()
+        log.emit(type_, detail="x")
+        (bundle,) = rec.records()
+        assert bundle["reason"] == type_
+        assert bundle["trigger"]["type"] == type_
+
+
+def test_non_trigger_events_do_not_record():
+    log, rec = _recorder()
+    log.emit("peer.connect", peer="a:1")
+    log.emit("verify.dispatch", backend="cpu", size=8)
+    assert rec.records() == [] and rec.stats()["dumps"] == 0
+
+
+def test_breaker_trigger_only_on_open():
+    log, rec = _recorder()
+    log.emit("verify.breaker", **{"from": "ready", "to": "degraded"})
+    assert rec.records() == []
+    log.emit("verify.breaker", **{"from": "degraded", "to": "open"})
+    (bundle,) = rec.records()
+    assert bundle["reason"] == "verify.breaker"
+    log.emit("verify.breaker", **{"from": "open", "to": "probing"})
+    assert len(rec.records()) == 1
+
+
+def test_dump_event_does_not_self_trigger():
+    """blackbox.dump is emitted into the same log the recorder watches;
+    it must never be a trigger (infinite recursion otherwise)."""
+    assert "blackbox.dump" not in TRIGGERS
+    log, rec = _recorder()
+    log.emit("watchdog.stall", kind="event_loop")
+    assert rec.stats()["dumps"] == 1
+    # the dump event itself is now in the log; no further bundle
+    assert log.counts().get("blackbox.dump") == 1
+    assert rec.stats()["dumps"] == 1
+
+
+# --- bundle contents ---------------------------------------------------------
+
+
+def test_bundle_fields_complete():
+    reg = Metrics(disabled=False)
+    reg.inc("verify.batches", 3)
+    reg.set_gauge("sched.host_depth", 2.0, labels={"host": "h0"})
+    tl = Timeline(interval=1.0, registry=reg, disabled=False)
+    tl.tick()
+    col = Tracer(enabled=True)
+    tr = col.start("block", peer="a:1")
+    tr.end(tr.begin("verify.dispatch"))
+    col.finish(tr)
+    log = EventLog()
+    log.emit("peer.connect", peer="a:1")
+    rec = FlightRecorder(
+        FlightRecorderConfig(min_interval=0.0),
+        log_=log, timeline=tl, tracer_=col,
+        sources={
+            "engine": lambda: {"backend": "cpu", "backlog": 0},
+            "health": lambda: {"ok": False},
+            "broken": lambda: 1 / 0,
+        },
+    )
+    rec.attach()
+    log.emit("utxo.error", height=7, error="boom")
+    (bundle,) = rec.records()
+    assert bundle["reason"] == "utxo.error"
+    assert bundle["trigger"]["height"] == 7 and bundle["trigger"]["seq"] == 2
+    assert [e["type"] for e in bundle["events"]][-2:] == [
+        "peer.connect", "utxo.error",
+    ]
+    assert bundle["event_counts"]["utxo.error"] == 1
+    assert bundle["traces"]["slowest"][0]["trace_id"] == tr.trace_id
+    assert bundle["traces"]["recent"][0]["trace_id"] == tr.trace_id
+    assert "verify.batches" in bundle["timeline"]
+    assert bundle["fleet_history"]["h0"]["sched.host_depth"]
+    assert bundle["engine"] == {"backend": "cpu", "backlog": 0}
+    assert bundle["health"] == {"ok": False}
+    # a broken source degrades to an error string, never kills the dump
+    assert "ZeroDivisionError" in bundle["broken"]["error"]
+    assert isinstance(bundle["chaos"], dict)
+    assert bundle["path"] is None  # no dir configured: memory-only
+
+
+def test_bundle_without_timeline_keeps_shape():
+    log, rec = _recorder()
+    log.emit("store.corruption", path="x", offset=1)
+    (bundle,) = rec.records()
+    assert bundle["timeline"] == {} and bundle["fleet_history"] == {}
+
+
+# --- rate limit --------------------------------------------------------------
+
+
+def test_rate_limit_one_bundle_per_interval():
+    metrics.reset()
+    log, rec = _recorder(min_interval=60.0)
+    for i in range(5):
+        log.emit("watchdog.stall", kind="event_loop", n=i)
+    assert rec.stats()["dumps"] == 1
+    assert rec.stats()["suppressed"] == 4
+    assert metrics.get("blackbox.suppressed") == 4.0
+    assert len(rec.records()) == 1
+
+
+def test_force_bypasses_rate_limit():
+    log, rec = _recorder(min_interval=3600.0)
+    log.emit("watchdog.stall", kind="event_loop")
+    assert rec.record("node.unclean_shutdown") is None  # suppressed
+    bundle = rec.record("node.unclean_shutdown", force=True)
+    assert bundle is not None and rec.stats()["dumps"] == 2
+
+
+def test_detach_stops_recording():
+    log, rec = _recorder()
+    rec.detach()
+    log.emit("watchdog.stall", kind="event_loop")
+    assert rec.stats()["dumps"] == 0
+    rec.attach()
+    rec.attach()  # idempotent: one subscription
+    log.emit("watchdog.stall", kind="event_loop")
+    assert rec.stats()["dumps"] == 1
+
+
+# --- disk bundles ------------------------------------------------------------
+
+
+def test_dir_write_and_records_order(tmp_path):
+    log, rec = _recorder(dir=str(tmp_path))
+    log.emit("utxo.error", height=1, error="a")
+    log.emit("watchdog.stall", kind="event_loop")
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 2
+    assert files[0].startswith("blackbox-") and files[0].endswith(".json")
+    assert "utxo_error" in files[0] or "utxo_error" in files[1]
+    on_disk = json.loads((tmp_path / files[0]).read_text())
+    assert on_disk["reason"] in ("utxo.error", "watchdog.stall")
+    # records(): newest first, paths point at the files
+    recs = rec.records()
+    assert [r["reason"] for r in recs] == ["watchdog.stall", "utxo.error"]
+    assert all(os.path.isfile(r["path"]) for r in recs)
+
+
+def test_env_dir_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUNODE_BLACKBOX_DIR", str(tmp_path))
+    assert FlightRecorderConfig().dir == str(tmp_path)
+    monkeypatch.delenv("TPUNODE_BLACKBOX_DIR")
+    assert FlightRecorderConfig().dir is None
+
+
+def test_write_failure_keeps_bundle_in_ring(tmp_path):
+    metrics.reset()
+    target = tmp_path / "not_a_dir"
+    target.write_text("occupied")  # makedirs will fail on a file
+    log, rec = _recorder(dir=str(target))
+    log.emit("watchdog.stall", kind="event_loop")
+    (bundle,) = rec.records()
+    assert bundle["path"] is None
+    assert rec.stats()["write_errors"] == 1
+    assert metrics.get("blackbox.write_errors") == 1.0
+
+
+# --- node wiring -------------------------------------------------------------
+
+
+def _node_cfg(tmp_path=None, **kw):
+    from tests.fakenet import dummy_peer_connect
+    from tests.fixtures import all_blocks
+    from tpunode import BCH_REGTEST, NodeConfig, Publisher
+    from tpunode.store import MemoryKV
+
+    return NodeConfig(
+        net=BCH_REGTEST,
+        store=MemoryKV(),
+        pub=Publisher(),
+        peers=[],
+        connect=lambda sa: dummy_peer_connect(BCH_REGTEST, all_blocks()),
+        blackbox_dir=str(tmp_path) if tmp_path is not None else None,
+        **kw,
+    )
+
+
+@pytest.mark.asyncio
+async def test_node_wires_recorder_and_clean_exit_writes_nothing(tmp_path):
+    from tpunode import Node
+
+    async with Node(_node_cfg(tmp_path)) as node:
+        assert node.blackbox is not None
+        assert node.blackbox.stats()["attached"]
+        assert node.timeline is not None
+        st = node.stats()
+        assert "blackbox" in st and "timeline" in st
+        assert "fleet_history" in st
+    # clean shutdown: detached, no unclean-shutdown bundle on disk
+    assert node.blackbox.stats()["attached"] is False
+    assert os.listdir(tmp_path) == []
+
+
+@pytest.mark.asyncio
+async def test_node_unclean_shutdown_records_bundle(tmp_path):
+    from tpunode import Node
+
+    with pytest.raises(RuntimeError, match="scenario"):
+        async with Node(_node_cfg(tmp_path)) as node:
+            raise RuntimeError("scenario failure")
+    (name,) = os.listdir(tmp_path)
+    assert "node_unclean_shutdown" in name
+    bundle = json.loads((tmp_path / name).read_text())
+    assert bundle["reason"] == "node.unclean_shutdown"
+    assert "scenario failure" in bundle["trigger"]["failure"]
+    (ring_bundle,) = node.blackbox.records(1)
+    assert ring_bundle["reason"] == "node.unclean_shutdown"
+
+
+@pytest.mark.asyncio
+async def test_node_blackbox_off_switch():
+    from tpunode import Node
+
+    async with Node(_node_cfg(blackbox=False)) as node:
+        assert node.blackbox is None
+        assert node.stats()["blackbox"] == {"enabled": False}
+
+
+@pytest.mark.asyncio
+async def test_node_timeline_off_switch():
+    from tpunode import Node
+
+    async with Node(_node_cfg(timeline_interval=0.0)) as node:
+        assert node.timeline is None
+        assert node.stats()["fleet_history"] == {}
+
+
+# --- the fleet chaos acceptance ----------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_chaos_partition_produces_one_complete_bundle(tmp_path):
+    """ISSUE 16 acceptance: a 2-host fleet engine under an injected
+    dispatch partition loses h1.  The incident is a CASCADE — the chaos
+    fault forces h1's breaker open (``verify.breaker`` -> "open"), then
+    the engine marks the host down (``mesh.host_down``) — and the
+    recorder freezes exactly ONE bundle at the FIRST trigger; everything
+    downstream (host_down, a follow-on watchdog stall) lands in the
+    suppressed count, never on disk.  The bundle is asserted field by
+    field: events ring, fleet timeline window, engine/breaker/mesh
+    state, chaos stats."""
+    from tpunode.actors import task_registry
+    from tpunode.chaos import ChaosPlan, chaos
+    from tpunode.events import events
+    from tpunode.verify.engine import VerifyConfig, VerifyEngine
+
+    from tests.test_engine import make_items
+
+    metrics.reset()
+    tl = Timeline(interval=1.0, disabled=False)  # over the global registry
+    rec = FlightRecorder(
+        FlightRecorderConfig(dir=str(tmp_path), min_interval=60.0),
+        timeline=tl,  # global event log
+    )
+    try:
+        async with VerifyEngine(
+            VerifyConfig(
+                backend="cpu", batch_size=8, max_wait=0.005,
+                pipeline_depth=1, mesh_hosts=2, warmup=False,
+                breaker_cooldown=30.0,  # no rejoin mid-test
+            )
+        ) as eng:
+            rec.sources["engine"] = eng.stats
+            rec.attach()
+            try:
+                # clean warmup round: populates verify.* counters and the
+                # per-host sched.host_depth / mesh.host_chips gauges so
+                # the timeline has fleet series BEFORE the incident
+                warm = [make_items(6, tamper_every=3) for _ in range(4)]
+                got = await asyncio.gather(
+                    *(eng.verify(i) for i, _ in warm)
+                )
+                for (items, expected), out in zip(warm, got):
+                    assert out == expected
+                tl.tick()
+                assert rec.stats()["dumps"] == 0  # healthy: no bundle
+
+                chaos.install(ChaosPlan.parse(
+                    "seed=3;mesh.dispatch:partition:match=h1,n=2"
+                ))
+                deadline = asyncio.get_running_loop().time() + 10
+                while metrics.get("mesh.host_losses") < 1:
+                    assert (
+                        asyncio.get_running_loop().time() < deadline
+                    ), "partition never fired"
+                    batches = [
+                        make_items(6, tamper_every=3) for _ in range(4)
+                    ]
+                    got = await asyncio.gather(
+                        *(eng.verify(i) for i, _ in batches)
+                    )
+                    for (items, expected), out in zip(batches, got):
+                        assert out == expected
+                    tl.tick()
+                # exactly one bundle: first trigger of the cascade wins,
+                # the host_down that follows was suppressed
+                assert rec.stats()["dumps"] == 1
+                assert rec.stats()["suppressed"] >= 1
+                suppressed = rec.stats()["suppressed"]
+                # a follow-on stall inside the rate window: suppressed too
+                events.emit(
+                    "watchdog.stall", kind="event_loop", lag_seconds=9.9
+                )
+                assert rec.stats()["dumps"] == 1
+                assert rec.stats()["suppressed"] == suppressed + 1
+            finally:
+                rec.detach()
+        assert task_registry.report_leaks() == []
+    finally:
+        chaos.uninstall()
+
+    # exactly ONE file on disk
+    (name,) = os.listdir(tmp_path)
+    bundle = json.loads((tmp_path / name).read_text())
+
+    # field-by-field: the trigger is the breaker forced open on h1
+    assert bundle["reason"] == "verify.breaker"
+    assert bundle["trigger"]["type"] == "verify.breaker"
+    assert bundle["trigger"]["to"] == "open"
+    assert bundle["trigger"]["host"] == "h1"
+    assert bundle["trigger"]["seq"] > 0
+
+    # the events ring around the incident: the injected fault and the
+    # breaker transition are both in frame
+    types = [e["type"] for e in bundle["events"]]
+    assert "chaos.inject" in types
+    assert "verify.breaker" in types
+    assert bundle["event_counts"]["chaos.inject"] >= 1
+
+    # causal traces frozen with the incident (the engine's dispatch path
+    # is traced; both rings are present even when sampling kept few)
+    assert set(bundle["traces"]) == {"slowest", "recent"}
+    assert isinstance(bundle["traces"]["slowest"], list)
+
+    # the timeline window: sampled series around the trigger, with the
+    # per-host fleet view
+    assert "verify.items" in bundle["timeline"]
+    assert bundle["fleet_history"], "no per-host series sampled"
+    assert set(bundle["fleet_history"]) == {"h0", "h1"}
+    assert any(
+        "sched.host_depth" in fams
+        for fams in bundle["fleet_history"].values()
+    )
+
+    # engine/breaker/mesh state from the wired source, frozen at the
+    # moment the breaker opened
+    fleet = bundle["engine"]["fleet"]
+    assert fleet["hosts"] == 2
+    assert fleet["breakers"]["h1"] == "open"
+    assert "queued_lanes" in fleet and "host_steals" in fleet
+
+    # chaos stats make the injected fault self-describing
+    assert bundle["chaos"]["enabled"] is True
+    assert any(
+        f["fired"] >= 1 and "partition" in f["fault"]
+        for f in bundle["chaos"]["faults"]
+    ), bundle["chaos"]
+
+
+def test_breaker_open_trigger_with_breaker_stats_source_no_deadlock():
+    """Regression (found by the --chaos bench worker): the breaker emits
+    ``verify.breaker`` with its own lock held, and the recorder's
+    observer runs synchronously inside that emit — a bundle source that
+    calls back into ``breaker.stats()`` on the same thread must complete
+    (reentrant breaker lock), not self-deadlock."""
+    import threading
+
+    from tpunode.verify.engine import CircuitBreaker
+
+    br = CircuitBreaker(threshold=1, window=30.0, cooldown=5.0)
+    rec = FlightRecorder(
+        FlightRecorderConfig(min_interval=0.0),
+        sources={"breaker": br.stats},  # global log: where the breaker emits
+    )
+    rec.attach()
+    try:
+        t = threading.Thread(target=lambda: br.trip("device gone"))
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "deadlocked building the bundle"
+        assert rec.stats()["dumps"] == 1
+        (bundle,) = rec.records(1)
+        assert bundle["reason"] == "verify.breaker"
+        assert bundle["breaker"]["state"] == "open"
+    finally:
+        rec.detach()
+
+
+# --- watchdog + stats reporter under fleet mode ------------------------------
+
+
+@pytest.mark.asyncio
+async def test_watchdog_and_stats_reporter_under_fleet_mode():
+    """ISSUE 16 satellite: the observability loops work against a
+    multi-host engine — the watchdog's dispatch-stall probe reads the
+    fleet engine's inflight clock, and StatsReporter folds per-host
+    labeled series into bounded aggregates instead of leaking them into
+    the persisted event."""
+    from tpunode.actors import task_registry
+    from tpunode.events import StatsReporter
+    from tpunode.verify.engine import VerifyConfig, VerifyEngine
+    from tpunode.watchdog import Watchdog, WatchdogConfig
+
+    from tests.test_engine import make_items
+
+    metrics.reset()
+    log = EventLog()
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=8, max_wait=0.005,
+            pipeline_depth=1, mesh_hosts=2, warmup=False,
+        )
+    ) as eng:
+        wd = Watchdog(
+            WatchdogConfig(dispatch_stall_threshold=30.0),
+            engine=eng, log_=log,
+        )
+        rep = StatsReporter(
+            interval=30.0, log=log,
+            extra=lambda: {"fleet": eng.stats()["fleet"]},
+            label_agg={"sched.host_depth": "host"},
+        )
+        rep.tick()  # baseline snapshot for the rate window
+        batches = [make_items(6, tamper_every=3) for _ in range(4)]
+        got = await asyncio.gather(*(eng.verify(i) for i, _ in batches))
+        for (items, expected), out in zip(batches, got):
+            assert out == expected
+
+        # healthy 2-host fleet: no stall findings, inflight clock at zero
+        assert wd.check() == []
+        snap = wd.snapshot()
+        assert snap["dispatch_inflight_seconds"] == 0.0
+        assert "dispatch_inflight" in snap
+
+        ev = rep.tick()
+        assert ev["type"] == "node.stats"
+        assert ev["counters"]["verify.items"] >= 24.0
+        # per-host/per-peer labeled series never leak into the event...
+        assert not any("{" in k for k in ev["counters"])
+        # ...they arrive as bounded per-host aggregates instead
+        assert set(ev["labeled"]["sched.host_depth"]) == {"h0", "h1"}
+        assert ev["rates"]["verify.items"] > 0.0
+        assert set(ev["fleet"]["active"]) == {"h0", "h1"}
+    assert task_registry.report_leaks() == []

@@ -123,6 +123,131 @@ async def test_mempool_endpoint():
 
 
 @pytest.mark.asyncio
+async def test_events_since_cursor():
+    """ISSUE 16 satellite: /events?since=<seq> returns only events newer
+    than the cursor, and every body carries the log's current seq so the
+    poller can advance it."""
+    log = EventLog()
+    log.emit("peer.connect", peer="a:1")
+    log.emit("peer.connect", peer="b:2")
+    async with DebugServer(
+        port=0, registry=Metrics(disabled=False), log_=log
+    ) as srv:
+        status, _, body = await _get(srv.port, "/events")
+        assert status == 200
+        got = json.loads(body)
+        assert got["seq"] == 2
+        seqs = [e["seq"] for e in got["events"]]
+        assert seqs == [1, 2]
+
+        # cursor at the tip: nothing new
+        status, _, body = await _get(srv.port, f"/events?since={got['seq']}")
+        assert json.loads(body)["events"] == []
+
+        # new event past the cursor: exactly it comes back
+        log.emit("peer.disconnect", peer="a:1")
+        status, _, body = await _get(srv.port, f"/events?since={got['seq']}")
+        got2 = json.loads(body)
+        assert [e["type"] for e in got2["events"]] == ["peer.disconnect"]
+        assert got2["seq"] == 3
+
+        # since=0 is a valid cursor (all events), not the ring-tail mode
+        status, _, body = await _get(srv.port, "/events?since=0&n=2")
+        assert [e["seq"] for e in json.loads(body)["events"]] == [2, 3]
+
+
+@pytest.mark.asyncio
+async def test_timeseries_endpoint():
+    """/timeseries round-trips the metrics timeline: index without a
+    name, one series' points with one; {"enabled": false} when the node
+    runs no timeline."""
+    from tpunode.timeseries import Timeline
+
+    reg = Metrics(disabled=False)
+    reg.inc("peer.msgs_in", 3)
+    tl = Timeline(interval=1.0, registry=reg, disabled=False)
+    tl.tick(now=100.0)
+    reg.inc("peer.msgs_in", 2)
+    tl.tick(now=101.0)
+
+    async with DebugServer(port=0, registry=reg, timeline=tl) as srv:
+        status, _, body = await _get(srv.port, "/timeseries")
+        assert status == 200
+        got = json.loads(body)
+        assert got["enabled"] is True and got["ticks"] == 2
+        assert "peer.msgs_in" in got["series_names"]
+
+        status, _, body = await _get(
+            srv.port, "/timeseries?name=peer.msgs_in&tier=0"
+        )
+        got = json.loads(body)
+        assert got["name"] == "peer.msgs_in" and got["tier"] == 0
+        assert [tuple(p) for p in got["points"]] == [
+            (100.0, 3.0), (101.0, 5.0),
+        ]
+
+        # since trims older points
+        status, _, body = await _get(
+            srv.port, "/timeseries?name=peer.msgs_in&since=101"
+        )
+        assert [tuple(p) for p in json.loads(body)["points"]] == [
+            (101.0, 5.0)
+        ]
+
+    async with DebugServer(port=0, registry=reg) as srv:
+        status, _, body = await _get(srv.port, "/timeseries")
+        assert json.loads(body) == {"enabled": False}
+
+
+@pytest.mark.asyncio
+async def test_fleet_and_flightrecords_endpoints():
+    """/fleet joins live fleet state with the sampled per-host history;
+    /flightrecords serves the recorder's ring + stats."""
+    from tpunode.blackbox import FlightRecorder, FlightRecorderConfig
+    from tpunode.timeseries import Timeline
+
+    reg = Metrics(disabled=False)
+    reg.set_gauge("mesh.host_chips", 8.0, labels={"host": "h0"})
+    reg.set_gauge("sched.host_depth", 2.0, labels={"host": "h0"})
+    tl = Timeline(interval=1.0, registry=reg, disabled=False)
+    tl.tick(now=100.0)
+    log = EventLog()
+    rec = FlightRecorder(
+        FlightRecorderConfig(dir=None, min_interval=0.0),
+        log_=log, timeline=tl,
+    )
+    rec.record("test.manual", force=True)
+
+    async with DebugServer(
+        port=0, registry=reg, log_=log, timeline=tl, blackbox=rec,
+        fleet=lambda: {"active_hosts": ["h0"]},
+    ) as srv:
+        status, _, body = await _get(srv.port, "/fleet")
+        assert status == 200
+        got = json.loads(body)
+        assert got["now"] == {"active_hosts": ["h0"]}
+        assert set(got["history"]["h0"]) == {
+            "mesh.host_chips", "sched.host_depth",
+        }
+
+        status, _, body = await _get(srv.port, "/flightrecords")
+        assert status == 200
+        got = json.loads(body)
+        assert got["stats"]["dumps"] == 1
+        (bundle,) = got["records"]
+        assert bundle["reason"] == "test.manual"
+        assert "timeline" in bundle and "fleet_history" in bundle
+
+    # neither wired: both endpoints answer, not 404
+    async with DebugServer(port=0, registry=reg) as srv:
+        status, _, body = await _get(srv.port, "/fleet")
+        assert status == 200
+        assert json.loads(body) == {"now": None, "history": {}}
+        status, _, body = await _get(srv.port, "/flightrecords")
+        assert json.loads(body) == {"enabled": False}
+
+
+@pytest.mark.asyncio
 async def test_non_get_rejected_and_garbage_ignored():
     async with DebugServer(port=0, registry=Metrics(disabled=False)) as srv:
         reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)

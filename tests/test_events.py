@@ -51,6 +51,21 @@ def test_event_schema_golden():
     assert back["seconds"] == 0.01
 
 
+def test_seq_cursor():
+    """ISSUE 16 satellite: every event carries a monotonic ``seq``; the
+    ``tail_since`` cursor returns only newer events (oldest first) and
+    keeps working across ring eviction."""
+    log = EventLog(maxlen=4)
+    assert log.seq() == 0
+    for i in range(6):
+        assert log.emit("chain.headers", count=i)["seq"] == i + 1
+    assert log.seq() == 6
+    assert [e["seq"] for e in log.tail(100)] == [3, 4, 5, 6]  # 1,2 evicted
+    assert [e["count"] for e in log.tail_since(4)] == [4, 5]  # seq > 4 only
+    assert log.tail_since(6) == []  # cursor at the tip
+    assert [e["seq"] for e in log.tail_since(0, n=2)] == [5, 6]  # newest kept
+
+
 def test_jsonl_file_sink(tmp_path):
     path = tmp_path / "events.jsonl"
     log = EventLog(path=str(path))

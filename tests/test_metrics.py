@@ -246,6 +246,13 @@ def test_render_prometheus_parses():
     assert counts == sorted(counts) and counts[-1] == 2
     assert any('le="+Inf"' in l for l in bucket_lines)
     assert "tpunode_span_verify_dispatch_count 2" in lines
+    # _sum is part of the histogram exposition contract (rate(_sum)/rate(
+    # _count) is how operators derive a mean latency from the scrape)
+    sum_line = next(
+        l for l in lines
+        if l.startswith("tpunode_span_verify_dispatch_sum ")
+    )
+    assert float(sum_line.split(" ")[1]) == pytest.approx(0.03)
     # label values with special characters are escaped, not mangled
     assert 'peer="[::1]:1"' in text
 

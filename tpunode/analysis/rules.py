@@ -11,6 +11,7 @@ a pinned ``<layer>.<name>`` naming schema.
 from __future__ import annotations
 
 import ast
+import os
 
 from .core import FileContext, NAME_SCHEMA_RE, rule
 
@@ -469,7 +470,9 @@ _METRIC_ATTRS = {"inc", "observe", "set_gauge"}
 # adds `mempool` (the mempool subsystem's metric/event/span names).
 KNOWN_LAYERS = frozenset({
     "asyncsan",   # runtime sanitizers (tpunode/asyncsan.py)
-    "bench",      # driver bench traces (bench.py)
+    "bench",      # driver bench traces (bench.py; incl. the watcher's
+                  # cross-round regression detector, ISSUE 16)
+    "blackbox",   # flight recorder (tpunode/blackbox.py, ISSUE 16)
     "bus",        # Publisher/user bus (tpunode/actors.py)
     "chain",      # header-chain actor (tpunode/chain.py)
     "chaos",      # fault injection (tpunode/chaos.py, ISSUE 7)
@@ -486,6 +489,8 @@ KNOWN_LAYERS = frozenset({
                   # ISSUE 10; incl. the node-side extract ring gauges)
     "store",      # KV store (tpunode/store.py)
     "trace",      # tracing internals (tpunode/tracectx.py)
+    "tsdb",       # metrics timeline sampler (tpunode/timeseries.py,
+                  # ISSUE 16)
     "utxo",       # persistent UTXO store (tpunode/utxo.py, ISSUE 9)
     "verify",     # batch verify engine (tpunode/verify/)
     "watchdog",   # stall watchdog (tpunode/watchdog.py)
@@ -571,3 +576,81 @@ def _event_name(ctx: FileContext) -> None:
             why = _name_violation(lit) if lit is not None else None
             if why is not None:
                 ctx.report("event-name", node, f"event type {why}")
+
+
+# --- doc-drift ---------------------------------------------------------------
+
+# OBSERVABILITY.md relative to this file (tpunode/analysis/ -> repo
+# root).  Loaded once per process; a missing doc disables the rule (an
+# installed copy of the package without the repo docs must lint clean).
+_OBS_DOC_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..", "..",
+    "OBSERVABILITY.md",
+)
+_obs_doc_cache: list = []  # [str] once loaded, [None] when absent
+
+
+def _observability_text() -> "str | None":
+    if not _obs_doc_cache:
+        try:
+            with open(_OBS_DOC_PATH, encoding="utf-8") as f:
+                _obs_doc_cache.append(f.read())
+        except OSError:
+            _obs_doc_cache.append(None)
+    return _obs_doc_cache[0]
+
+
+def _telemetry_name_literals(ctx: FileContext):
+    """Yield ``(node, name)`` for every literal metric/span/event name in
+    the file — the exact call sites metric-name/event-name lint."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        first = _literal(node.args[0]) if node.args else None
+        if isinstance(func, ast.Attribute):
+            if func.attr in _METRIC_ATTRS or func.attr in ("span", "emit"):
+                if first is not None:
+                    yield node, first
+            elif func.attr == "inc_batch":
+                for arg in node.args:
+                    if isinstance(arg, (ast.Tuple, ast.List)):
+                        for el in arg.elts:
+                            if isinstance(
+                                el, (ast.Tuple, ast.List)
+                            ) and el.elts:
+                                name = _literal(el.elts[0])
+                                if name is not None:
+                                    yield el, name
+        elif (
+            isinstance(func, ast.Name)
+            and func.id == "span"
+            and first is not None
+        ):
+            yield node, first
+
+
+@rule(
+    "doc-drift",
+    "schema-valid telemetry name literal is absent from OBSERVABILITY.md "
+    "(every shipped metric/span/event name needs an inventory row)",
+)
+def _doc_drift(ctx: FileContext) -> None:
+    """ISSUE 16 satellite: the names inventory in OBSERVABILITY.md is
+    load-bearing (dashboards and the flight-recorder postmortems are
+    read against it), so a name shipped without a row is drift, caught
+    at lint time.  Only names that PASS the schema+layer checks are
+    considered — a malformed name is metric-name/event-name's finding,
+    not two findings for one mistake."""
+    doc = _observability_text()
+    if doc is None:
+        return
+    for node, name in _telemetry_name_literals(ctx):
+        if _name_violation(name) is not None:
+            continue
+        if name not in doc:
+            ctx.report(
+                "doc-drift", node,
+                f"telemetry name {name!r} is not documented in "
+                "OBSERVABILITY.md (add an inventory row)",
+            )

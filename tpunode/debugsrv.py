@@ -6,10 +6,17 @@ the telemetry that already exists in-process:
 * ``GET /metrics``  — Prometheus text exposition (``render_prometheus``)
 * ``GET /health``   — the embedder-supplied health snapshot as JSON
 * ``GET /stats``    — the full stats snapshot as JSON (when supplied)
-* ``GET /events?n=100&type=watchdog.stall`` — recent structured events
+* ``GET /events?n=100&type=watchdog.stall`` — recent structured events;
+  ``?since=<seq>`` returns only events newer than that sequence number
+  (pollers keep a cursor instead of re-downloading the ring)
 * ``GET /traces?n=8`` — recent + slowest finished trace trees (tracectx)
 * ``GET /mempool`` — mempool snapshot (size, orphans, dedup hit-rate,
   top announcers) when the node runs one (``NodeConfig.mempool``)
+* ``GET /timeseries?name=&tier=&since=`` — the metrics timeline
+  (tpunode/timeseries.py): series index, or one series' ring
+* ``GET /fleet`` — per-host fleet state now + its sampled history
+* ``GET /flightrecords?n=`` — the flight recorder's post-mortem bundles
+  (tpunode/blackbox.py)
 
 Off by default: enable with ``NodeConfig.debug_port`` (0 binds an
 ephemeral port — read it back from ``DebugServer.port``).  Binds
@@ -56,6 +63,9 @@ class DebugServer:
         registry: Optional[Metrics] = None,
         log_: Optional[EventLog] = None,
         tracer_: Optional[Tracer] = None,
+        timeline=None,  # tpunode.timeseries.Timeline (or None)
+        blackbox=None,  # tpunode.blackbox.FlightRecorder (or None)
+        fleet: Optional[Callable[[], dict]] = None,  # live fleet state
     ):
         self._want_port = port
         self.host = host
@@ -65,6 +75,9 @@ class DebugServer:
         self.registry = registry if registry is not None else metrics
         self.log = log_ if log_ is not None else events
         self.tracer = tracer_ if tracer_ is not None else tracer
+        self.timeline = timeline
+        self.blackbox = blackbox
+        self.fleet = fleet
         self._server: Optional[asyncio.base_events.Server] = None
         self.port: Optional[int] = None  # actual bound port once started
 
@@ -147,12 +160,21 @@ class DebugServer:
             self._respond(writer, 200, self.stats())
         elif path == "/events":
             typ = params.get("type", [None])[0]
+            since = qint("since", -1, cap=(1 << 62))
+            if since >= 0:
+                # cursor mode: only events with seq > since (the poller
+                # remembers the newest seq it saw); ?type= filtering is
+                # a ring-tail view, not a cursor — they do not combine
+                evs = self.log.tail_since(since, qint("n", 100))
+            else:
+                evs = self.log.tail(qint("n", 100), type=typ)
             self._respond(
                 writer,
                 200,
                 {
-                    "events": self.log.tail(qint("n", 100), type=typ),
+                    "events": evs,
                     "counts": self.log.counts(),
+                    "seq": self.log.seq(),
                 },
             )
         elif path == "/traces":
@@ -170,6 +192,49 @@ class DebugServer:
                 self._respond(writer, 200, self.mempool())
             else:
                 self._respond(writer, 200, {"enabled": False})
+        elif path == "/timeseries":
+            if self.timeline is None:
+                self._respond(writer, 200, {"enabled": False})
+            else:
+                name = params.get("name", [None])[0]
+                if name is None:
+                    body = dict(self.timeline.stats())
+                    body["series_names"] = self.timeline.names()
+                    self._respond(writer, 200, body)
+                else:
+                    tier = qint("tier", 0, cap=16)
+                    since = qint("since", 0, cap=(1 << 62))
+                    self._respond(
+                        writer,
+                        200,
+                        {
+                            "name": name,
+                            "tier": tier,
+                            "points": self.timeline.series(
+                                name, tier=tier, since=float(since)
+                            ),
+                        },
+                    )
+        elif path == "/fleet":
+            now = self.fleet() if self.fleet is not None else None
+            history = (
+                self.timeline.fleet_history()
+                if self.timeline is not None
+                else {}
+            )
+            self._respond(writer, 200, {"now": now, "history": history})
+        elif path == "/flightrecords":
+            if self.blackbox is None:
+                self._respond(writer, 200, {"enabled": False})
+            else:
+                self._respond(
+                    writer,
+                    200,
+                    {
+                        "records": self.blackbox.records(qint("n", 16)),
+                        "stats": self.blackbox.stats(),
+                    },
+                )
         else:
             self._respond(
                 writer,
@@ -178,7 +243,9 @@ class DebugServer:
                     "error": f"no such endpoint: {path}",
                     "endpoints": [
                         "/metrics", "/health", "/stats",
-                        "/events?n=&type=", "/traces?n=", "/mempool",
+                        "/events?n=&type=&since=", "/traces?n=", "/mempool",
+                        "/timeseries?name=&tier=&since=", "/fleet",
+                        "/flightrecords?n=",
                     ],
                 },
             )

@@ -6,7 +6,11 @@ chain reorgs, header-batch imports, verify-batch dispatches and failures —
 into an in-memory ring buffer, optionally mirrored to a JSONL file
 (``TPUNODE_EVENTS=<path>``).  Every event is one JSON object::
 
-    {"ts": <unix seconds>, "type": "<layer>.<name>", ...fields}
+    {"ts": <unix seconds>, "type": "<layer>.<name>", ...fields, "seq": <n>}
+
+``seq`` is a per-log monotonic sequence number (assigned under the ring
+lock) — the ``/events?since=<seq>`` cursor and the flight recorder's
+ordering both key off it.
 
 so a session's history can be replayed, grepped, or diffed (the schema is
 pinned by tests/test_events.py).  Emission is thread-safe (the verify
@@ -60,6 +64,12 @@ class EventLog:
 
     def __init__(self, maxlen: int = 4096, path: Optional[str] = None):
         self._lock = threading.Lock()
+        # Monotonic per-log sequence number, assigned under the ring lock:
+        # the /events?since=<seq> cursor (pollers fetch only what they
+        # have not seen) and the flight recorder's bundle ordering both
+        # key off it.  Never reset — a restart starts a new JSONL file
+        # anyway, and within one process seq strictly increases.
+        self._seq = 0
         # Separate sink lock: TextIOWrapper is NOT thread-safe, so file
         # writes must serialize — but behind their own lock, so a slow
         # disk stalls only writers, never ring readers/counters.
@@ -76,6 +86,8 @@ class EventLog:
         ev = {"ts": round(time.time(), 6), "type": type}
         ev.update(fields)
         with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
             self._ring.append(ev)
             self._counts[type] += 1
             if self._path is not None and self._file is None:
@@ -125,6 +137,19 @@ class EventLog:
         if type is not None:
             evs = [e for e in evs if e["type"] == type]
         return evs[-n:]
+
+    def tail_since(self, seq: int, n: int = 100) -> list[dict]:
+        """Events with ``seq > seq`` (oldest first), capped at ``n`` —
+        the /events cursor: a poller remembers the last seq it saw and
+        never re-downloads the whole ring."""
+        with self._lock:
+            evs = [e for e in self._ring if e["seq"] > seq]
+        return evs[-n:]
+
+    def seq(self) -> int:
+        """The seq of the newest event (0 before the first emit)."""
+        with self._lock:
+            return self._seq
 
     def counts(self) -> dict[str, int]:
         """Total events per type since start (survives ring eviction)."""

@@ -359,6 +359,30 @@ class Metrics:
         with self._lock:
             return {_render_key(n, lk): h for (n, lk), h in self._hists.items()}
 
+    def flat_sample(self) -> dict[str, float]:
+        """One-lock flat sample for the timeline sampler
+        (tpunode/timeseries.py): counters + gauges (like :meth:`snapshot`)
+        plus each histogram's ``<name>.count``/``<name>.sum`` — the two
+        histogram moments that are meaningful as time series (windowed
+        deltas give rate and mean; per-bucket rings would be cardinality
+        × buckets for no query anyone asks).  A span histogram's
+        ``.count`` collides with its legacy shadow counter of the same
+        name — they track the same quantity, so the overwrite is a
+        no-op."""
+        with self._lock:
+            out = {
+                _render_key(n, lk): c.value
+                for (n, lk), c in self._counters.items()
+            }
+            out.update(
+                {_render_key(n, lk): v for (n, lk), v in self._gauges.items()}
+            )
+            for (n, lk), h in self._hists.items():
+                key = _render_key(n, lk)
+                out[key + ".count"] = float(h.count)
+                out[key + ".sum"] = h.total
+        return out
+
     def render_prometheus(self, prefix: str = "tpunode_") -> str:
         """Prometheus text exposition format (0.0.4).
 

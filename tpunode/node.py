@@ -32,9 +32,11 @@ from .actors import (
     spawn_supervised,
     task_registry,
 )
+from .blackbox import FlightRecorder, FlightRecorderConfig
 from .chain import Chain, ChainBestBlock, ChainConfig, ChainEvent
 from .debugsrv import DebugServer
 from .events import StatsReporter, events
+from .timeseries import Timeline
 from .mempool import Mempool, MempoolConfig
 from .metrics import metrics, percentiles
 from .trace import span
@@ -193,6 +195,18 @@ class NodeConfig:
     # /events /traces on 127.0.0.1).  None = off (the default); 0 binds an
     # ephemeral port, readable from node.debug_server.port.
     debug_port: Optional[int] = None
+    # metrics timeline sampler (tpunode/timeseries.py): seconds between
+    # registry snapshots into the ring-buffer history (downsampling tiers,
+    # /timeseries + /fleet endpoints, Node.stats()["fleet_history"]);
+    # 0 disables the sampler.  TPUNODE_NO_TSDB=1 also disables it.
+    timeline_interval: float = 1.0
+    # flight recorder (tpunode/blackbox.py): trigger events (watchdog
+    # stalls, breaker opens, host losses, store corruption, ...) freeze a
+    # rate-limited post-mortem bundle — always into the in-memory ring
+    # (/flightrecords); also onto disk when blackbox_dir (or
+    # $TPUNODE_BLACKBOX_DIR) is set.  False turns the recorder off.
+    blackbox: bool = True
+    blackbox_dir: Optional[str] = None
     # prevout oracle for BIP143 (P2WPKH / BCH FORKID) and BIP341 (taproot)
     # sighashes: (prevout txid, vout) -> satoshi amount, or
     # (amount, scriptPubKey), or None if unknown.  The tuple form enables
@@ -367,6 +381,8 @@ class Node:
         self._watchdog: Optional[Watchdog] = None
         self._attributor = None  # asyncsan.LoopAttributor when enabled
         self.debug_server: Optional[DebugServer] = None
+        self.timeline: Optional[Timeline] = None
+        self.blackbox: Optional[FlightRecorder] = None
 
     @staticmethod
     def _verify_task_died(task, exc) -> None:
@@ -455,6 +471,25 @@ class Node:
                 attributor=self._attributor,
             )
             self._tasks.link(self._watchdog.run(), name="watchdog")
+        if self.cfg.timeline_interval > 0:
+            self.timeline = Timeline(interval=self.cfg.timeline_interval)
+            self._tasks.link(self.timeline.run(), name="timeline-sampler")
+        if self.cfg.blackbox:
+            # bundle state sources: each is one lock-cheap snapshot call,
+            # safe from whatever thread the trigger event fires on
+            sources: dict = {"health": self.health}
+            if self.verify_engine is not None:
+                sources["engine"] = self.verify_engine.stats
+            if self._watchdog is not None:
+                sources["watchdog"] = self._watchdog.snapshot
+            if self.utxo is not None:
+                sources["utxo"] = self.utxo.stats
+            self.blackbox = FlightRecorder(
+                FlightRecorderConfig(dir=self.cfg.blackbox_dir),
+                timeline=self.timeline,
+                sources=sources,
+            )
+            self.blackbox.attach()
         if self.cfg.debug_port is not None:
             self.debug_server = DebugServer(
                 port=self.cfg.debug_port,
@@ -463,6 +498,9 @@ class Node:
                 mempool=(
                     self.mempool.stats if self.mempool is not None else None
                 ),
+                timeline=self.timeline,
+                blackbox=self.blackbox,
+                fleet=self._fleet_now,
             )
             await self._stack.enter_async_context(self.debug_server)
         log.info(
@@ -476,6 +514,24 @@ class Node:
 
     async def __aexit__(self, exc_type, exc, tb) -> None:
         log.info("[Node] stopping")
+        # unclean shutdown is a flight-recorder trigger: freeze the bundle
+        # BEFORE teardown (the state sources still describe the live node),
+        # bypassing the rate limit — this is the last chance to record.
+        if self.blackbox is not None:
+            unclean = self._failure is not None or (
+                exc is not None and not isinstance(exc, asyncio.CancelledError)
+            )
+            if unclean:
+                cause = self._failure if self._failure is not None else exc
+                self.blackbox.record(
+                    "node.unclean_shutdown",
+                    trigger={
+                        "type": "node.unclean_shutdown",
+                        "failure": repr(cause),
+                    },
+                    force=True,
+                )
+            self.blackbox.detach()
         self._owner = None
         try:
             await self._tasks.__aexit__(exc_type, exc, tb)
@@ -527,6 +583,14 @@ class Node:
         if self.ibd is not None:
             extra["ibd_target"] = self.ibd.stats()["target"]
         return extra
+
+    def _fleet_now(self) -> dict:
+        """Live fleet state for the /fleet endpoint (history rides along
+        from the timeline)."""
+        if self.verify_engine is None:
+            return {"enabled": False}
+        fleet = self.verify_engine.stats().get("fleet")
+        return fleet if fleet is not None else {"enabled": False}
 
     def _uptime(self) -> float:
         if self._started_at is None:
@@ -642,6 +706,23 @@ class Node:
                 else {"enabled": False}
             ),
             "events": events.counts(),
+            # per-host fleet series history (ISSUE 16): how the queue
+            # depths / breaker states / sub-mesh widths got here
+            "fleet_history": (
+                self.timeline.fleet_history()
+                if self.timeline is not None
+                else {}
+            ),
+            "timeline": (
+                self.timeline.stats()
+                if self.timeline is not None
+                else {"enabled": False}
+            ),
+            "blackbox": (
+                self.blackbox.stats()
+                if self.blackbox is not None
+                else {"enabled": False}
+            ),
         }
 
     def _verify_failure(self, where: str, error) -> None:

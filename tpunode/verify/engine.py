@@ -275,7 +275,11 @@ class CircuitBreaker:
         # gauge/events with host= so one sick host's transitions don't
         # masquerade as engine-wide device health.
         self.name = name
-        self._lock = threading.Lock()
+        # Reentrant: _transition emits verify.breaker with the lock held,
+        # and a synchronous event observer (the flight recorder freezing
+        # a bundle on the open transition) calls back into stats() on the
+        # same thread — a plain Lock would self-deadlock there.
+        self._lock = threading.RLock()
         self._state = "ready"
         self._failures: collections.deque[float] = collections.deque()
         self._opened_at: Optional[float] = None
@@ -804,7 +808,9 @@ class VerifyEngine:
                 "active": self._fleet.active_hosts(),
                 "depths": self._fleet.host_depths(),
                 "steals": self._fleet.steals,
+                "host_steals": dict(self._fleet.host_steals),
                 "requeued": self._fleet.requeued,
+                "queued_lanes": self._fleet.queued_lanes(),
                 "breakers": {
                     name: hs.breaker.state
                     for name, hs in self._hosts.items()
@@ -1481,6 +1487,7 @@ class VerifyEngine:
             hs.mesh_state = "cold"
             chips = hs.chips
         metrics.inc("mesh.shrinks")
+        self._chips_gauge(hs.name, chips)
         events.emit("mesh.shrink", host=hs.name, chips=chips)
         log.warning(
             "[Engine] host %s sub-mesh shrunk to %d chip(s)", hs.name, chips
@@ -1502,9 +1509,19 @@ class VerifyEngine:
             hs.mesh_state = "cold"
             chips = hs.chips
         metrics.inc("mesh.regrows")
+        self._chips_gauge(hs.name, chips)
         events.emit("mesh.regrow", host=hs.name, chips=chips)
         log.info(
             "[Engine] host %s sub-mesh re-grown to %d chip(s)", hs.name, chips
+        )
+
+    @staticmethod
+    def _chips_gauge(host: str, chips: int) -> None:
+        # per-host sub-mesh width as a labeled gauge: the fleet timeline
+        # (tpunode/timeseries.py) samples it, so an 8→4→8 shrink/regrow
+        # is reconstructible after the fact
+        metrics.set_gauge(
+            "mesh.host_chips", float(chips), labels={"host": host}
         )
 
     def _fleet_hybrid_mesh(self):
@@ -1568,6 +1585,7 @@ class VerifyEngine:
                     hs.chips = hs.full_chips
                 hs.mesh = host_submesh(hybrid, hs.index, chips=hs.chips)
                 hs.mesh_state = "ready"
+                self._chips_gauge(hs.name, hs.chips)
                 return hs.mesh
             except Exception as e:
                 hs.mesh_state = "failed"

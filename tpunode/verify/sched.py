@@ -334,6 +334,9 @@ class FleetDispatcher:
         self._active: dict = {h: True for h in hosts}
         self.steals = 0
         self.requeued = 0
+        # per-thief steal totals: the fleet timeline's per-host steal
+        # series (tpunode/timeseries.py) — bounded by the fixed host set
+        self.host_steals: dict = {h: 0 for h in hosts}
 
     # -- intake ---------------------------------------------------------------
 
@@ -443,7 +446,9 @@ class FleetDispatcher:
             return None
         lane = self._queues[victim].popleft()
         self.steals += 1
+        self.host_steals[thief] += 1
         metrics.inc("sched.steals")
+        metrics.inc("sched.host_steals", labels={"host": thief})
         events.emit(
             "sched.steal", thief=thief, victim=victim, items=lane.total,
         )

@@ -66,6 +66,7 @@ class Watchdog:
         self.attributor = attributor
         # stall keys currently in an episode: emit once, re-arm on clear
         self._stalled: set[str] = set()
+        self._last_lag = 0.0  # newest measured loop lag (snapshot())
 
     def add_mailbox(self, mb: Mailbox) -> None:
         self.mailboxes.append(mb)
@@ -76,6 +77,7 @@ class Watchdog:
         """One pass over every stall surface; returns the ``watchdog.stall``
         events emitted this pass (empty on a healthy node)."""
         emitted: list[dict] = []
+        self._last_lag = lag
         metrics.set_gauge("watchdog.loop_lag_seconds", lag)
         metrics.observe("watchdog.loop_lag", lag)
         if lag > self.cfg.lag_threshold:
@@ -130,6 +132,37 @@ class Watchdog:
             else:
                 self._clear("verify_dispatch")
         return emitted
+
+    def snapshot(self) -> dict:
+        """Current state of every stall surface — the flight recorder's
+        ``watchdog`` bundle section (what was stuck, and how stuck, at
+        the moment of the trigger)."""
+        now = time.monotonic()
+        out: dict = {
+            "last_lag_seconds": round(self._last_lag, 4),
+            "stalled": sorted(self._stalled),
+            "mailboxes": [
+                {
+                    "mailbox": mb.name,
+                    "oldest_age_seconds": round(mb.oldest_age(now), 3),
+                    "depth": mb.qsize(),
+                }
+                for mb in self.mailboxes
+            ],
+            "thresholds": {
+                "lag": self.cfg.lag_threshold,
+                "mailbox_age": self.cfg.mailbox_age_threshold,
+                "dispatch_stall": self.cfg.dispatch_stall_threshold,
+            },
+        }
+        if self.engine is not None:
+            out["dispatch_inflight_seconds"] = round(
+                self.engine.dispatch_inflight_seconds(), 3
+            )
+            depth = getattr(self.engine, "dispatch_inflight", None)
+            if depth is not None:
+                out["dispatch_inflight"] = depth()
+        return out
 
     def _stall(self, key: str, **fields) -> list[dict]:
         if key in self._stalled:
