@@ -2009,6 +2009,43 @@ def _worker_observability() -> None:
         t0 = time.perf_counter()
         bundle = recorder.record("bench.observability", force=True)
         build_ms = (time.perf_counter() - t0) * 1e3
+
+        # SLO engine (ISSUE 17): evaluator tick cost (enabled + the
+        # off-switch), and burn-detection latency — how many 1s ticks a
+        # synthetic dispatch stall needs to page against a 100-tick
+        # healthy baseline (deterministic: explicit now= timestamps).
+        from tpunode.events import EventLog
+        from tpunode.slo import SloEvaluator
+
+        def slo_tick_median(ev, base: float, n: int = 300) -> float:
+            xs = []
+            for i in range(n):
+                t0 = time.perf_counter()
+                ev.tick(now=base + i)
+                xs.append(time.perf_counter() - t0)
+            return _stats.median(xs)
+
+        slo_tick_s = slo_tick_median(
+            SloEvaluator(registry=metrics, log_=EventLog(), disabled=False),
+            base=1_000.0,
+        )
+        slo_off_s = slo_tick_median(
+            SloEvaluator(defs=None, registry=metrics, log_=EventLog()),
+            base=2_000.0,
+        )
+        det_log = EventLog()
+        det = SloEvaluator(registry=metrics, log_=det_log, disabled=False)
+        for i in range(100):
+            det.tick(now=50_000.0 + i)  # healthy baseline
+        metrics.set_gauge("watchdog.stalled", 1.0)  # the wedged dispatch
+        det_ticks = 0
+        for i in range(100, 400):
+            det.tick(now=50_000.0 + i)
+            det_ticks += 1
+            if det_log.counts().get("slo.burn"):
+                break
+        metrics.set_gauge("watchdog.stalled", 0.0)
+
         print(
             json.dumps(
                 {
@@ -2021,6 +2058,14 @@ def _worker_observability() -> None:
                     "blackbox": {
                         "build_ms": round(build_ms, 3),
                         "bundle_keys": sorted(bundle or {}),
+                    },
+                    "slo": {
+                        "tick_us_p50": round(slo_tick_s * 1e6, 2),
+                        "disabled_tick_us_p50": round(slo_off_s * 1e6, 4),
+                        "burn_detection": {
+                            "ticks": det_ticks,
+                            "seconds": round(det_ticks * det.interval, 1),
+                        },
                     },
                 }
             )

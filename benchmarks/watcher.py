@@ -146,6 +146,9 @@ MESH_LADDER = (
     (2, 240.0, None),
 )
 CONFIG_BUDGETS = {"config2": 600.0, "config5": 900.0, "config3": 900.0}
+# Observability-overhead slot (ISSUE 16/17): the worker is jax-free and
+# CPU-pinned, so the budget only covers interpreter start + micro-bench.
+OBS_BUDGET = float(os.environ.get("TPUNODE_WATCHER_OBS_BUDGET", 120))
 # Sweep order: config2 is cheap; config3 (full-node IBD on device) is
 # the VERDICT item-2 money shot and must be banked before config5,
 # whose ~150k-sig batch is the slowest compile during an outage.  One
@@ -613,6 +616,25 @@ def run_mesh() -> bool:
     return banked
 
 
+def run_observability() -> bool:
+    """Once-per-round observability-overhead sample (ISSUE 16/17): the
+    bench.py --observability worker's sampler/SLO tick costs and burn-
+    detection latency, passed through as a ``kind="observability"`` row.
+    The worker never imports jax (JAX_PLATFORMS=cpu keeps the TPU shim
+    honest), so unlike the tunnel-client slots this one runs even when
+    the device is down and never needs to yield to bench.py.  A failed
+    worker keeps the slot for a later window."""
+    res = _run_json(
+        [sys.executable, "bench.py", "--observability"],
+        OBS_BUDGET, {"JAX_PLATFORMS": "cpu"},
+    )
+    if res.get("ok"):
+        _record("observability", res)
+        return True
+    _log(f"observability: {res.get('error', '?')}")
+    return False
+
+
 def run_config(name: str) -> dict | None:
     if _bench_running():
         _log(f"{name}: bench.py running — yielding the tunnel")
@@ -804,7 +826,8 @@ def handle_window(swept: set) -> float:
     config sweep, once-per-round affine point-form sample (ISSUE 8),
     once-per-round lazy-reduction sample (ISSUE 12), once-per-round
     pod-mesh sharding sample (ISSUE 13), once-per-round
-    Mosaic diagnostic.  Mutates ``swept``
+    Mosaic diagnostic, once-per-round device-free observability-overhead
+    sample (ISSUE 16/17).  Mutates ``swept``
     (the on-device captures so far this round) and returns the sleep
     interval until the next probe.  Raises FatalMismatch to stop the
     watcher for the round.
@@ -877,6 +900,10 @@ def handle_window(swept: set) -> float:
             # transient failure (e.g. tunnel died mid-diag): keep the
             # once-per-round slot for a later window
             _log(f"mosaic_diag: {diag.get('error', '?')}")
+    # Observability-overhead sample (ISSUE 16/17): once per round,
+    # device-free, so it runs even when the tunnel is down.
+    if "observability" not in swept and run_observability():
+        swept.add("observability")
     # Back off to the slow refresh cadence only once every config is
     # banked: with all of them captured the next window owes us nothing
     # but a headline refresh, but while configs are missing the next

@@ -136,6 +136,11 @@ from tpunode.metrics import metrics
 def record():
     metrics.inc("node.fixture_undocumented")
 """,
+    # stale-doc (ISSUE 17) is doc-anchored, not source-anchored: it runs
+    # once per sweep against OBSERVABILITY.md + the code corpus, so a
+    # source fixture cannot drive it.  Dedicated tests below seed the
+    # doc/corpus caches instead.
+    "stale-doc": None,
 }
 
 
@@ -148,6 +153,8 @@ def test_every_shipped_rule_has_a_fixture():
 
 @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
 def test_rule_fires_exactly_once(rule_id):
+    if FIXTURES[rule_id] is None:
+        pytest.skip(f"{rule_id} is doc-anchored (dedicated tests below)")
     findings = analyze_source(FIXTURES[rule_id], path=f"<{rule_id}>")
     assert [f.rule for f in findings] == [rule_id], findings
     f = findings[0]
@@ -157,6 +164,8 @@ def test_rule_fires_exactly_once(rule_id):
 @pytest.mark.parametrize("rule_id", sorted(FIXTURES))
 def test_rule_suppressed_on_flagged_line(rule_id):
     """The per-line pragma silences exactly the finding on its line."""
+    if FIXTURES[rule_id] is None:
+        pytest.skip(f"{rule_id} is doc-anchored (dedicated tests below)")
     src = FIXTURES[rule_id]
     line = analyze_source(src)[0].line
     lines = src.splitlines()
@@ -468,6 +477,108 @@ def test_doc_drift_new_layers_registered():
     from tpunode.analysis.rules import KNOWN_LAYERS
 
     assert "tsdb" in KNOWN_LAYERS and "blackbox" in KNOWN_LAYERS
+    assert "slo" in KNOWN_LAYERS  # ISSUE 17
+
+
+# --- stale-doc (ISSUE 17): doc-drift's reverse pass --------------------------
+
+# The rule fires once per sweep, anchored on analysis/rules.py; findings
+# carry the DOC's location.  These tests seed the module-level doc and
+# corpus caches the rule reads, so no real files are touched.
+
+_ANCHOR = "tpunode/analysis/rules.py"
+
+
+def _seed_stale_doc(monkeypatch, doc, corpus):
+    from tpunode.analysis import rules
+
+    monkeypatch.setattr(rules, "_obs_doc_cache", [doc])
+    monkeypatch.setattr(rules, "_corpus_cache", [corpus])
+
+
+def _stale_findings(src=""):
+    return [
+        f
+        for f in Analyzer(select=["stale-doc"]).check_source(
+            src, path=_ANCHOR
+        )
+        if f.rule == "stale-doc"
+    ]
+
+
+def test_stale_doc_fires_on_removed_name(monkeypatch):
+    doc = (
+        "# OBSERVABILITY\n"
+        "\n"
+        "Current inventory by layer:\n"
+        "\n"
+        "* **`node.*`**: `node.fixture_gone` (counter).\n"
+    )
+    _seed_stale_doc(monkeypatch, doc, "metrics.inc('node.other')\n")
+    (f,) = _stale_findings()
+    assert f.rule == "stale-doc"
+    assert "node.fixture_gone" in f.message
+    assert f.path.endswith("OBSERVABILITY.md") and f.line == 5
+
+
+def test_stale_doc_clean_when_name_ships(monkeypatch):
+    doc = (
+        "Current inventory by layer:\n"
+        "* **`node.*`**: `node.fixture_alive{peer=}` (labeled counter).\n"
+    )
+    _seed_stale_doc(
+        monkeypatch, doc, "metrics.inc('node.fixture_alive', labels=l)\n"
+    )
+    assert _stale_findings() == []
+
+
+def test_stale_doc_covers_events_table_and_span_rows(monkeypatch):
+    """Pipe-table rows with a backticked first cell are inventory too,
+    and `span.<layer>.<name>` rows match the bare span(...) literal."""
+    doc = (
+        "| type | fields |\n"
+        "|---|---|\n"
+        "| `node.fixture_event` | `x` |\n"
+        "\n"
+        "Current inventory by layer:\n"
+        "* `span.node.fixture_phase` (histogram).\n"
+    )
+    _seed_stale_doc(
+        monkeypatch, doc,
+        "log.emit('node.fixture_event')\nspan('node.fixture_phase')\n",
+    )
+    assert _stale_findings() == []
+    _seed_stale_doc(monkeypatch, doc, "nothing_here = 1\n")
+    assert {
+        f.message.split("'")[1] for f in _stale_findings()
+    } == {"node.fixture_event", "span.node.fixture_phase"}
+
+
+def test_stale_doc_suppressible_per_doc_row(monkeypatch):
+    doc = (
+        "Current inventory by layer:\n"
+        "* `node.fixture_dynamic` (built at runtime) "
+        "<!-- # asyncsan: disable=stale-doc -->\n"
+    )
+    _seed_stale_doc(monkeypatch, doc, "nothing_here = 1\n")
+    assert _stale_findings() == []
+
+
+def test_stale_doc_only_fires_on_its_anchor_file(monkeypatch):
+    """One sweep, one pass: the rule is anchored on analysis/rules.py and
+    stays silent for every other analyzed file."""
+    doc = (
+        "Current inventory by layer:\n"
+        "* `node.fixture_gone` (counter).\n"
+    )
+    _seed_stale_doc(monkeypatch, doc, "nothing_here = 1\n")
+    out = Analyzer(select=["stale-doc"]).check_source("", path="other.py")
+    assert out == []
+
+
+def test_stale_doc_missing_doc_disables(monkeypatch):
+    _seed_stale_doc(monkeypatch, None, "nothing_here = 1\n")
+    assert _stale_findings() == []
 
 
 def test_syntax_error_is_a_finding_not_a_crash():

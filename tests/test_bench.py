@@ -143,6 +143,8 @@ _OBS_OK = {
                  "bundle_keys": ["chaos", "event_counts", "events",
                                  "fleet_history", "path", "reason",
                                  "timeline", "traces", "trigger", "ts"]},
+    "slo": {"tick_us_p50": 52.0, "disabled_tick_us_p50": 0.3,
+            "burn_detection": {"ticks": 7, "seconds": 7.0}},
 }
 
 # Canned healthy chaos-resilience result (the real subprocess path is
@@ -882,6 +884,11 @@ def test_observability_section_always_present(monkeypatch):
     assert obs["sampler"]["disabled_tick_us_p50"] < obs["sampler"]["tick_us_p50"]
     assert obs["blackbox"]["build_ms"] > 0
     assert "timeline" in obs["blackbox"]["bundle_keys"]
+    # ISSUE 17: the SLO engine's costs ride the same section
+    assert obs["slo"]["tick_us_p50"] > 0
+    assert obs["slo"]["disabled_tick_us_p50"] < obs["slo"]["tick_us_p50"]
+    assert obs["slo"]["burn_detection"]["ticks"] >= 1
+    assert obs["slo"]["burn_detection"]["seconds"] > 0
 
 
 def test_observability_section_worker_env_is_device_free(monkeypatch):
@@ -946,6 +953,13 @@ def test_observability_worker_subprocess():
     assert line["blackbox"]["build_ms"] > 0
     assert {"reason", "events", "timeline", "fleet_history", "chaos",
             "traces", "trigger"} <= set(line["blackbox"]["bundle_keys"])
+    # ISSUE 17: SLO evaluator costs + synthetic burn-detection latency.
+    # 6 SLOs against live gauges/histograms must evaluate well inside
+    # the same 1.5ms tick budget; the off switch stays ~free.
+    assert 0 < line["slo"]["tick_us_p50"] < 1500.0
+    assert line["slo"]["disabled_tick_us_p50"] < 50.0
+    det = line["slo"]["burn_detection"]
+    assert det["ticks"] >= 1 and det["seconds"] == det["ticks"] * 1.0
 
 
 def test_mesh_worker_subprocess():
@@ -1757,12 +1771,14 @@ def _setup_window(monkeypatch, W, head, why, mosaic=False):
         lambda argv, t, env=None: diags.append(argv) or {"cases": ["x"]},
     )
     monkeypatch.setattr(W, "_record", lambda k, p: recs.append(k))
-    # the once-per-round affine (ISSUE 8), lazy (ISSUE 12) and mesh
-    # (ISSUE 13) samples have their own tests; stub them here so the
-    # diag/config call counts these scenarios pin stay exact
+    # the once-per-round affine (ISSUE 8), lazy (ISSUE 12), mesh
+    # (ISSUE 13) and observability (ISSUE 17) samples have their own
+    # tests; stub them here so the diag/config call counts these
+    # scenarios pin stay exact
     monkeypatch.setattr(W, "run_affine", lambda: False)
     monkeypatch.setattr(W, "run_lazy", lambda: False)
     monkeypatch.setattr(W, "run_mesh", lambda: False)
+    monkeypatch.setattr(W, "run_observability", lambda: False)
     return configs, diags, recs
 
 

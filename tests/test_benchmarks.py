@@ -482,6 +482,7 @@ def test_handle_window_skips_upgrade_after_pallas_failure(monkeypatch):
     monkeypatch.setattr(watcher, "run_affine", lambda: False)
     monkeypatch.setattr(watcher, "run_lazy", lambda: False)
     monkeypatch.setattr(watcher, "run_mesh", lambda: False)
+    monkeypatch.setattr(watcher, "run_observability", lambda: False)
     upgrade_calls = []
 
     def fake_run_headline(pallas_only=False):
@@ -539,6 +540,45 @@ def test_run_affine_banks_kind_affine(monkeypatch, tmp_path):
     assert watcher.run_affine() is True
     assert len(calls) == 1
     assert calls[0].get("TPUNODE_BENCH_KERNEL") == "xla"
+
+
+def test_run_observability_banks_passthrough_row(monkeypatch, tmp_path):
+    """ISSUE 17 satellite: the once-per-round observability slot passes
+    the worker's JSON through as a ``kind="observability"`` row (slo
+    keys included), pins the worker to the CPU platform, and keeps the
+    slot for a later window on failure."""
+    watcher = _load_watcher()
+    runs = tmp_path / "runs.jsonl"
+    monkeypatch.setattr(watcher, "RUNS_PATH", str(runs))
+    calls = []
+    ok = {
+        "ok": True,
+        "sampler": {"tick_us_p50": 88.0, "disabled_tick_us_p50": 0.2,
+                    "series": 128},
+        "blackbox": {"build_ms": 5.1, "bundle_keys": ["reason"]},
+        "slo": {"tick_us_p50": 52.0, "disabled_tick_us_p50": 0.3,
+                "burn_detection": {"ticks": 7, "seconds": 7.0}},
+    }
+
+    def fake_run_json(argv, timeout, env=None):
+        calls.append((argv, env or {}))
+        return dict(ok)
+
+    monkeypatch.setattr(watcher, "_run_json", fake_run_json)
+    assert watcher.run_observability() is True
+    ((argv, env),) = calls
+    assert argv[-1] == "--observability"
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    rows = [json.loads(line) for line in open(runs)]
+    assert [r["kind"] for r in rows] == ["observability"]
+    assert rows[0]["slo"]["burn_detection"]["ticks"] == 7
+
+    # a failed worker banks nothing: the once-per-round slot survives
+    monkeypatch.setattr(
+        watcher, "_run_json", lambda *a, **k: {"ok": False, "error": "boom"}
+    )
+    assert watcher.run_observability() is False
+    assert sum(1 for _ in open(runs)) == 1
 
 
 def test_run_affine_pallas_failure_does_not_degrade_headline(

@@ -248,6 +248,57 @@ async def test_fleet_and_flightrecords_endpoints():
 
 
 @pytest.mark.asyncio
+async def test_index_route_catalogs_endpoints():
+    """ISSUE 17 satellite: GET / returns the endpoint catalog as JSON so a
+    human (or probe) landing on the port discovers the surface without
+    reading source."""
+    async with DebugServer(port=0, registry=Metrics(disabled=False)) as srv:
+        status, headers, body = await _get(srv.port, "/")
+        assert status == 200
+        assert headers["content-type"].startswith("application/json")
+        got = json.loads(body)
+        assert got["server"] == "tpunode-debugsrv"
+        endpoints = got["endpoints"]
+        assert isinstance(endpoints, dict)
+        for route in ("/metrics", "/health", "/slo", "/flightrecords?n="):
+            assert route in endpoints
+            assert isinstance(endpoints[route], str) and endpoints[route]
+        # the catalog and the 404 hint list agree
+        status, _, body = await _get(srv.port, "/nope")
+        assert status == 404
+        assert json.loads(body)["endpoints"] == list(endpoints)
+
+
+@pytest.mark.asyncio
+async def test_slo_endpoint():
+    """/slo serves the evaluator snapshot; without one (slos=None or the
+    off switch) it reports {"enabled": false}."""
+    from tpunode.events import EventLog as _EL
+    from tpunode.slo import SloEvaluator
+
+    reg = Metrics(disabled=False)
+    ev = SloEvaluator(registry=reg, log_=_EL())
+    ev.tick(now=100.0)
+    async with DebugServer(
+        port=0, registry=reg, slo=ev.snapshot
+    ) as srv:
+        status, headers, body = await _get(srv.port, "/slo")
+        assert status == 200
+        assert headers["content-type"].startswith("application/json")
+        got = json.loads(body)
+        assert got["enabled"] is True and got["ticks"] == 1
+        names = [s["definition"]["name"] for s in got["slos"]]
+        assert len(names) == len(set(names))
+        assert "verdict-latency-block" in names
+        assert "dispatch-stall" in names
+
+    async with DebugServer(port=0, registry=reg) as srv:
+        status, _, body = await _get(srv.port, "/slo")
+        assert status == 200
+        assert json.loads(body) == {"enabled": False}
+
+
+@pytest.mark.asyncio
 async def test_non_get_rejected_and_garbage_ignored():
     async with DebugServer(port=0, registry=Metrics(disabled=False)) as srv:
         reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)

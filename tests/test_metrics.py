@@ -230,6 +230,8 @@ def test_render_prometheus_parses():
     lines = text.strip().split("\n")
     types = {}
     for line in lines:
+        if line.startswith("# HELP "):
+            continue  # described families (ISSUE 17) — pinned below
         if line.startswith("# TYPE "):
             _, _, name, kind = line.split(" ")
             types[name] = kind
@@ -255,6 +257,56 @@ def test_render_prometheus_parses():
     assert float(sum_line.split(" ")[1]) == pytest.approx(0.03)
     # label values with special characters are escaped, not mangled
     assert 'peer="[::1]:1"' in text
+
+
+def test_render_prometheus_help_lines():
+    """ISSUE 17 satellite: families registered via describe() get a
+    `# HELP` line immediately before their `# TYPE`; first registration
+    wins, undescribed families emit none, and help text is escaped per
+    exposition-format 0.0.4 (backslash and newline)."""
+    m = Metrics(disabled=False)
+    m.describe("node.verdict_latency", "submit->verdict latency\nback\\slash")
+    m.describe("node.verdict_latency", "a later registration loses")
+    m.observe("node.verdict_latency", 0.01, labels={"priority": "block"})
+    m.inc("bus.dropped")  # never described: no HELP line
+    lines = m.render_prometheus().strip().split("\n")
+    idx = lines.index(
+        "# HELP tpunode_node_verdict_latency "
+        "submit->verdict latency\\nback\\\\slash"
+    )
+    assert lines[idx + 1].startswith("# TYPE tpunode_node_verdict_latency ")
+    assert not any(l.startswith("# HELP tpunode_bus_dropped") for l in lines)
+    # describe() works while recording is disabled (module import happens
+    # before any enablement decision) and survives reset()
+    d = Metrics(disabled=True)
+    d.describe("bus.dropped", "messages dropped at a full mailbox")
+    d.disabled = False
+    d.inc("bus.dropped")
+    assert "# HELP tpunode_bus_dropped " in d.render_prometheus()
+    m.reset()
+    m.observe("node.verdict_latency", 0.01)
+    assert "# HELP tpunode_node_verdict_latency " in m.render_prometheus()
+
+
+def test_histogram_count_le():
+    """count_le is exact on bucket boundaries (what the SLO engine's
+    latency objectives read) and conservative between them."""
+    from tpunode.metrics import DEFAULT_BUCKETS, Histogram
+
+    h = Histogram()
+    h.observe(DEFAULT_BUCKETS[3])  # lands in bucket 3 ((b2, b3])
+    h.observe(DEFAULT_BUCKETS[3] * 1.5)  # bucket 4
+    h.observe(DEFAULT_BUCKETS[10])  # bucket 10
+    assert h.count_le(DEFAULT_BUCKETS[3]) == 1
+    assert h.count_le(DEFAULT_BUCKETS[4]) == 2
+    assert h.count_le(DEFAULT_BUCKETS[9]) == 2
+    assert h.count_le(DEFAULT_BUCKETS[10]) == 3
+    assert h.count_le(0.0) == 0
+    # a non-boundary bound rounds down to the buckets fully at/under it
+    assert h.count_le(DEFAULT_BUCKETS[3] * 1.2) == 1
+    # beyond the last bound: everything, including overflow observations
+    h.observe(DEFAULT_BUCKETS[-1] * 10)
+    assert h.count_le(float("inf")) == 4
 
 
 def test_render_prometheus_no_duplicate_sample_names():

@@ -1,0 +1,390 @@
+"""SLO engine tests (ISSUE 17): declarative objectives, multi-window
+burn-rate math on explicit timestamps (no wall sleeps), the chaos
+acceptance (a seeded engine.dispatch stall plan burning the budget into
+exactly one flight-recorder bundle), the per-class latency + cost-ledger
+conservation pins, and the off-switch micro-bench."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from tpunode.chaos import ChaosPlan, chaos
+from tpunode.events import EventLog
+from tpunode.metrics import Metrics, metrics
+from tpunode.slo import (
+    DEFAULT_SLOS,
+    FAST_BURN,
+    FAST_WINDOW,
+    SLOW_BURN,
+    SloDef,
+    SloEvaluator,
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _burn_events(log: EventLog) -> list[dict]:
+    return [e for e in log.tail(200) if e["type"] == "slo.burn"]
+
+
+# -- SloDef -------------------------------------------------------------------
+
+
+def test_slodef_validation():
+    with pytest.raises(ValueError, match="unknown SLO kind"):
+        SloDef("x", "latencyy")
+    with pytest.raises(ValueError, match="objective"):
+        SloDef("x", "stall", objective=1.0)
+    with pytest.raises(ValueError, match="objective"):
+        SloDef("x", "stall", objective=0.0)
+    # latency kind needs a positive threshold AND a priority label
+    with pytest.raises(ValueError, match="threshold"):
+        SloDef("x", "latency", priority="block")
+    with pytest.raises(ValueError, match="threshold"):
+        SloDef("x", "latency", threshold=0.5)
+    d = SloDef("x", "latency", threshold=0.5, priority="block")
+    desc = d.describe()
+    assert desc["threshold_seconds"] == 0.5 and desc["priority"] == "block"
+    # non-latency kinds don't carry latency-only fields
+    assert "threshold_seconds" not in SloDef("y", "stall").describe()
+
+
+def test_default_slos_shape():
+    names = [d.name for d in DEFAULT_SLOS]
+    assert len(names) == len(set(names))
+    kinds = {d.kind for d in DEFAULT_SLOS}
+    assert kinds == {"latency", "stall", "breaker"}
+    # one latency SLO per priority class, thresholds on 2**n µs bucket
+    # boundaries so histogram counts are exact
+    lat = {d.priority: d for d in DEFAULT_SLOS if d.kind == "latency"}
+    assert set(lat) == {"block", "mempool", "ibd", "bulk"}
+    for d in lat.values():
+        n = d.threshold / 1e-6
+        assert abs(n - 2 ** round(__import__("math").log2(n))) < 1e-9
+    # the priority ladder loosens monotonically down-class
+    assert (
+        lat["block"].threshold
+        < lat["mempool"].threshold
+        < lat["ibd"].threshold
+        < lat["bulk"].threshold
+    )
+
+
+def test_duplicate_names_rejected():
+    defs = (SloDef("dup", "stall"), SloDef("dup", "breaker"))
+    with pytest.raises(ValueError, match="duplicate"):
+        SloEvaluator(defs, registry=Metrics(disabled=False), log_=EventLog())
+
+
+# -- burn-rate math (explicit now=, no sleeps) --------------------------------
+
+
+def _latency_eval(objective=0.99):
+    reg = Metrics(disabled=False)
+    log = EventLog()
+    d = SloDef(
+        "lat-block", "latency", objective=objective,
+        threshold=1e-6 * 2**19, priority="block",
+    )
+    ev = SloEvaluator((d,), registry=reg, log_=log, disabled=False)
+    return reg, log, ev
+
+
+def test_burn_episode_latching_and_rearm():
+    reg, log, ev = _latency_eval()
+    t0 = 1000.0
+
+    # healthy traffic: 200 under-threshold observations, no burn
+    for _ in range(200):
+        reg.observe("node.verdict_latency", 1e-3, labels={"priority": "block"})
+    assert ev.tick(now=t0) == 1
+    assert _burn_events(log) == [] and ev.burning() == []
+
+    # 50 bad observations: bad frac 0.2 / budget 0.01 = burn 20 — over
+    # both page thresholds, so ONE event per (slo, window) episode
+    for _ in range(50):
+        reg.observe("node.verdict_latency", 2.0, labels={"priority": "block"})
+    ev.tick(now=t0 + 1)
+    evs = _burn_events(log)
+    assert [(e["slo"], e["window"]) for e in evs] == [
+        ("lat-block", "fast"), ("lat-block", "slow"),
+    ]
+    fast = evs[0]
+    assert fast["bad"] == 50 and fast["total"] == 250
+    assert fast["burn"] == 20.0 and fast["threshold"] == FAST_BURN
+    assert fast["objective"] == 0.99
+    assert evs[1]["threshold"] == SLOW_BURN
+    assert ev.burning("fast") == ["lat-block"]
+    assert ev.burning("slow") == ["lat-block"]
+    assert reg.get(
+        "slo.burn_rate", labels={"slo": "lat-block", "window": "fast"}
+    ) == 20.0
+    assert reg.get("slo.burns", labels={"slo": "lat-block", "window": "fast"}) == 1
+
+    # latched: further burning ticks re-emit NOTHING
+    ev.tick(now=t0 + 2)
+    ev.tick(now=t0 + 3)
+    assert len(_burn_events(log)) == 2
+
+    # the bad samples age out of the fast window -> fast episode re-arms
+    ev.tick(now=t0 + 3 + FAST_WINDOW + 60)
+    assert ev.burning("fast") == []
+    assert ev.burning("slow") == ["lat-block"]  # 1h window still holds them
+
+    # a fresh bad burst starts a NEW fast episode (slow stays latched)
+    for _ in range(50):
+        reg.observe("node.verdict_latency", 2.0, labels={"priority": "block"})
+    ev.tick(now=t0 + 4 + FAST_WINDOW + 60)
+    evs = _burn_events(log)
+    assert len(evs) == 3
+    assert evs[-1]["window"] == "fast" and evs[-1]["slo"] == "lat-block"
+
+
+def test_stall_and_breaker_kinds_sample_gauges():
+    reg = Metrics(disabled=False)
+    log = EventLog()
+    defs = (
+        SloDef("stall", "stall", objective=0.99),
+        SloDef("breaker", "breaker", objective=0.99),
+    )
+    ev = SloEvaluator(defs, registry=reg, log_=log, disabled=False)
+    t0 = 5000.0
+    for i in range(5):  # healthy ticks: gauges at 0 / ready
+        ev.tick(now=t0 + i)
+    assert _burn_events(log) == []
+
+    # one stalled tick among few total = burn far over both thresholds
+    reg.set_gauge("watchdog.stalled", 1.0)
+    ev.tick(now=t0 + 5)
+    evs = _burn_events(log)
+    assert {(e["slo"], e["window"]) for e in evs} == {
+        ("stall", "fast"), ("stall", "slow"),
+    }
+
+    # breaker: probing (3.0) is NOT open and spends no budget; open (2.0) is
+    reg.set_gauge("watchdog.stalled", 0.0)
+    reg.set_gauge("verify.breaker_state", 3.0)
+    ev.tick(now=t0 + 6)
+    assert ev.burning("fast") == []  # stall re-armed, probing is good
+    reg.set_gauge("verify.breaker_state", 2.0)
+    ev.tick(now=t0 + 7)  # 1 open tick of 8: burn 12.5, still under 14.4
+    assert ev.burning("fast") == []
+    assert "breaker" in ev.burning("slow")  # ...but over the slow 6.0
+    ev.tick(now=t0 + 8)  # 2 of 9: burn 22.2 pages the fast window too
+    assert "breaker" in ev.burning("fast")
+
+
+def test_snapshot_shape_and_ledger_passthrough():
+    reg, log, ev = _latency_eval()
+    ev.ledger = lambda: {"busy_seconds": 1.0}
+    for _ in range(10):
+        reg.observe("node.verdict_latency", 1e-3, labels={"priority": "block"})
+    ev.tick(now=100.0)
+    snap = ev.snapshot()
+    assert snap["enabled"] is True and snap["ticks"] == 1
+    assert snap["windows"]["fast"] == {
+        "seconds": FAST_WINDOW, "burn": FAST_BURN,
+    }
+    (s,) = snap["slos"]
+    assert s["definition"]["name"] == "lat-block"
+    assert s["good"] == 10 and s["bad"] == 0
+    assert s["budget_remaining"] == 1.0 and s["burning"] == []
+    assert set(s["burn"]) == {"fast", "slow"}
+    assert snap["burn_history"] == []
+    assert snap["ledger"] == {"busy_seconds": 1.0}
+    # a broken ledger provider degrades, never raises
+    ev.ledger = lambda: 1 / 0
+    assert "error" in ev.snapshot()["ledger"]
+
+
+# -- the off switch -----------------------------------------------------------
+
+
+def test_off_switch_env_and_none(monkeypatch):
+    reg = Metrics(disabled=False)
+    monkeypatch.setenv("TPUNODE_NO_SLO", "1")
+    ev = SloEvaluator(registry=reg, log_=EventLog())
+    assert ev.disabled and ev.tick() == 0
+    monkeypatch.delenv("TPUNODE_NO_SLO")
+    ev2 = SloEvaluator(defs=None, registry=reg, log_=EventLog())
+    assert ev2.disabled and ev2.tick() == 0
+    assert ev2.snapshot()["enabled"] is False
+    # explicit kwarg wins over everything
+    ev3 = SloEvaluator(registry=reg, log_=EventLog(), disabled=True)
+    assert ev3.tick() == 0
+
+
+def test_off_tick_overhead_micro():
+    """The acceptance bar (chaos-off style): a disabled tick is one
+    attribute read + return.  Early-exits on the first clean batch."""
+    ev = SloEvaluator(
+        defs=None, registry=Metrics(disabled=False), log_=EventLog()
+    )
+    assert ev.disabled
+
+    def one_batch(n=5000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ev.tick()
+        return (time.perf_counter() - t0) / n
+
+    one_batch(500)  # warm caches
+    best = min(one_batch() for _ in range(3))
+    attempts = 0
+    while best >= 5e-6 and attempts < 20:
+        attempts += 1
+        best = min(best, one_batch())
+    assert best < 5e-6, f"disabled tick {best * 1e6:.2f}µs >= 5µs"
+
+
+# -- chaos acceptance ---------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_chaos_stall_burns_into_flight_bundle():
+    """The PR's acceptance scenario: a seeded engine.dispatch stall plan
+    (a wedged backend) pushes block-class verdict latency over a tight
+    objective; the evaluator emits exactly one slo.burn per (slo, window)
+    episode, and the flight recorder banks exactly ONE bundle (the slow-
+    window event lands inside min_interval and is suppressed) whose slo
+    section carries definitions, budgets, burn history and the cost
+    ledger."""
+    from tpunode.blackbox import FlightRecorder, FlightRecorderConfig
+    from tpunode.verify.engine import VerifyConfig, VerifyEngine
+
+    from tests.test_engine import make_items
+
+    metrics.reset()
+    log = EventLog()
+    # tight block objective: 2**12 µs (~4.1 ms) so a 50 ms injected stall
+    # is unambiguously over threshold without slow wall sleeps
+    tight = SloDef(
+        "verdict-latency-block", "latency", objective=0.99,
+        threshold=1e-6 * 2**12, priority="block",
+        description="block-class submit->verdict latency (test-tight)",
+    )
+    chaos.install(ChaosPlan.parse("seed=7;engine.dispatch:stall:dur=0.05"))
+    async with VerifyEngine(
+        VerifyConfig(backend="oracle", max_wait=0.0)
+    ) as eng:
+        ev = SloEvaluator(
+            (tight,), registry=metrics, log_=log, ledger=eng.ledger,
+        )
+        rec = FlightRecorder(
+            FlightRecorderConfig(dir=None),  # default min_interval: 30s
+            log_=log,
+            sources={"slo": ev.snapshot},
+        )
+        rec.attach()
+        try:
+            items, expected = make_items(4, tamper_every=2)
+            for _ in range(3):
+                got = await eng.verify(items, priority="block")
+                assert got == expected  # verdicts survive the stalls
+            ev.tick(now=1000.0)
+            ev.tick(now=1001.0)  # latched: no second event per episode
+        finally:
+            rec.detach()
+            chaos.uninstall()
+        ledger = eng.ledger()
+
+    assert chaos.stats()["enabled"] is False
+    # exactly one slo.burn per episode: fast then slow, then silence
+    evs = _burn_events(log)
+    assert [(e["slo"], e["window"]) for e in evs] == [
+        ("verdict-latency-block", "fast"),
+        ("verdict-latency-block", "slow"),
+    ]
+    assert all(e["bad"] == 3 and e["total"] == 3 for e in evs)
+
+    # exactly ONE bundle: the fast event triggered it, the slow event
+    # 0 s later fell inside min_interval
+    st = rec.stats()
+    assert st["dumps"] == 1 and st["suppressed"] == 1
+    (bundle,) = rec.records()
+    assert bundle["reason"] == "slo.burn"
+    assert bundle["trigger"]["slo"] == "verdict-latency-block"
+    assert bundle["trigger"]["window"] == "fast"
+
+    # the bundle's slo section, field by field
+    slo = bundle["slo"]
+    assert slo["enabled"] is True
+    (s,) = slo["slos"]
+    assert s["definition"] == tight.describe()
+    assert s["bad"] == 3 and s["good"] == 0
+    assert s["budget_remaining"] == 0.0
+    assert s["burning"] == ["fast", "slow"] or s["burning"] == ["fast"]
+    (h,) = slo["burn_history"]  # built inline during the FAST emit
+    assert h["slo"] == "verdict-latency-block" and h["window"] == "fast"
+    assert h["burn"] >= FAST_BURN and h["bad"] == 3 and h["total"] == 3
+    led = slo["ledger"]
+    assert led["busy_seconds"] >= 3 * 0.05  # three stalled dispatches
+    assert "block" in led["by_class"]
+    assert led["by_class"]["block"]["items"] == 12
+
+    # conservation pin: charged == busy within 5%
+    assert ledger["charged_seconds"] == pytest.approx(
+        ledger["busy_seconds"], rel=0.05
+    )
+
+
+# -- per-class latency + ledger conservation (satellite d) --------------------
+
+
+@pytest.mark.asyncio
+async def test_per_class_latency_and_ledger_conservation():
+    """Mixed block+mempool+bulk traffic through a depth-2 pipeline: every
+    class's node.verdict_latency histogram is populated, the priority
+    ladder shows up in the medians (block <= bulk), and the cost ledger
+    charged every class while conserving busy seconds."""
+    from tpunode.verify.engine import VerifyConfig, VerifyEngine
+
+    from tests.test_engine import make_items
+
+    metrics.reset()
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="oracle", max_wait=0.0, batch_size=32, pipeline_depth=2
+        )
+    ) as eng:
+        bulk_items, bulk_exp = make_items(128, tamper_every=8)
+        mp_items, mp_exp = make_items(32, tamper_every=4)
+        blk_items, blk_exp = make_items(16, tamper_every=2)
+        # bulk backlog enqueued FIRST; block still jumps the queue
+        got_bulk, got_mp, got_blk = await asyncio.gather(
+            eng.verify(bulk_items, priority="bulk"),
+            eng.verify(mp_items, priority="mempool"),
+            eng.verify(blk_items, priority="block"),
+        )
+        assert got_bulk == bulk_exp
+        assert got_mp == mp_exp
+        assert got_blk == blk_exp
+        ledger = eng.ledger()
+
+    meds = {}
+    for p in ("block", "mempool", "bulk"):
+        h = metrics.histogram("node.verdict_latency", labels={"priority": p})
+        assert h is not None and h.count > 0, f"no latency for {p}"
+        meds[p] = h.quantile(0.5)
+    # the priority ladder: live block work never waits behind bulk
+    assert meds["block"] <= meds["bulk"]
+
+    # ledger: every class charged, items exact, conservation within 5%
+    by_class = ledger["by_class"]
+    assert set(by_class) >= {"block", "mempool", "bulk"}
+    assert by_class["block"]["items"] == 16
+    assert by_class["mempool"]["items"] == 32
+    assert by_class["bulk"]["items"] == 128
+    assert 0.999 <= sum(c["share"] for c in by_class.values()) <= 1.001
+    assert ledger["charged_seconds"] == pytest.approx(
+        ledger["busy_seconds"], rel=0.05
+    )
+    assert ledger["busy_seconds"] > 0.0

@@ -12,8 +12,9 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 
-from .core import FileContext, NAME_SCHEMA_RE, rule
+from .core import FileContext, Finding, NAME_SCHEMA_RE, rule
 
 # --- blocking-call -----------------------------------------------------------
 
@@ -487,6 +488,8 @@ KNOWN_LAYERS = frozenset({
     "peermgr",    # fleet manager (tpunode/peermgr.py)
     "sched",      # lane-packing verify scheduler (tpunode/verify/sched.py,
                   # ISSUE 10; incl. the node-side extract ring gauges)
+    "slo",        # SLO engine: burn rates + budgets (tpunode/slo.py,
+                  # ISSUE 17)
     "store",      # KV store (tpunode/store.py)
     "trace",      # tracing internals (tpunode/tracectx.py)
     "tsdb",       # metrics timeline sampler (tpunode/timeseries.py,
@@ -654,3 +657,121 @@ def _doc_drift(ctx: FileContext) -> None:
                 f"telemetry name {name!r} is not documented in "
                 "OBSERVABILITY.md (add an inventory row)",
             )
+
+
+# --- stale-doc ---------------------------------------------------------------
+
+# doc-drift's reverse pass (ISSUE 17): an OBSERVABILITY.md inventory row
+# whose name no code literal ships anymore is a lie dashboards are still
+# being read against.  The scan is scoped to the regions that CLAIM to be
+# an inventory — the "Current inventory by layer" bullet list and the
+# pipe-table rows whose first cell is backticked (the events/pieces
+# tables) — so prose elsewhere in the doc cannot false-positive.
+
+_DOC_TOKEN_RE = re.compile(r"`([^`]+)`")
+# Same pragma as core's, re-parsed here for MARKDOWN rows: the doc form
+# lives in an HTML comment (`<!-- # asyncsan: disable=stale-doc -->`),
+# so the token list must stop at whitespace rather than swallowing the
+# comment terminator's hyphens.
+_DOC_PRAGMA_RE = re.compile(r"#\s*asyncsan:\s*disable=([A-Za-z0-9_\-,]+)")
+
+# Repo root relative to this file; the code corpus the doc is checked
+# against is every .py under tpunode/ and benchmarks/ plus the driver.
+_REPO_ROOT = os.path.normpath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+_corpus_cache: list = []  # [str] once loaded (concatenated sources)
+
+
+def _code_corpus() -> str:
+    if not _corpus_cache:
+        paths = [os.path.join(_REPO_ROOT, "bench.py")]
+        for top in ("tpunode", "benchmarks"):
+            for root, dirs, names in os.walk(os.path.join(_REPO_ROOT, top)):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                paths.extend(
+                    os.path.join(root, f)
+                    for f in sorted(names)
+                    if f.endswith(".py")
+                )
+        chunks = []
+        for path in paths:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    chunks.append(f.read())
+            except OSError:
+                pass
+        _corpus_cache.append("\n".join(chunks))
+    return _corpus_cache[0]
+
+
+def _doc_documented_names(doc: str):
+    """Yield ``(lineno, line, name)`` for every schema-valid telemetry
+    name the doc's inventory regions commit to.  Labeled forms are
+    stripped at ``{`` (``peer.msgs{peer=,cmd=}`` documents ``peer.msgs``)
+    and ``.py`` path tokens are skipped (module tables, not telemetry)."""
+    inventory = False
+    for lineno, line in enumerate(doc.splitlines(), start=1):
+        stripped = line.strip()
+        if stripped.startswith("Current inventory by layer"):
+            inventory = True
+            continue
+        if inventory and stripped.startswith("## "):
+            inventory = False
+        if not inventory and not stripped.startswith("| `"):
+            continue
+        for token in _DOC_TOKEN_RE.findall(line):
+            name = token.split("{", 1)[0]
+            if name.endswith(".py") or not NAME_SCHEMA_RE.match(name):
+                continue
+            yield lineno, line, name
+
+
+@rule(
+    "stale-doc",
+    "OBSERVABILITY.md inventory row names a telemetry series no code "
+    "literal ships anymore (delete the row, or suppress the row with "
+    "`<!-- # asyncsan: disable=stale-doc -->` if it is intentional)",
+)
+def _stale_doc(ctx: FileContext) -> None:
+    """Runs once per analysis (anchored on this file, which every full
+    tree sweep includes) rather than per analyzed file.  Findings carry
+    the DOC's path+line, so they are appended directly instead of going
+    through ctx.report — per-row suppression is the pragma on the doc
+    row itself, not on any Python line."""
+    if not ctx.path.replace(os.sep, "/").endswith("analysis/rules.py"):
+        return
+    doc = _observability_text()
+    if doc is None:
+        return
+    corpus = _code_corpus()
+    seen: set[str] = set()
+    for lineno, line, name in _doc_documented_names(doc):
+        if name in seen:
+            continue
+        seen.add(name)
+        m = _DOC_PRAGMA_RE.search(line)
+        if m is not None:
+            ids = {t.strip().rstrip("-") for t in m.group(1).split(",")}
+            if "all" in ids or "stale-doc" in ids:
+                continue
+        # span-histogram rows document the landed name; the literal at
+        # the call site is the bare span("<layer>.<name>") argument
+        bare = name[len("span."):] if name.startswith("span.") else name
+        if name in corpus or bare in corpus:
+            continue
+        ctx.findings.append(
+            Finding(
+                rule="stale-doc",
+                path=os.path.normpath(_OBS_DOC_PATH),
+                line=lineno,
+                col=0,
+                message=(
+                    f"documented telemetry name {name!r} no longer "
+                    "appears as a code literal (stale inventory row)"
+                ),
+            )
+        )

@@ -15,9 +15,12 @@ event fires, freezes everything an operator would wish they had:
 * chaos-injection stats (so a chaos-driven incident is self-describing).
 
 Triggers: ``watchdog.stall``, ``mesh.host_down``, ``store.corruption``,
-``utxo.error``, ``asyncsan.task_leak``, a circuit breaker opening
-(``verify.breaker`` with ``to="open"``), and — via an explicit
-:meth:`record` call from ``Node.__aexit__`` — an unclean shutdown.
+``utxo.error``, ``asyncsan.task_leak``, ``slo.burn`` (an error-budget
+burn-rate breach, ISSUE 17 — the bundle's ``slo`` source carries the
+breached definition, budgets, burn history and cost ledger), a circuit
+breaker opening (``verify.breaker`` with ``to="open"``), and — via an
+explicit :meth:`record` call from ``Node.__aexit__`` — an unclean
+shutdown.
 
 Bundles are **rate-limited** (``min_interval``, default 30s): an incident
 storm produces one bundle plus a ``blackbox.suppressed`` count, never a
@@ -58,6 +61,7 @@ TRIGGERS = frozenset(
         "store.corruption",
         "utxo.error",
         "asyncsan.task_leak",
+        "slo.burn",
     }
 )
 

@@ -30,7 +30,10 @@ point                     actions
                           ``match`` selects the window: ``snapshot``,
                           ``pre_replace``, ``post_replace``, ``cleanup``)
 ``engine.dispatch``       ``error`` (batch failure), ``device_loss``
-                          (raise ChaosDeviceLoss — the breaker's signal)
+                          (raise ChaosDeviceLoss — the breaker's
+                          signal), ``stall`` (sleep ``dur`` in the
+                          dispatch worker thread — a wedged backend;
+                          the SLO engine's synthetic burn source)
 ``engine.warmup``         ``error`` (device warmup/compile failure)
 ``mesh.dispatch``         ``error``, ``device_loss`` (one host's chip/
                           sub-mesh fails — that host's breaker degrades
@@ -76,6 +79,7 @@ import logging
 import os
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -127,7 +131,7 @@ POINTS: dict[str, tuple[str, ...]] = {
     "store.append": ("error", "torn_write", "bit_flip", "crash"),
     "store.rotate": ("error", "crash"),
     "store.compact": ("error", "crash"),
-    "engine.dispatch": ("error", "device_loss"),
+    "engine.dispatch": ("error", "device_loss", "stall"),
     "engine.warmup": ("error",),
     "mesh.dispatch": ("error", "device_loss", "partition"),
 }
@@ -331,6 +335,12 @@ class Chaos:
         write, engine dispatch/warmup); no-op when nothing fires."""
         spec = self.decide(point, label)
         if spec is None:
+            return
+        if spec.action == "stall":
+            # Blocks THIS dispatch worker thread for ``dur`` (ISSUE 17:
+            # the SLO chaos plan's synthetic dispatch stall) — the event
+            # loop stays healthy, exactly like a wedged backend.
+            time.sleep(spec.dur)
             return
         msg = f"chaos[{spec.describe()}] at {label or point}"
         if spec.action == "device_loss":

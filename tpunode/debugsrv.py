@@ -17,6 +17,10 @@ the telemetry that already exists in-process:
 * ``GET /fleet`` — per-host fleet state now + its sampled history
 * ``GET /flightrecords?n=`` — the flight recorder's post-mortem bundles
   (tpunode/blackbox.py)
+* ``GET /slo`` — the SLO evaluator's snapshot (tpunode/slo.py):
+  definitions, burn rates, remaining budgets, burn history, cost ledger
+* ``GET /`` — the endpoint catalog itself as JSON (machine-discoverable:
+  an operator with just the port can enumerate everything above)
 
 Off by default: enable with ``NodeConfig.debug_port`` (0 binds an
 ephemeral port — read it back from ``DebugServer.port``).  Binds
@@ -45,6 +49,22 @@ log = logging.getLogger("tpunode.debugsrv")
 _MAX_REQUEST_LINE = 8192
 _HEADER_TIMEOUT = 5.0
 
+# The endpoint catalog: served by ``GET /`` and echoed (keys only) in the
+# 404 body.  One source of truth — adding a route means adding a row here.
+ENDPOINTS: dict[str, str] = {
+    "/": "this endpoint catalog",
+    "/metrics": "Prometheus text exposition",
+    "/health": "health snapshot (JSON)",
+    "/stats": "full stats snapshot (JSON)",
+    "/events?n=&type=&since=": "recent structured events / seq cursor",
+    "/traces?n=": "recent + slowest finished trace trees",
+    "/mempool": "mempool snapshot",
+    "/timeseries?name=&tier=&since=": "metrics timeline rings",
+    "/fleet": "per-host fleet state now + sampled history",
+    "/flightrecords?n=": "flight recorder post-mortem bundles",
+    "/slo": "SLO burn rates, budgets, burn history, cost ledger",
+}
+
 
 class DebugServer:
     """Serve the debug endpoints until the scope closes::
@@ -66,6 +86,7 @@ class DebugServer:
         timeline=None,  # tpunode.timeseries.Timeline (or None)
         blackbox=None,  # tpunode.blackbox.FlightRecorder (or None)
         fleet: Optional[Callable[[], dict]] = None,  # live fleet state
+        slo: Optional[Callable[[], dict]] = None,  # SloEvaluator.snapshot
     ):
         self._want_port = port
         self.host = host
@@ -78,6 +99,7 @@ class DebugServer:
         self.timeline = timeline
         self.blackbox = blackbox
         self.fleet = fleet
+        self.slo = slo
         self._server: Optional[asyncio.base_events.Server] = None
         self.port: Optional[int] = None  # actual bound port once started
 
@@ -151,7 +173,12 @@ class DebugServer:
             except (KeyError, ValueError, IndexError):
                 return default
 
-        if path == "/metrics":
+        if path == "/":
+            self._respond(
+                writer, 200,
+                {"server": "tpunode-debugsrv", "endpoints": ENDPOINTS},
+            )
+        elif path == "/metrics":
             self._respond_text(writer, 200, self.registry.render_prometheus())
         elif path == "/health":
             body = self.health() if self.health is not None else {"ok": True}
@@ -235,18 +262,18 @@ class DebugServer:
                         "stats": self.blackbox.stats(),
                     },
                 )
+        elif path == "/slo":
+            if self.slo is not None:
+                self._respond(writer, 200, self.slo())
+            else:
+                self._respond(writer, 200, {"enabled": False})
         else:
             self._respond(
                 writer,
                 404,
                 {
                     "error": f"no such endpoint: {path}",
-                    "endpoints": [
-                        "/metrics", "/health", "/stats",
-                        "/events?n=&type=&since=", "/traces?n=", "/mempool",
-                        "/timeseries?name=&tier=&since=", "/fleet",
-                        "/flightrecords?n=",
-                    ],
+                    "endpoints": list(ENDPOINTS),
                 },
             )
 

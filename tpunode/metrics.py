@@ -143,6 +143,18 @@ class Histogram:
                        p50=None, p90=None, p99=None)
         return out
 
+    def count_le(self, bound: float) -> int:
+        """Observations ``<= bound`` — exact when ``bound`` is one of the
+        bucket boundaries (the SLO evaluator picks its latency thresholds
+        on boundaries for exactly this reason); otherwise the cumulative
+        count up to the last boundary ``<= bound`` (a lower bound)."""
+        if math.isinf(bound) and bound > 0:
+            return self.count  # +Inf: everything, incl. the overflow bucket
+        idx = bisect_left(self.bounds, bound)
+        if idx < len(self.bounds) and self.bounds[idx] == bound:
+            idx += 1
+        return sum(self.counts[:idx])
+
     def bucket_counts(self) -> dict[str, int]:
         """Non-empty buckets keyed by upper bound (readable exposition)."""
         out = {}
@@ -182,7 +194,19 @@ class Metrics:
         self._counters: dict[tuple[str, _LabelKey], _Counter] = {}
         self._gauges: dict[tuple[str, _LabelKey], float] = {}
         self._hists: dict[tuple[str, _LabelKey], Histogram] = {}
+        # metric family -> help text (# HELP exposition lines); optional,
+        # registered at first use via describe()
+        self._help: dict[str, str] = {}
         self._created = time.monotonic()
+
+    def describe(self, name: str, help_: str) -> None:
+        """Register a one-line description for a metric family: rendered
+        as a ``# HELP`` line by :meth:`render_prometheus`.  Idempotent —
+        the first registration wins (call it where the family is first
+        recorded).  Works even when recording is disabled (descriptions
+        are metadata, not samples)."""
+        with self._lock:
+            self._help.setdefault(name, help_)
 
     # -- write path ----------------------------------------------------------
 
@@ -425,12 +449,18 @@ class Metrics:
             counters = {k: c.value for k, c in self._counters.items()}
             gauges = dict(self._gauges)
             hists = dict(self._hists)
+            helps = dict(self._help)
         lines: list[str] = []
         typed: set[str] = set()
 
         def emit_type(name: str, kind: str) -> None:
             if name not in typed:
                 typed.add(name)
+                help_ = helps.get(name)
+                if help_ is not None:
+                    # HELP escaping (0.0.4): backslash and newline only
+                    text = help_.replace("\\", "\\\\").replace("\n", "\\n")
+                    lines.append(f"# HELP {pname(name)} {text}")
                 lines.append(f"# TYPE {pname(name)} {kind}")
 
         for (name, lk), value in sorted(counters.items()):

@@ -37,6 +37,7 @@ from .chain import Chain, ChainBestBlock, ChainConfig, ChainEvent
 from .debugsrv import DebugServer
 from .events import StatsReporter, events
 from .timeseries import Timeline
+from .slo import DEFAULT_SLOS, SloDef, SloEvaluator
 from .mempool import Mempool, MempoolConfig
 from .metrics import metrics, percentiles
 from .trace import span
@@ -207,6 +208,14 @@ class NodeConfig:
     # $TPUNODE_BLACKBOX_DIR) is set.  False turns the recorder off.
     blackbox: bool = True
     blackbox_dir: Optional[str] = None
+    # SLO engine (tpunode/slo.py, ISSUE 17): declarative objectives —
+    # per-class verdict-latency targets, a dispatch-stall budget, a
+    # breaker-open budget — evaluated once a second against the live
+    # registry; fast/slow-window burn breaches emit ``slo.burn`` events
+    # (a flight-recorder trigger) and surface at /slo, stats()["slo"]
+    # and health().  None disables the evaluator entirely;
+    # TPUNODE_NO_SLO=1 disables it at runtime (one-attribute-read tick).
+    slos: Optional[tuple[SloDef, ...]] = DEFAULT_SLOS
     # prevout oracle for BIP143 (P2WPKH / BCH FORKID) and BIP341 (taproot)
     # sighashes: (prevout txid, vout) -> satoshi amount, or
     # (amount, scriptPubKey), or None if unknown.  The tuple form enables
@@ -383,6 +392,7 @@ class Node:
         self.debug_server: Optional[DebugServer] = None
         self.timeline: Optional[Timeline] = None
         self.blackbox: Optional[FlightRecorder] = None
+        self.slo: Optional[SloEvaluator] = None
 
     @staticmethod
     def _verify_task_died(task, exc) -> None:
@@ -474,6 +484,20 @@ class Node:
         if self.cfg.timeline_interval > 0:
             self.timeline = Timeline(interval=self.cfg.timeline_interval)
             self._tasks.link(self.timeline.run(), name="timeline-sampler")
+        if self.cfg.slos is not None:
+            # SLO evaluator (ISSUE 17): objectives over the live registry;
+            # the ledger hook folds the engine's cost attribution into
+            # every snapshot (stats()["slo"], /slo, flight bundles)
+            self.slo = SloEvaluator(
+                self.cfg.slos,
+                ledger=(
+                    self.verify_engine.ledger
+                    if self.verify_engine is not None
+                    else None
+                ),
+            )
+            if not self.slo.disabled:
+                self._tasks.link(self.slo.run(), name="slo-evaluator")
         if self.cfg.blackbox:
             # bundle state sources: each is one lock-cheap snapshot call,
             # safe from whatever thread the trigger event fires on
@@ -484,6 +508,8 @@ class Node:
                 sources["watchdog"] = self._watchdog.snapshot
             if self.utxo is not None:
                 sources["utxo"] = self.utxo.stats
+            if self.slo is not None:
+                sources["slo"] = self.slo.snapshot
             self.blackbox = FlightRecorder(
                 FlightRecorderConfig(dir=self.cfg.blackbox_dir),
                 timeline=self.timeline,
@@ -501,6 +527,9 @@ class Node:
                 timeline=self.timeline,
                 blackbox=self.blackbox,
                 fleet=self._fleet_now,
+                slo=(
+                    self.slo.snapshot if self.slo is not None else None
+                ),
             )
             await self._stack.enter_async_context(self.debug_server)
         log.info(
@@ -634,6 +663,15 @@ class Node:
             "utxo_height": (
                 self.utxo.height if self.utxo is not None else None
             ),
+            # SLO burn (ISSUE 17): degraded while any FAST-window burn
+            # episode is active (the page-now condition); slow-window
+            # burns surface in stats()["slo"] without degrading health
+            "slo_burning": (
+                self.slo.burning("fast") if self.slo is not None else []
+            ),
+            "degraded": bool(
+                self.slo is not None and self.slo.burning("fast")
+            ),
         }
 
     def stats(self) -> dict:
@@ -721,6 +759,11 @@ class Node:
             "blackbox": (
                 self.blackbox.stats()
                 if self.blackbox is not None
+                else {"enabled": False}
+            ),
+            "slo": (
+                self.slo.snapshot()
+                if self.slo is not None
                 else {"enabled": False}
             ),
         }

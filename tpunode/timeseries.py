@@ -19,7 +19,8 @@ Cardinality discipline: unlabeled series are always captured; **labeled**
 series are captured only for families in ``label_families`` (default:
 the per-host fleet series — ``sched.host_depth``, ``sched.host_steals``,
 ``verify.breaker_state``, ``mesh.host_chips`` — whose label set is fixed
-at engine construction).
+at engine construction, plus ``slo.burn_rate`` whose label set is fixed
+by the declared SLOs).
 Per-peer families never reach the rings (address churn would grow them
 without bound), and a hard ``max_series`` cap drops anything beyond it
 (counted in ``tsdb.dropped_series``).
@@ -52,12 +53,14 @@ __all__ = ["Timeline", "DEFAULT_TIERS", "DEFAULT_LABEL_FAMILIES"]
 DEFAULT_TIERS: tuple[tuple[int, int], ...] = ((1, 600), (15, 480))
 
 # Labeled families worth a ring per label value: the per-host fleet
-# gauges (bounded label set — hosts are fixed at engine construction).
+# gauges (bounded label set — hosts are fixed at engine construction)
+# and the per-SLO burn rates (bounded by the declared SLO set).
 DEFAULT_LABEL_FAMILIES: tuple[str, ...] = (
     "sched.host_depth",
     "sched.host_steals",
     "verify.breaker_state",
     "mesh.host_chips",
+    "slo.burn_rate",
 )
 
 

@@ -562,6 +562,86 @@ class _HostState:
         self.event: Optional[asyncio.Event] = None  # lane-assigned wakeup
 
 
+metrics.describe(
+    "verify.cost_seconds",
+    "wall-clock rung seconds charged to each priority class, pro-rated "
+    "by item count",
+)
+
+
+class CostLedger:
+    """Per-class cost attribution (ISSUE 17): every dispatched lane's
+    wall-clock rung time is charged back to the priority classes of the
+    submissions it carried, pro-rated by item count.  The charge is cut
+    from the ONE measured ``dt`` around :meth:`VerifyEngine._run_ladder`,
+    so conservation holds by construction: summed charged seconds equal
+    total rung busy seconds (the pin in tests/test_slo.py allows 5% for
+    float accumulation, nothing more).
+
+    Thread-safe — charges arrive from every dispatch worker thread;
+    snapshots from stats()/the flight recorder."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (priority, rung) -> [charged seconds, items]
+        self._cells: dict[tuple[str, str], list] = {}
+        self._busy = 0.0  # total measured rung busy seconds
+
+    def charge(
+        self, class_counts: dict[str, int], total: int, dt: float, rung: str
+    ) -> None:
+        if total <= 0 or dt < 0:
+            return
+        shares = [
+            (p, n, dt * n / total) for p, n in class_counts.items() if n > 0
+        ]
+        with self._lock:
+            self._busy += dt
+            for p, n, share in shares:
+                cell = self._cells.get((p, rung))
+                if cell is None:
+                    cell = self._cells[(p, rung)] = [0.0, 0]
+                cell[0] += share
+                cell[1] += n
+        metrics.inc_batch(
+            (
+                (
+                    "verify.cost_seconds",
+                    share,
+                    {"priority": p, "rung": rung},
+                )
+                for p, _, share in shares
+            )
+        )
+
+    def snapshot(self) -> dict:
+        """The ``engine.stats()["ledger"]`` / flight-recorder section:
+        per-(class, rung) charged seconds + items, per-class
+        items-weighted share of the total, and the busy-seconds pin."""
+        with self._lock:
+            cells = {k: list(v) for k, v in self._cells.items()}
+            busy = self._busy
+        charged = sum(v[0] for v in cells.values())
+        by_class: dict[str, dict] = {}
+        for (p, rung), (secs, items) in sorted(cells.items()):
+            c = by_class.setdefault(
+                p, {"seconds": 0.0, "items": 0, "rungs": {}}
+            )
+            c["seconds"] += secs
+            c["items"] += items
+            c["rungs"][rung] = {
+                "seconds": round(secs, 6), "items": items,
+            }
+        for c in by_class.values():
+            c["seconds"] = round(c["seconds"], 6)
+            c["share"] = round(c["seconds"] / charged, 4) if charged else 0.0
+        return {
+            "busy_seconds": round(busy, 6),
+            "charged_seconds": round(charged, 6),
+            "by_class": by_class,
+        }
+
+
 class VerifyEngine:
     """Submit items, await verdicts.
 
@@ -590,6 +670,12 @@ class VerifyEngine:
         self._inflight: dict[int, float] = {}
         self._inflight_lock = threading.Lock()
         self._inflight_seq = 0
+        # Cost-attribution ledger (ISSUE 17) + the per-dispatch-thread
+        # slot carrying the lane's class counts into _dispatch_multi
+        # (threading.local, not a parameter: tests and subclasses pin
+        # _dispatch_multi's (payloads, target) call shape).
+        self._ledger = CostLedger()
+        self._tls = threading.local()
         self._lane_tasks: set[asyncio.Task] = set()
         self._slots: Optional[asyncio.Semaphore] = None
         self._kick: Optional[asyncio.Event] = None
@@ -781,6 +867,11 @@ class VerifyEngine:
         with self._inflight_lock:
             return len(self._inflight)
 
+    def ledger(self) -> dict:
+        """Cost-attribution snapshot (ISSUE 17): per-class charged rung
+        seconds + the conservation pin — also under stats()["ledger"]."""
+        return self._ledger.snapshot()
+
     def stats(self) -> dict:
         """Telemetry snapshot for Node.stats()/health()."""
         out = {
@@ -828,6 +919,7 @@ class VerifyEngine:
         disp = metrics.histogram("span.verify.dispatch")
         if disp is not None:
             out["dispatch_seconds"] = disp.summary()
+        out["ledger"] = self._ledger.snapshot()
         return out
 
     # -- lifecycle -----------------------------------------------------------
@@ -1089,10 +1181,11 @@ class VerifyEngine:
             token = self._inflight_seq
             self._inflight[token] = time.monotonic()
         try:
+            classes = lane.class_counts()
             try:
                 results = await asyncio.to_thread(
                     self._dispatch_traced, payloads, lane.target, lane.act0,
-                    host,
+                    host, None, classes,
                 )
             except HostLost as e:
                 assert host is not None and self._fleet is not None
@@ -1108,6 +1201,7 @@ class VerifyEngine:
                 results = await asyncio.to_thread(
                     self._dispatch_traced, payloads, lane.target, lane.act0,
                     None, "cpu" if self._cpu is not None else "oracle",
+                    classes,
                 )
         except asyncio.CancelledError:
             # engine teardown mid-dispatch: waiters must not hang on a
@@ -1145,19 +1239,28 @@ class VerifyEngine:
         act: Optional[tuple],
         host: Optional[_HostState] = None,
         backend: Optional[str] = None,
+        classes: Optional[dict] = None,
     ) -> list[bool]:
         """Worker-thread entry: re-activate the submitting item's trace
         (contextvars do not cross ``to_thread`` from the queue loop — the
         loop's own context has no trace) so the dispatch/prepare/transfer/
-        kernel/readback spans land in the item's pipeline tree."""
-        with _activate_trace(act):
-            if host is None and backend is None:
-                # keep the 2-arg call shape: tests (and subclasses) spy
-                # on _dispatch_multi with (payloads, target) signatures
-                return self._dispatch_multi(payloads, target)
-            return self._dispatch_multi(
-                payloads, target, host=host, backend=backend
-            )
+        kernel/readback spans land in the item's pipeline tree.
+        ``classes`` (the lane's per-priority item counts) rides a
+        thread-local into _dispatch_multi's ledger charge — this IS the
+        dispatch thread."""
+        self._tls.classes = classes
+        try:
+            with _activate_trace(act):
+                if host is None and backend is None:
+                    # keep the 2-arg call shape: tests (and subclasses)
+                    # spy on _dispatch_multi with (payloads, target)
+                    # signatures
+                    return self._dispatch_multi(payloads, target)
+                return self._dispatch_multi(
+                    payloads, target, host=host, backend=backend
+                )
+        finally:
+            self._tls.classes = None
 
     def _pick(self, n: int, host: Optional[_HostState] = None) -> str:
         """Resolve the starting backend rung for one batch.  Never blocks
@@ -1236,6 +1339,14 @@ class VerifyEngine:
             out, served = self._run_ladder(picked, payloads, total, host)
             dt = time.perf_counter() - t0
             metrics.inc("verify.seconds", dt)
+            # Ledger charge (ISSUE 17): the ONE measured rung time is cut
+            # across the lane's carried classes; the sync/no-lane paths
+            # (verify_sync, warmup canaries) have no class counts and
+            # charge to "bulk".
+            classes = getattr(self._tls, "classes", None)
+            self._ledger.charge(
+                classes if classes else {"bulk": total}, total, dt, served
+            )
             events.emit(
                 "verify.dispatch", backend=served, size=total,
                 occupancy=round(occupancy, 4) if occupancy is not None else None,

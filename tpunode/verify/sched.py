@@ -78,6 +78,11 @@ PRIORITIES = ("block", "mempool", "ibd", "bulk")
 # which the duration-shaped default bounds would quantize uselessly.
 OCCUPANCY_BUCKETS = tuple(i / 20 for i in range(1, 21))
 
+metrics.describe(
+    "node.verdict_latency",
+    "submit->verdict-publish latency per priority class (seconds)",
+)
+
 
 def slice_payload(payload, lo: int, hi: int):
     """A view/copy of ``payload[lo:hi]`` in dispatchable form: list
@@ -133,6 +138,15 @@ class Submission:
         self.results[lo : lo + len(verdicts)] = verdicts
         self.remaining -= len(verdicts)
         if self.remaining <= 0 and not self.failed and not self.fut.done():
+            # Per-class e2e latency (ISSUE 17): admission stamp -> last
+            # slice delivered.  Observed HERE — submission-side, not
+            # lane-side — so packed/sliced/stolen/requeued lanes still
+            # attribute the latency to the originating priority class.
+            metrics.observe(
+                "node.verdict_latency",
+                time.monotonic() - self.enqueued,
+                labels={"priority": self.priority},
+            )
             self.fut.set_result(self.results)
 
     def fail(self, exc: BaseException) -> None:
@@ -180,6 +194,15 @@ class PackedLane:
         return [
             slice_payload(sub.payload, lo, hi) for sub, lo, hi in self.slices
         ]
+
+    def class_counts(self) -> dict[str, int]:
+        """Items per priority class carried by this lane — the cost
+        ledger's attribution input (ISSUE 17): the engine pro-rates the
+        lane's wall-clock rung time across these counts."""
+        out: dict[str, int] = {}
+        for sub, lo, hi in self.slices:
+            out[sub.priority] = out.get(sub.priority, 0) + (hi - lo)
+        return out
 
 
 class LanePacker:

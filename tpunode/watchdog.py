@@ -37,6 +37,11 @@ __all__ = ["WatchdogConfig", "Watchdog"]
 
 log = logging.getLogger("tpunode.watchdog")
 
+metrics.describe(
+    "watchdog.stalled",
+    "stall surfaces currently in an episode (0 = healthy)",
+)
+
 
 @dataclass
 class WatchdogConfig:
@@ -131,6 +136,9 @@ class Watchdog:
                 emitted += self._stall("verify_dispatch", **fields)
             else:
                 self._clear("verify_dispatch")
+        # Level signal for the SLO evaluator (ISSUE 17): episodes emit one
+        # event each, but burn-rate accounting needs "are we stalled NOW".
+        metrics.set_gauge("watchdog.stalled", float(len(self._stalled)))
         return emitted
 
     def snapshot(self) -> dict:
