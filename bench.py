@@ -143,14 +143,23 @@ def _progress(msg: str) -> None:
 
 
 def _sanitizer_counts(event_counts: dict, metrics) -> dict:
-    """asyncsan/watchdog regression signals for the BENCH JSON (ISSUE 3
-    satellite): leaked supervised tasks and watchdog stall episodes seen
+    """asyncsan/threadsan/watchdog regression signals for the BENCH JSON
+    (ISSUE 3 + 18 satellites): leaked supervised tasks, watchdog stall
+    episodes, and the lock sanitizer's cycle/reentry/hold watermarks seen
     by this process.  A nonzero trajectory across rounds flags a
-    concurrency regression the throughput number alone would hide."""
+    concurrency regression the throughput number alone would hide.  The
+    threadsan keys are registry counters (not event counts) so they are
+    meaningful whether or not TPUNODE_THREADSAN armed this run — zeros
+    when off."""
+    from tpunode.threadsan import registry as _ts
+
     return {
         "task_leak": int(event_counts.get("asyncsan.task_leak", 0)),
         "watchdog_stall": int(event_counts.get("watchdog.stall", 0)),
         "task_leaks_metric": metrics.get("asyncsan.task_leaks"),
+        "lock_cycles": int(_ts.lock_cycles),
+        "lock_reentries": int(_ts.lock_reentries),
+        "max_hold_ms": round(_ts.max_hold_seconds * 1000.0, 3),
     }
 
 

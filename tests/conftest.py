@@ -60,15 +60,21 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        from tpunode import asyncsan
+        from tpunode import asyncsan, threadsan
 
-        if asyncsan.enabled():
+        if asyncsan.enabled() or threadsan.enabled():
             # TPUNODE_ASYNCSAN=1: every coroutine test runs under asyncio
             # debug mode with the tight slow-callback threshold, so a
             # blocking call inside the suite logs itself with its source
-            # location (ANALYSIS.md, runtime sanitizers)
+            # location (ANALYSIS.md, runtime sanitizers).
+            # TPUNODE_THREADSAN=1 (ISSUE 18): the lock registry arms and
+            # each test's loop thread registers for blocking-acquire
+            # attribution — the thread-side twin.
             async def _sanitized():
-                asyncsan.install()
+                if asyncsan.enabled():
+                    asyncsan.install()
+                if threadsan.enabled():
+                    threadsan.install()
                 await func(**kwargs)
 
             asyncio.run(_sanitized())
@@ -76,3 +82,18 @@ def pytest_pyfunc_call(pyfuncitem):
             asyncio.run(func(**kwargs))
         return True
     return None
+
+
+@pytest.fixture
+def threadsan_armed(monkeypatch):
+    """Arm threadsan for one test (ISSUE 18): fresh registry state, env
+    set so any Node/conftest install path agrees, disarmed afterwards.
+    The test asserts on the yielded registry's counters/findings."""
+    from tpunode.threadsan import registry
+
+    monkeypatch.setenv("TPUNODE_THREADSAN", "1")
+    registry.reset()
+    registry.arm()
+    yield registry
+    registry.disarm()
+    registry.reset()

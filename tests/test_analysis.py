@@ -79,7 +79,7 @@ async def main(work):
 import asyncio
 import threading
 
-_lock = threading.Lock()
+_lock = threading.Lock()  # asyncsan: disable=raw-lock
 
 async def main():
     with _lock:
@@ -141,6 +141,28 @@ def record():
     # source fixture cannot drive it.  Dedicated tests below seed the
     # doc/corpus caches instead.
     "stale-doc": None,
+    "raw-lock": """\
+import threading
+
+def make():
+    return threading.Lock()
+""",
+    # unkeyed jit wrapper: no static mode argument and no mode-accessor
+    # call in the enclosing cache scope (ISSUE 18; the rule scopes to
+    # tpunode/verify/ paths and "<...>" in-memory test sources)
+    "jit-cache-key": """\
+import jax
+
+def build(fn):
+    return jax.jit(fn)
+""",
+    # env knob read nowhere documented in OBSERVABILITY.md's inventory
+    "env-knob-doc": """\
+import os
+
+def knob():
+    return os.environ.get("TPUNODE_FIXTURE_UNDOCUMENTED")
+""",
 }
 
 
@@ -259,7 +281,7 @@ def test_pool_shutdown_unrelated_teardown_does_not_suppress():
     findings = analyze_source(
         "import threading\n"
         "from concurrent.futures import ThreadPoolExecutor\n"
-        "_lock = threading.Lock()\n"
+        "_lock = threading.Lock()  # asyncsan: disable=raw-lock\n"
         "def start(path, parts):\n"
         "    f = open(path)\n"
         "    f.close()\n"
@@ -619,7 +641,9 @@ def test_cli_inprocess_exit_codes(tmp_path, capsys):
     assert cli_main([str(good)]) == 0
 
     assert cli_main(["--list-rules"]) == 0
-    assert "raw-spawn" in capsys.readouterr().out
+    listed = capsys.readouterr().out
+    for rid in ("raw-spawn", "raw-lock", "jit-cache-key", "env-knob-doc"):
+        assert rid in listed
     assert cli_main(["--rules", "bogus", str(good)]) == 2
     assert cli_main([str(tmp_path / "missing.py")]) == 2
 
@@ -637,3 +661,120 @@ def test_cli_subprocess_tree_is_clean():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert json.loads(proc.stdout)["findings"] == []
+
+
+# --- raw-lock / jit-cache-key / env-knob-doc (ISSUE 18) ----------------------
+
+
+def test_raw_lock_flags_aliases_and_dynamic_import():
+    src = (
+        "from threading import Lock as L\n"
+        "a = L()\n"
+        'b = __import__("threading").RLock()\n'
+    )
+    findings = analyze_source(src)
+    assert [f.rule for f in findings] == ["raw-lock", "raw-lock"]
+    assert [f.line for f in findings] == [2, 3]
+
+
+def test_raw_lock_ignores_asyncio_and_registry_locks():
+    src = (
+        "import asyncio\n"
+        "from tpunode import threadsan\n"
+        "a = asyncio.Lock()\n"
+        'b = threadsan.lock("node.fixture")\n'
+        'c = threadsan.rlock("node.fixture_r")\n'
+    )
+    assert analyze_source(src) == []
+
+
+def test_raw_lock_exempts_threadsan_itself():
+    src = "import threading\n_meta = threading.Lock()\n"
+    assert (
+        Analyzer(select=["raw-lock"]).check_source(
+            src, path="tpunode/threadsan.py"
+        )
+        == []
+    )
+    assert [
+        f.rule
+        for f in Analyzer(select=["raw-lock"]).check_source(
+            src, path="tpunode/store.py"
+        )
+    ] == ["raw-lock"]
+
+
+_JIT = Analyzer(select=["jit-cache-key"])
+
+
+def test_jit_cache_key_accepts_static_mode_argnames():
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('interpret', 'field_modes'))\n"
+        "def f(x):\n"
+        "    return x\n"
+    )
+    assert _JIT.check_source(src, path="tpunode/verify/kernel.py") == []
+
+
+def test_jit_cache_key_accepts_static_argnums():
+    src = "import jax\n\ndef build(fn):\n    return jax.jit(fn, static_argnums=(1,))\n"
+    assert _JIT.check_source(src, path="tpunode/verify/kernel.py") == []
+
+
+def test_jit_cache_key_accepts_mode_keyed_cache_scope():
+    src = (
+        "import jax\n"
+        "from tpunode.verify.modes import kernel_modes\n"
+        "_CACHE = {}\n"
+        "def build(fn, mesh):\n"
+        "    key = (mesh, kernel_modes())\n"
+        "    if key not in _CACHE:\n"
+        "        _CACHE[key] = jax.jit(fn)\n"
+        "    return _CACHE[key]\n"
+    )
+    assert _JIT.check_source(src, path="tpunode/verify/multichip.py") == []
+
+
+def test_jit_cache_key_flags_modeless_static_argnames():
+    src = (
+        "import jax\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnames=('interpret',))\n"
+        "def f(x):\n"
+        "    return x\n"
+    )
+    findings = _JIT.check_source(src, path="tpunode/verify/kernel.py")
+    assert [f.rule for f in findings] == ["jit-cache-key"]
+
+
+def test_jit_cache_key_scoped_to_verify_paths():
+    src = "import jax\n\ndef build(fn):\n    return jax.jit(fn)\n"
+    assert _JIT.check_source(src, path="tpunode/node.py") == []
+    assert [
+        f.rule for f in _JIT.check_source(src, path="tpunode/verify/engine.py")
+    ] == ["jit-cache-key"]
+
+
+def test_env_knob_doc_containment(monkeypatch):
+    _seed_stale_doc(monkeypatch, "| `TPUNODE_DOCUMENTED=1` | a knob |", "")
+    src = (
+        "import os\n"
+        'a = os.environ.get("TPUNODE_DOCUMENTED")\n'
+        'b = os.environ.get("TPUNODE_NOT_DOCUMENTED")\n'
+        'c = "TPUNODE_" + a\n'  # prefix-building: not a knob literal
+    )
+    findings = [
+        f
+        for f in Analyzer(select=["env-knob-doc"]).check_source(src)
+        if f.rule == "env-knob-doc"
+    ]
+    assert [f.line for f in findings] == [3]
+    assert "TPUNODE_NOT_DOCUMENTED" in findings[0].message
+
+
+def test_env_knob_doc_ignores_docstrings(monkeypatch):
+    _seed_stale_doc(monkeypatch, "nothing documented", "")
+    src = '"""Module mentioning TPUNODE_SOMETHING in prose."""\nx = 1\n'
+    assert Analyzer(select=["env-knob-doc"]).check_source(src) == []

@@ -2200,3 +2200,50 @@ def test_rotate_keep_drops_stale_rows(tmp_path, monkeypatch):
     assert W._rotate_runs_file() == []
     kept = [json.loads(x) for x in runs.read_text().splitlines()]
     assert [r["ts"] for r in kept] == ["new"]
+
+
+# --- sanitizers section (ISSUE 3 + 18 satellites) ----------------------------
+
+
+def test_sanitizer_counts_keys_and_disarmed_zeros():
+    """The BENCH JSON sanitizers section carries the asyncsan AND
+    threadsan regression signals with a pinned key set — a rename or a
+    dropped key silently breaks round-over-round trajectory diffs."""
+    bench = _load_bench()
+    from tpunode.metrics import metrics
+    from tpunode.threadsan import registry
+
+    san = bench._sanitizer_counts({"asyncsan.task_leak": 2}, metrics)
+    assert set(san) == {
+        "task_leak", "watchdog_stall", "task_leaks_metric",
+        "lock_cycles", "lock_reentries", "max_hold_ms",
+    }
+    assert san["task_leak"] == 2 and san["watchdog_stall"] == 0
+    # threadsan keys read the registry (not events), so a disarmed run
+    # reports honest zeros rather than missing keys
+    assert not registry._armed
+    assert san["lock_cycles"] == 0 and san["lock_reentries"] == 0
+    assert san["max_hold_ms"] == registry.snapshot()["max_hold_ms"]
+
+
+def test_scripted_line_carries_threadsan_sanitizers(monkeypatch):
+    """Scripted driver run: the emitted line's sanitizers section
+    includes the threadsan counters (driver-local source, since the
+    stubbed worker result has no sanitizers dict)."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 3.0}),
+            (_batch(32768), {"ok": True, "rate": 200000.0,
+                             "device": "tpu:v5e", "kernel": "pallas",
+                             "batch": 32768}),
+        ],
+    )
+    assert rc == 0
+    san = line["sanitizers"]
+    assert san["source"] == "driver-local"
+    assert san["lock_cycles"] == 0
+    assert san["lock_reentries"] == 0
+    assert isinstance(san["max_hold_ms"], float)

@@ -461,14 +461,16 @@ async def test_warmup_failure_reprobes_not_terminal(monkeypatch):
 
 
 @pytest.mark.asyncio
-async def test_chaos_soak_verdict_conservation(monkeypatch):
+async def test_chaos_soak_verdict_conservation(monkeypatch, threadsan_armed):
     """Full fakenet node + mempool under a seeded fault plan: peer
     garbage (one misbehaving pusher), random session drops (churn),
     mailbox delivery chaos on the mempool actor, and a mid-run device
     loss.  Asserts verdict conservation — every unique submitted tx
     yields exactly ONE verdict, none carrying an error — plus zero stuck
     PENDING, zero task leaks, a quiet watchdog, and the breaker
-    re-opening the device path after the fault clears."""
+    re-opening the device path after the fault clears.  Runs with
+    threadsan armed (ISSUE 18): the full fault plan must produce zero
+    lock-order cycles and zero non-reentrant reentries."""
     from benchmarks.txgen import gen_signed_txs
     from tests.fakenet import TxRelay, dummy_peer_connect, poll_until
     from tests.fixtures import all_blocks
@@ -576,6 +578,9 @@ async def test_chaos_soak_verdict_conservation(monkeypatch):
     # the run's artifact shows what was injected
     st = chaos.stats()
     assert any(f["fired"] for f in st["faults"]), st
+    # -- threadsan (ISSUE 18): no deadlock findings under chaos --------
+    assert threadsan_armed.lock_cycles == 0, threadsan_armed.findings
+    assert threadsan_armed.lock_reentries == 0, threadsan_armed.findings
 
 
 # --- peer-fleet hardening (ISSUE 7 part 3) ----------------------------------

@@ -779,7 +779,7 @@ async def test_fleet_shutdown_cancels_queued_and_inflight():
 
 
 @pytest.mark.asyncio
-async def test_fleet_chip_loss_shrinks_then_canary_regrows(monkeypatch):
+async def test_fleet_chip_loss_shrinks_then_canary_regrows(monkeypatch, threadsan_armed):
     """Chip-by-chip degradation: a device loss on one multi-chip host
     halves that host's sub-mesh (largest still-healthy half) while the
     OTHER host keeps its full row; the failed lane still resolves via
@@ -824,10 +824,13 @@ async def test_fleet_chip_loss_shrinks_then_canary_regrows(monkeypatch):
             assert metrics.get("mesh.regrows") >= 1
     finally:
         chaos.uninstall()
+    # threadsan (ISSUE 18): shrink + canary regrow is deadlock-free
+    assert threadsan_armed.lock_cycles == 0, threadsan_armed.findings
+    assert threadsan_armed.lock_reentries == 0, threadsan_armed.findings
 
 
 @pytest.mark.asyncio
-async def test_fleet_chip_loss_regrows_without_breaker_open(monkeypatch):
+async def test_fleet_chip_loss_regrows_without_breaker_open(monkeypatch, threadsan_armed):
     """Review r13: at the DEFAULT breaker threshold a single device
     loss only reaches 'degraded' — the shrink must still re-grow (via
     the cooldown-paced success probe), not pin the host at half width
@@ -872,10 +875,13 @@ async def test_fleet_chip_loss_regrows_without_breaker_open(monkeypatch):
             assert h0.breaker.opens == 0  # the gap scenario: no open ever
     finally:
         chaos.uninstall()
+    # threadsan (ISSUE 18): probe-paced regrow is deadlock-free
+    assert threadsan_armed.lock_cycles == 0, threadsan_armed.findings
+    assert threadsan_armed.lock_reentries == 0, threadsan_armed.findings
 
 
 @pytest.mark.asyncio
-async def test_fleet_mesh_shrink_soak(monkeypatch):
+async def test_fleet_mesh_shrink_soak(monkeypatch, threadsan_armed):
     """ISSUE 13 acceptance SOAK: 8 fleet hosts under staged partitions —
     the active set shrinks 8 -> ... -> 1 (h0 is never partitioned) while
     traffic flows, then re-grows to 8 as the canaries clear.  Every
@@ -958,6 +964,10 @@ async def test_fleet_mesh_shrink_soak(monkeypatch):
         unsub()
         chaos.uninstall()
     assert task_registry.report_leaks() == []
+    # threadsan (ISSUE 18): the whole 8->1->8 cycle — per-host breakers,
+    # fleet dispatcher, canary probes, ledger charges — orders cleanly
+    assert threadsan_armed.lock_cycles == 0, threadsan_armed.findings
+    assert threadsan_armed.lock_reentries == 0, threadsan_armed.findings
 
 
 # --- acceptance: fakenet node through the full pipeline ----------------------

@@ -1,0 +1,470 @@
+"""threadsan unit + integration tests (ISSUE 18 tentpole).
+
+Pins the four detector behaviors (lock-order cycle on a 2-lock
+inversion, non-reentrant reentry, hold-time histograms, loop-thread
+blocking-acquire), the off-switch micro-bench (<5µs per
+acquire+release), registry naming, and — the reason the module exists —
+the PR 14 CircuitBreaker self-deadlock: with the RLock fix reverted to a
+plain registry lock, threadsan catches the recorder-observer reentry as
+a finding + ``ThreadSanError`` instead of a hang; with the shipped RLock
+the same scenario is finding-free.  A fakenet node run under
+``TPUNODE_THREADSAN=1`` closes with zero cycle/reentry findings (the
+lock-order audit of ISSUE 18's bugfix satellite, automated).
+
+Uses the shared ``threadsan_armed`` conftest fixture: fresh registry,
+armed, disarmed + reset afterwards.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tests.fakenet import dummy_peer_connect, poll_until as _poll
+from tests.fixtures import all_blocks
+from tpunode import threadsan
+from tpunode.events import events
+from tpunode.metrics import metrics
+from tpunode.threadsan import SanLock, ThreadSanError
+from tpunode.verify.engine import CircuitBreaker, CostLedger
+
+
+def _wait_for(cond, what: str, timeout: float = 2.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# --- cycle detection ---------------------------------------------------------
+
+
+def test_two_lock_inversion_closes_a_cycle(threadsan_armed):
+    """a->b then b->a: the second ordering closes the cycle and is
+    reported the moment the edge is inserted — no interleaving needed."""
+    reg = threadsan_armed
+    a = threadsan.lock("test.a")
+    b = threadsan.lock("test.b")
+    with a:
+        with b:
+            pass
+    assert reg.lock_cycles == 0  # one consistent order so far
+    with b:
+        with a:
+            pass
+    assert reg.lock_cycles == 1
+    (finding,) = [f for f in reg.findings if f["kind"] == "cycle"]
+    # the chain names both locks and both endpoints agree (a cycle)
+    assert finding["chain"][0] == finding["chain"][-1]
+    assert {"test.a", "test.b"} <= set(finding["chain"])
+    # the closing edge carries this thread's stack and the first
+    # witness stack of the prior a->b ordering
+    assert finding["stack"] and finding["witnesses"]
+    assert any(w["stack"] for w in finding["witnesses"].values())
+    # the event lands (reporter thread) and the counter metric moves
+    _wait_for(
+        lambda: events.counts().get("threadsan.lock_cycle", 0) >= 1,
+        "threadsan.lock_cycle event",
+    )
+    assert metrics.get("threadsan.lock_cycles") >= 1.0
+
+
+def test_cycle_reported_once_per_lock_set(threadsan_armed):
+    reg = threadsan_armed
+    a = threadsan.lock("test.once_a")
+    b = threadsan.lock("test.once_b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert reg.lock_cycles == 1
+
+
+def test_consistent_order_stays_clean(threadsan_armed):
+    reg = threadsan_armed
+    outer = threadsan.lock("test.outer")
+    inner = threadsan.lock("test.inner")
+    for _ in range(50):
+        with outer:
+            with inner:
+                pass
+    assert reg.lock_cycles == 0 and reg.findings == []
+
+
+def test_three_lock_cycle_through_intermediate(threadsan_armed):
+    """a->b, b->c, then c->a: the cycle spans three nodes — the DFS must
+    find it through the intermediate edge, not just direct inversions."""
+    reg = threadsan_armed
+    a = threadsan.lock("test.tri_a")
+    b = threadsan.lock("test.tri_b")
+    c = threadsan.lock("test.tri_c")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    assert reg.lock_cycles == 0
+    with c:
+        with a:
+            pass
+    assert reg.lock_cycles == 1
+    (finding,) = [f for f in reg.findings if f["kind"] == "cycle"]
+    assert {"test.tri_a", "test.tri_b", "test.tri_c"} <= set(
+        finding["chain"]
+    )
+
+
+def test_same_name_siblings_do_not_self_edge(threadsan_armed):
+    """Two instances under one name (per-host breakers, per-Trace locks)
+    nesting within each other must not register a name self-cycle."""
+    reg = threadsan_armed
+    first = threadsan.lock("test.sibling")
+    second = threadsan.lock("test.sibling")
+    with first:
+        with second:
+            pass
+    assert reg.lock_cycles == 0 and reg.findings == []
+
+
+# --- reentry detection -------------------------------------------------------
+
+
+def test_nonreentrant_reentry_raises_instead_of_hanging(threadsan_armed):
+    reg = threadsan_armed
+    lk = threadsan.lock("test.reentry")
+    assert lk.acquire()
+    try:
+        with pytest.raises(ThreadSanError, match="test.reentry"):
+            lk.acquire()
+    finally:
+        lk.release()
+    assert reg.lock_reentries == 1
+    (finding,) = [f for f in reg.findings if f["kind"] == "reentry"]
+    assert finding["lock"] == "test.reentry" and finding["stack"]
+    _wait_for(
+        lambda: events.counts().get("threadsan.lock_reentry", 0) >= 1,
+        "threadsan.lock_reentry event",
+    )
+
+
+def test_nonblocking_reentry_reports_without_raising(threadsan_armed):
+    """acquire(blocking=False) on a held lock cannot deadlock — it is
+    still a reported ordering bug, but returns False like the raw
+    primitive instead of raising."""
+    reg = threadsan_armed
+    lk = threadsan.lock("test.reentry_nb")
+    assert lk.acquire()
+    try:
+        assert lk.acquire(blocking=False) is False
+    finally:
+        lk.release()
+    assert reg.lock_reentries == 1
+
+
+def test_rlock_reentry_is_legitimate(threadsan_armed):
+    reg = threadsan_armed
+    rl = threadsan.rlock("test.rlock")
+    with rl:
+        with rl:
+            with rl:
+                pass
+    assert reg.lock_reentries == 0 and reg.findings == []
+    # the lock actually released: another thread can take (and release) it
+    got = []
+
+    def taker():
+        if rl.acquire(timeout=1):
+            got.append(True)
+            rl.release()
+
+    t = threading.Thread(target=taker)
+    t.start()
+    t.join()
+    assert got == [True]
+
+
+# --- the PR 14 breaker regression pin ----------------------------------------
+
+
+def test_pr14_breaker_reentry_caught_with_rlock_fix_reverted(threadsan_armed):
+    """The bug that motivated this module, re-introduced: revert the
+    breaker's RLock to a plain (non-reentrant) registry lock and replay
+    the PR 14 scenario — breaker opens, emits ``verify.breaker`` with
+    its lock held, and a synchronous observer (the flight recorder
+    freezing a bundle) re-enters ``stats()`` on the same thread.  Before
+    threadsan that was a silent hang a bench worker had to die to
+    expose; now it is a recorded finding + ``ThreadSanError`` (swallowed
+    by the event log's observer guard, so the emit completes)."""
+    reg = threadsan_armed
+    br = CircuitBreaker(threshold=1)
+    br._lock = threadsan.lock("test.breaker_plain")  # the pre-PR-14 bug
+    observed = []
+
+    def recorder_observer(ev):
+        if ev.get("type") == "verify.breaker":
+            observed.append(br.stats())  # same-thread reentry
+
+    unsubscribe = events.subscribe(recorder_observer)
+    try:
+        br.record_failure("chaos: device_loss")  # opens at threshold=1
+    finally:
+        unsubscribe()
+    # no hang, the breaker opened, and threadsan named the deadlock
+    assert br.state == "open"
+    assert observed == []  # the reentrant stats() never completed
+    assert reg.lock_reentries >= 1
+    assert any(
+        f["kind"] == "reentry" and f["lock"] == "test.breaker_plain"
+        for f in reg.findings
+    )
+
+
+def test_pr14_breaker_rlock_fix_is_clean_under_threadsan(threadsan_armed):
+    """The shipped breaker (registry RLock) under the same recorder
+    scenario: the observer's stats() completes and threadsan agrees the
+    locking is sound — the regression pin's control arm."""
+    reg = threadsan_armed
+    br = CircuitBreaker(threshold=1)
+    observed = []
+
+    def recorder_observer(ev):
+        if ev.get("type") == "verify.breaker":
+            observed.append(br.stats())
+
+    unsubscribe = events.subscribe(recorder_observer)
+    try:
+        br.record_failure("chaos: device_loss")
+    finally:
+        unsubscribe()
+    assert br.state == "open"
+    assert observed and observed[0]["state"] == "open"
+    assert reg.lock_reentries == 0 and reg.lock_cycles == 0
+
+
+def test_stats_walk_order_has_no_cycle(threadsan_armed):
+    """The ISSUE 18 lock-order audit, pinned: the recorder/SLO walk
+    (breaker.stats + ledger.snapshot from a foreign thread, with breaker
+    transitions emitting into events/metrics and ledger charges landing
+    from dispatch threads) must register no ordering cycle."""
+    reg = threadsan_armed
+    br = CircuitBreaker(threshold=2)
+    ledger = CostLedger()
+
+    def stats_walker():
+        for _ in range(25):
+            br.stats()
+            ledger.snapshot()
+            metrics.get("verify.breaker_opens")
+
+    def dispatch_worker():
+        for _ in range(25):
+            ledger.charge({"block": 8}, 8, 0.001, "tpu")
+            br.record_failure("flaky")
+            br.record_success()
+
+    threads = [
+        threading.Thread(target=stats_walker),
+        threading.Thread(target=dispatch_worker),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.lock_cycles == 0, reg.findings
+    assert reg.lock_reentries == 0, reg.findings
+
+
+# --- hold-time + loop-block telemetry ----------------------------------------
+
+
+def test_hold_time_histogram_and_watermark(threadsan_armed):
+    reg = threadsan_armed
+    lk = threadsan.lock("test.hold")
+    with lk:
+        time.sleep(0.02)
+    assert reg.max_hold_seconds >= 0.02
+    hist = metrics.histogram(
+        "threadsan.hold_seconds", labels={"lock": "test.hold"}
+    )
+    assert hist is not None and hist.count >= 1
+    snap = reg.snapshot()
+    assert snap["max_hold_ms"] >= 20.0
+    assert snap["lock_cycles"] == 0
+
+
+def test_loop_thread_blocking_acquire_detected(threadsan_armed, monkeypatch):
+    monkeypatch.setenv("TPUNODE_THREADSAN_BLOCK", "0.01")
+    reg = threadsan_armed
+    reg.register_loop_thread()  # pretend this test thread runs the loop
+    lk = threadsan.lock("test.loop_block")
+    started = threading.Event()
+
+    def holder():
+        with lk:
+            started.set()
+            time.sleep(0.08)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    started.wait(1)
+    with lk:  # blocks this "loop" thread behind the holder
+        pass
+    t.join()
+    assert reg.loop_blocks == 1
+    assert reg.last_loop_block["lock"] == "test.loop_block"
+    assert reg.last_loop_block["waited_seconds"] >= 0.01
+
+
+def test_worker_thread_blocking_is_not_a_loop_block(threadsan_armed):
+    """Contention on a non-registered thread is normal locking, not a
+    finding — only registered loop threads report blocking acquires."""
+    reg = threadsan_armed
+    lk = threadsan.lock("test.worker_block")
+    started = threading.Event()
+
+    def holder():
+        with lk:
+            started.set()
+            time.sleep(0.06)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    started.wait(1)
+    with lk:
+        pass
+    t.join()
+    assert reg.loop_blocks == 0
+
+
+# --- registry naming + lifecycle ---------------------------------------------
+
+
+def test_registry_naming_and_kinds(threadsan_armed):
+    reg = threadsan_armed
+    lk = threadsan.lock("layer.thing")
+    rl = threadsan.rlock("layer.thing_r")
+    assert isinstance(lk, SanLock) and isinstance(rl, SanLock)
+    assert lk.name == "layer.thing" and not lk.reentrant
+    assert rl.name == "layer.thing_r" and rl.reentrant
+    snap = reg.snapshot()
+    assert snap["armed"] is True
+    assert snap["locks"] >= 2  # at least the two above
+
+
+def test_migrated_subsystem_locks_are_registered():
+    """The ISSUE 18 sweep: the always-imported subsystems construct
+    their locks through the registry under dotted names."""
+    names = set(threadsan.registry._names)
+    for expected in (
+        "metrics.registry",
+        "events.ring",
+        "events.sink",
+        "chaos.controller",
+        "verify.ecdsa_table",
+    ):
+        assert expected in names, (expected, sorted(names))
+
+
+def test_acquire_spanning_arming_is_tolerated():
+    """A lock taken before arm() and released after must pass through
+    (the held-stack entry never existed); epoch bumping also discards
+    stale per-thread state from a previous arming window."""
+    reg = threadsan.registry
+    lk = threadsan.lock("test.spanning")
+    assert lk.acquire()
+    reg.reset()
+    reg.arm()
+    try:
+        lk.release()  # unknown to the armed epoch: raw pass-through
+        with lk:
+            pass
+        assert reg.lock_reentries == 0
+    finally:
+        reg.disarm()
+        reg.reset()
+
+
+def test_locked_query_matches_state(threadsan_armed):
+    lk = threadsan.lock("test.locked_q")
+    assert lk.locked() is False
+    with lk:
+        assert lk.locked() is True
+    assert lk.locked() is False
+
+
+# --- the off-switch micro-bench ----------------------------------------------
+
+
+def test_disarmed_acquire_release_under_5us():
+    """ISSUE 18 acceptance: off path is attribute reads ahead of the raw
+    primitive — <5µs per acquire+release pair (same retry discipline as
+    the span()/slo.tick micro-benches)."""
+    assert not threadsan.registry._armed
+    lk = threadsan.lock("test.bench")
+    n = 2000
+    best = float("inf")
+    attempts = 0
+    while best >= 5e-6 and attempts < 20:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            lk.acquire()
+            lk.release()
+        best = min(best, (time.perf_counter() - t0) / n)
+        attempts += 1
+    assert best < 5e-6, f"disarmed acquire+release {best * 1e6:.2f}µs"
+
+
+# --- fakenet integration -----------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_fakenet_node_run_is_finding_free(threadsan_armed):
+    """A real node session (fakenet peers, headers sync, stats/health
+    walks) with threadsan armed: every migrated lock is exercised across
+    the loop thread + worker threads and the order graph stays
+    acyclic — the automated form of the ISSUE 18 lock-order audit."""
+    from tpunode import (
+        BCH_REGTEST,
+        Namespaced,
+        Node,
+        NodeConfig,
+        Publisher,
+    )
+    from tpunode.store import MemoryKV
+    from tpunode.wire import NetworkAddress
+
+    reg = threadsan_armed
+    pub = Publisher(name="node-events")
+    blocks = all_blocks()
+    cfg = NodeConfig(
+        net=BCH_REGTEST,
+        store=Namespaced(MemoryKV(), b"node:"),
+        pub=pub,
+        max_peers=20,
+        peers=["[::1]:17486"],
+        discover=False,
+        address=NetworkAddress.from_host_port("0.0.0.0", 0, services=1),
+        timeout=0.4,
+        max_peer_life=48 * 3600,
+        stats_interval=0.05,
+        connect=lambda sa: dummy_peer_connect(BCH_REGTEST, blocks),
+    )
+    async with pub.subscription():
+        async with Node(cfg) as node:
+            await _poll(
+                lambda: events.counts().get("chain.headers", 0) >= 1,
+                what="chain.headers event",
+            )
+            node.stats()
+            node.health()
+    assert reg.lock_cycles == 0, reg.findings
+    assert reg.lock_reentries == 0, reg.findings
+    snap = reg.snapshot()
+    assert snap["locks"] > 10  # the migrated registry is in play

@@ -491,6 +491,8 @@ KNOWN_LAYERS = frozenset({
     "slo",        # SLO engine: burn rates + budgets (tpunode/slo.py,
                   # ISSUE 17)
     "store",      # KV store (tpunode/store.py)
+    "threadsan",  # lock-order/lockset sanitizer (tpunode/threadsan.py,
+                  # ISSUE 18)
     "trace",      # tracing internals (tpunode/tracectx.py)
     "tsdb",       # metrics timeline sampler (tpunode/timeseries.py,
                   # ISSUE 16)
@@ -775,3 +777,172 @@ def _stale_doc(ctx: FileContext) -> None:
                 ),
             )
         )
+
+
+# --- raw-lock (ISSUE 18) ------------------------------------------------------
+
+
+@rule(
+    "raw-lock",
+    "bare threading.Lock()/RLock() construction bypasses the threadsan "
+    "registry (use tpunode.threadsan.lock()/rlock() so the lock is "
+    "named, hold-timed, and deadlock-checked)",
+)
+def _raw_lock(ctx: FileContext) -> None:
+    """Every lock in the tree goes through threadsan's LockRegistry —
+    that is what makes the lock-order graph complete.  threadsan.py
+    itself is exempt (its wrappers and the registry's one meta lock are
+    the raw primitives everything else is built on)."""
+    base = os.path.basename(ctx.path.replace(os.sep, "/"))
+    if base == "threadsan.py":
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        qual = ctx.resolve(func)
+        hit = qual in ("threading.Lock", "threading.RLock")
+        if (
+            not hit
+            and qual is None
+            and isinstance(func, ast.Attribute)
+            and func.attr in ("Lock", "RLock")
+            and isinstance(func.value, ast.Call)
+        ):
+            # dynamic receiver, e.g. __import__("threading").Lock()
+            hit = True
+        if hit:
+            kind = (func.attr if isinstance(func, ast.Attribute)
+                    else qual.rsplit(".", 1)[-1])
+            ctx.report(
+                "raw-lock", node,
+                f"bare threading.{kind}() outside the threadsan registry "
+                "(construct via tpunode.threadsan."
+                f"{'rlock' if kind == 'RLock' else 'lock'}('<layer>.<name>') "
+                "so it joins the lock-order graph)",
+            )
+
+
+# --- jit-cache-key (ISSUE 18) -------------------------------------------------
+
+# The formulation-mode accessors (tpunode/verify/modes.py): any compiled
+# wrapper whose behaviour depends on the active modes must key on one of
+# these — PR 4's shared-trace-cache bug was a jit cache that silently
+# served one mode's trace to another.
+_MODE_FNS = frozenset({"kernel_modes", "field_modes", "structure_modes"})
+
+
+def _static_argnames_have_modes(call: ast.Call) -> "bool | None":
+    """True/False when the call carries static_argnames (do they include
+    a mode tuple?); None when neither static kwarg is present."""
+    saw = None
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            return True  # positional static key — accepted as-is
+        if kw.arg == "static_argnames":
+            saw = False
+            names: list = []
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                names = [_literal(el) for el in kw.value.elts]
+            else:
+                names = [_literal(kw.value)]
+            if any(n is not None and "modes" in n for n in names):
+                saw = True
+    return saw
+
+
+def _scope_calls_mode_fn(ctx: FileContext, fstack: list) -> bool:
+    for f in fstack:
+        for sub in ast.walk(f):
+            if isinstance(sub, ast.Call):
+                q = ctx.resolve(sub.func)
+                if q is not None and q.rsplit(".", 1)[-1] in _MODE_FNS:
+                    return True
+    return False
+
+
+@rule(
+    "jit-cache-key",
+    "jax.jit wrapper in tpunode/verify/ is not keyed on the formulation "
+    "modes (thread kernel_modes()/field_modes()/structure_modes() "
+    "through static_argnums/static_argnames, or key the surrounding "
+    "cache dict on it)",
+)
+def _jit_cache_key(ctx: FileContext) -> None:
+    """PR 4's discovery, enforced: two formulations tracing through one
+    jit cache silently serve each other's compilations.  Every
+    ``jax.jit(...)`` (or ``partial(jax.jit, ...)``) in the verify layer
+    must either carry the mode tuple as a static argument or live in a
+    scope that computes its cache key from a mode accessor."""
+    path = ctx.path.replace(os.sep, "/")
+    if "verify" not in path.split("/") and not path.startswith("<"):
+        return  # in-memory sources ("<...>") stay lintable for tests
+
+    def visit(node: ast.AST, fstack: list) -> None:
+        for child in ast.iter_child_nodes(node):
+            stack = fstack
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack = fstack + [child]
+            elif isinstance(child, ast.Call):
+                check(child, fstack)
+            visit(child, stack)
+
+    def check(call: ast.Call, fstack: list) -> None:
+        qual = ctx.resolve(call.func)
+        if qual == "jax.jit":
+            jit = call
+        elif (
+            qual is not None
+            and qual.rsplit(".", 1)[-1] == "partial"
+            and call.args
+            and ctx.resolve(call.args[0]) == "jax.jit"
+        ):
+            jit = call
+        else:
+            return
+        static = _static_argnames_have_modes(jit)
+        if static is True:
+            return
+        if static is None and _scope_calls_mode_fn(ctx, fstack):
+            return
+        ctx.report(
+            "jit-cache-key", jit,
+            "jax.jit wrapper is not keyed on the formulation modes "
+            "(add the mode tuple to static_argnames/static_argnums or "
+            "key the enclosing cache on kernel_modes()/field_modes()/"
+            "structure_modes())",
+        )
+
+    visit(ctx.tree, [])
+
+
+# --- env-knob-doc (ISSUE 18) --------------------------------------------------
+
+_ENV_KNOB_RE = re.compile(r"^TPUNODE_[A-Z0-9_]+$")
+
+
+@rule(
+    "env-knob-doc",
+    "TPUNODE_* env knob literal is missing from OBSERVABILITY.md's "
+    "env-var inventory (every shipped knob needs an inventory row)",
+)
+def _env_knob_doc(ctx: FileContext) -> None:
+    """Same doc-drift contract as the telemetry inventory, for config
+    knobs: an operator reading OBSERVABILITY.md must see every env var
+    the tree actually reads.  Containment is whole-doc (a prose mention
+    counts), so one inventory row per knob is the cheap fix."""
+    doc = _observability_text()
+    if doc is None:
+        return
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _ENV_KNOB_RE.match(node.value)
+            and node.value not in doc
+        ):
+            ctx.report(
+                "env-knob-doc", node,
+                f"env knob {node.value!r} is not documented in "
+                "OBSERVABILITY.md (add an env-var inventory row)",
+            )

@@ -42,6 +42,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from . import threadsan
 from .chaos import chaos
 from .events import EventLog, events
 from .metrics import metrics
@@ -61,6 +62,8 @@ TRIGGERS = frozenset(
         "store.corruption",
         "utxo.error",
         "asyncsan.task_leak",
+        "threadsan.lock_cycle",
+        "threadsan.lock_reentry",
         "slo.burn",
     }
 )
@@ -98,7 +101,7 @@ class FlightRecorder:
         # name -> zero-arg callable; each lands as a top-level bundle key
         # (engine stats, watchdog snapshot, node health, store stats, ...)
         self.sources = dict(sources or {})
-        self._lock = threading.Lock()
+        self._lock = threadsan.lock("blackbox.recorder")
         self._records: deque[dict] = deque(maxlen=self.cfg.ring)
         self._last_dump = -float("inf")
         self._suppressed = 0

@@ -83,6 +83,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from . import threadsan
 from .events import events
 from .metrics import metrics
 
@@ -249,7 +250,7 @@ class Chaos:
 
     def __init__(self):
         self.on = False
-        self._lock = threading.Lock()
+        self._lock = threadsan.lock("chaos.controller")
         self._plan: Optional[ChaosPlan] = None
         self._rng: Optional[random.Random] = None
         self._by_point: dict[str, list[FaultSpec]] = {}

@@ -36,6 +36,7 @@ import time
 from collections import Counter, deque
 from typing import Callable, Optional
 
+from . import threadsan
 from .metrics import metrics
 
 __all__ = ["EventLog", "events", "StatsReporter"]
@@ -63,7 +64,7 @@ class EventLog:
     MAX_SUBSCRIBER_FAILURES = 10
 
     def __init__(self, maxlen: int = 4096, path: Optional[str] = None):
-        self._lock = threading.Lock()
+        self._lock = threadsan.lock("events.ring")
         # Monotonic per-log sequence number, assigned under the ring lock:
         # the /events?since=<seq> cursor (pollers fetch only what they
         # have not seen) and the flight recorder's bundle ordering both
@@ -73,7 +74,7 @@ class EventLog:
         # Separate sink lock: TextIOWrapper is NOT thread-safe, so file
         # writes must serialize — but behind their own lock, so a slow
         # disk stalls only writers, never ring readers/counters.
-        self._sink_lock = threading.Lock()
+        self._sink_lock = threadsan.lock("events.sink")
         self._ring: deque[dict] = deque(maxlen=maxlen)
         self._counts: Counter[str] = Counter()
         self._file: Optional[io.TextIOBase] = None

@@ -28,6 +28,8 @@ from bisect import bisect_left
 from collections import deque
 from typing import Iterable, Optional, Sequence
 
+from . import threadsan
+
 __all__ = [
     "DEFAULT_BUCKETS",
     "Histogram",
@@ -190,7 +192,7 @@ class Metrics:
             if disabled is None
             else disabled
         )
-        self._lock = threading.Lock()
+        self._lock = threadsan.lock("metrics.registry")
         self._counters: dict[tuple[str, _LabelKey], _Counter] = {}
         self._gauges: dict[tuple[str, _LabelKey], float] = {}
         self._hists: dict[tuple[str, _LabelKey], Histogram] = {}

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Iterator, Optional, Sequence
 
+from . import threadsan
 from .metrics import metrics
 from .store import BatchOp, StoreVersionError, delete_op, put_op, v2_artifacts
 
@@ -92,7 +93,7 @@ _SCAN_HDR = struct.Struct("<II")
 _OP_PUT = 1
 _OP_DEL = 2
 
-_lib_lock = threading.Lock()
+_lib_lock = threadsan.lock("native.lib")
 _lib: Optional[ctypes.CDLL] = None
 
 

@@ -24,7 +24,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from . import asyncsan
+from . import asyncsan, threadsan
 from .actors import (
     LinkedTasks,
     Publisher,
@@ -425,6 +425,12 @@ class Node:
             asyncsan.install()
             self._attributor = asyncsan.LoopAttributor()
             self._attributor.start()
+        if threadsan.enabled():
+            # the thread-side twin (TPUNODE_THREADSAN, ANALYSIS.md): arms
+            # the lock registry's cycle/reentry/hold instrumentation and
+            # marks this loop thread so blocking acquires that stall it
+            # are reported
+            threadsan.install()
         try:
             return await self._start()
         except BaseException:
@@ -510,6 +516,7 @@ class Node:
                 sources["utxo"] = self.utxo.stats
             if self.slo is not None:
                 sources["slo"] = self.slo.snapshot
+            sources["threadsan"] = threadsan.registry.snapshot
             self.blackbox = FlightRecorder(
                 FlightRecorderConfig(dir=self.cfg.blackbox_dir),
                 timeline=self.timeline,
@@ -1830,10 +1837,8 @@ class Node:
         if not cfuts:
             region.close()
             return
-        import threading
-
         state = {"remaining": len(cfuts)}
-        lock = threading.Lock()
+        lock = threadsan.lock("node.region_refcount")
 
         def _done(_f):
             with lock:

@@ -50,6 +50,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Iterable, Optional
 
+from . import threadsan
 from .events import EventLog, events
 from .metrics import Metrics, metrics
 
@@ -205,7 +206,7 @@ class SloEvaluator:
         self.ledger = ledger  # zero-arg -> engine ledger snapshot
         # one lock: tick() runs on the sampler task, snapshot() from
         # whatever thread the flight recorder fires on
-        self._lock = threading.Lock()
+        self._lock = threadsan.lock("slo.evaluator")
         self._states = {d.name: _SloState(d, self.TIERS) for d in self.defs}
         self._ticks = 0
         # (slo, window) pairs currently in a burn episode: emit once,

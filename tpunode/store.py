@@ -58,6 +58,7 @@ import time
 import zlib
 from typing import Iterator, Optional, Protocol, Sequence
 
+from . import threadsan
 from .chaos import ChaosFault, chaos
 from .events import events
 from .metrics import metrics
@@ -398,7 +399,7 @@ class LogKV:
         self._live_bytes = 0
         # guards file handles, segment bookkeeping and _data mutation —
         # the group-commit thread and the caller thread share all three
-        self._lock = threading.RLock()
+        self._lock = threadsan.rlock("store.groupcommit")
         self._writer: Optional[_GroupCommitWriter] = None
         self._failed: Optional[BaseException] = None
         self._compacting = False

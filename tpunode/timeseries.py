@@ -44,6 +44,7 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Optional
 
+from . import threadsan
 from .metrics import Metrics, metrics
 
 __all__ = ["Timeline", "DEFAULT_TIERS", "DEFAULT_LABEL_FAMILIES"]
@@ -89,7 +90,7 @@ class Timeline:
         # series name -> per-tier deque[(ts, value)].  One lock: tick()
         # writes from the sampler task, window() reads from whatever
         # thread the flight recorder fires on (engine dispatch workers).
-        self._lock = threading.Lock()
+        self._lock = threadsan.lock("timeseries.rings")
         self._rings: dict[str, tuple[deque, ...]] = {}
         self._ticks = 0
         self._dropped: set[str] = set()

@@ -42,6 +42,7 @@ import time
 from collections import deque
 from typing import Iterator, Optional
 
+from . import threadsan
 from .metrics import metrics
 
 __all__ = [
@@ -124,7 +125,7 @@ class Trace:
         self.t0 = time.perf_counter()
         self.wall0 = time.time()
         self.finished = False
-        self._lock = threading.Lock()
+        self._lock = threadsan.lock("tracectx.trace")
         self._next = itertools.count(2)
         root = SpanRec(1, None, name, 0.0)
         if fields:
@@ -227,7 +228,7 @@ class Tracer:
             else enabled
         )
         self.ring = ring
-        self._lock = threading.Lock()
+        self._lock = threadsan.lock("tracectx.tracer")
         self._slowest: list[Trace] = []  # kept sorted, slowest first
         self._recent: deque[Trace] = deque(maxlen=recent)
 

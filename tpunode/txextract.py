@@ -24,6 +24,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from . import threadsan
 from .txverify import ExtractStats
 
 __all__ = [
@@ -38,7 +39,7 @@ __all__ = [
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _LIB_PATH = os.path.join(_REPO_ROOT, "native", "build", "libtxextract.so")
 
-_lib_lock = threading.Lock()
+_lib_lock = threadsan.lock("txextract.lib")
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 

@@ -101,7 +101,9 @@ def point_double(p: Point) -> Point:
 # atomically: engine warmup (a daemon thread) and oracle batches (worker
 # threads) can race to first use.
 _G_TABLE: tuple[tuple[Point, ...], ...] | None = None
-_G_TABLE_LOCK = __import__("threading").Lock()
+_G_TABLE_LOCK = __import__("tpunode.threadsan", fromlist=["lock"]).lock(
+    "verify.ecdsa_table"
+)
 
 
 def _g_table() -> tuple[tuple[Point, ...], ...]:

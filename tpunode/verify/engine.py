@@ -74,6 +74,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
+from .. import threadsan
 from ..actors import spawn_supervised
 from ..chaos import ChaosPartition, chaos
 from ..events import events
@@ -278,8 +279,13 @@ class CircuitBreaker:
         # Reentrant: _transition emits verify.breaker with the lock held,
         # and a synchronous event observer (the flight recorder freezing
         # a bundle on the open transition) calls back into stats() on the
-        # same thread — a plain Lock would self-deadlock there.
-        self._lock = threading.RLock()
+        # same thread — a plain Lock would self-deadlock there (the PR 14
+        # hang, now pinned via threadsan in tests/test_threadsan.py).
+        # Per-host breakers register under their own name so the fleet's
+        # host->engine acquisition edges don't alias into self-loops.
+        self._lock = threadsan.rlock(
+            f"verify.breaker.{name}" if name else "verify.breaker"
+        )
         self._state = "ready"
         self._failures: collections.deque[float] = collections.deque()
         self._opened_at: Optional[float] = None
@@ -582,7 +588,7 @@ class CostLedger:
     snapshots from stats()/the flight recorder."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threadsan.lock("verify.ledger")
         # (priority, rung) -> [charged seconds, items]
         self._cells: dict[tuple[str, str], list] = {}
         self._busy = 0.0  # total measured rung busy seconds
@@ -668,7 +674,7 @@ class VerifyEngine:
         # Written by the queue loop and the lane tasks, read by the
         # watchdog thread: guarded by _inflight_lock.
         self._inflight: dict[int, float] = {}
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = threadsan.lock("verify.inflight")
         self._inflight_seq = 0
         # Cost-attribution ledger (ISSUE 17) + the per-dispatch-thread
         # slot carrying the lane's class counts into _dispatch_multi
@@ -688,7 +694,7 @@ class VerifyEngine:
         # transient loser could pin "failed" over a winner's mesh.
         self._mesh_obj = None
         self._mesh_state = "cold"
-        self._mesh_lock = threading.Lock()
+        self._mesh_lock = threadsan.lock("verify.mesh")
         # Pod-scale fleet (ISSUE 13, cfg.mesh_hosts >= 2): per-host
         # states + the work-stealing dispatcher, built in __aenter__;
         # the hybrid mesh's device rows are carved lazily on the first
@@ -726,7 +732,7 @@ class VerifyEngine:
         self._device_error: Optional[str] = None
         self._warmup_started = 0.0
         self._warmup_failed_at = 0.0
-        self._warmup_lock = threading.Lock()
+        self._warmup_lock = threadsan.lock("verify.warmup")
         self._warmup_done = threading.Event()
         self._slow_logged = False
         # device-dispatch circuit breaker (ISSUE 7): engaged only once
