@@ -125,6 +125,10 @@ T_IBD = float(os.environ.get("TPUNODE_BENCH_IBD_TIMEOUT", 420))
 # Pod-scale fleet-dispatcher scaling (ISSUE 13): 1/2/4/8-way sharding on
 # the cpu-native proxy plus the campaign bit-identity pass.
 T_MESH = float(os.environ.get("TPUNODE_BENCH_MESH_TIMEOUT", 300))
+# Host-affine feed A/B (ISSUE 19): two 4-way e2e legs (affine vs
+# central-feed baseline) plus the campaign pass through the affine
+# path, all on the cpu-native proxy.
+T_MESH_E2E = float(os.environ.get("TPUNODE_BENCH_MESH_E2E_TIMEOUT", 240))
 # Observability overhead (ISSUE 16): timeline-sampler tick cost and
 # flight-recorder bundle build, measured over a synthetic registry.
 # jax is never imported (timeseries/blackbox are stdlib-only).
@@ -1212,6 +1216,249 @@ def _worker_mesh() -> None:
         print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
 
 
+def _worker_mesh_e2e() -> None:
+    """Host-affine feed A/B (ISSUE 19): the ingest→extract/pack→dispatch
+    →verdict path at 4-way on the cpu-native proxy, affinity ON (keyed
+    submissions land in their home host's packer; intake gates on the
+    TARGET host's feed depth) vs the central-feed baseline (keyless
+    submissions through the shared packer; intake gates on GLOBAL
+    unresolved pending — the pre-affinity node policy).
+
+    Both legs get the identical workload (keyed ingest batches, each
+    packed in-loop — the extract/pack stage is inside the timed window),
+    the identical deferred-intake retry tick, and the identical fault:
+    host h0's dispatch stalls ``slow_s`` per lane.  The gates differ the
+    way the policies differ: the baseline's global budget is ONE
+    pipeline's feed ceiling — fleet-blind, like the node's fixed
+    ``MAX_VERIFY_PENDING`` was before affinity — while the affine leg
+    budgets the SAME ceiling per host (per-host gates scale intake with
+    the fleet by construction).  That asymmetry is the policy under
+    test: a per-host gate defers ONLY the slow host's keys while the
+    rest of the fleet stays fed; the global gate parks the whole intake
+    stream behind the retry timer whenever total unresolved work — most
+    of it stuck behind the slow host — trips the one shared budget.  The
+    retry tick is 0.25s, deliberately kinder to the baseline than the
+    node's real deferral granularity (the 1s mempool scheduler tick).
+    Per-host ``feed_idle`` (idle-take fraction) is reported for both legs
+    as the starvation signal.  The campaign pool additionally runs
+    through the affine path and is cross-checked bit-identical against
+    the single-chip verdicts.  Prints one JSON line; the parent watchdog
+    bounds it.
+    """
+    import asyncio
+    import hashlib
+
+    sigs = int(os.environ.get("TPUNODE_BENCH_MESH_E2E_SIGS", 12288))
+    hosts = int(os.environ.get("TPUNODE_BENCH_MESH_E2E_HOSTS", 4))
+    try:
+        from benchmarks.campaign import build_pool
+        from benchmarks.common import make_triples, tile
+        from tpunode.metrics import metrics
+        from tpunode.verify.cpu_native import load_native_verifier
+        from tpunode.verify.engine import VerifyConfig, VerifyEngine
+        from tpunode.verify.raw import pack_items
+        from tpunode.verify.sched import affinity_key
+
+        if load_native_verifier() is None:
+            print(json.dumps(
+                {"ok": False, "error": "native verifier unavailable"}
+            ))
+            return
+        batch_items = 256  # one ingest batch = one getdata-sized unit
+        lane = 256         # small lane target -> tight per-host ceiling
+        retry_s = 0.25     # deferred-intake retry tick (see docstring)
+        slow_s = 0.05      # injected h0 stall per dispatched lane
+        _progress(f"generating {sigs} tiled sigs...")
+        uniq = make_triples(min(2048, sigs))
+        items = tile(uniq, sigs)
+        batches = [
+            items[off : off + batch_items]
+            for off in range(0, len(items), batch_items)
+        ]
+        # one stable pseudo-txid per ingest batch: the affinity key is a
+        # pure function of the batch index, so both legs and every rerun
+        # route identically
+        keys = [
+            affinity_key(
+                hashlib.blake2b(b"mesh-e2e-%d" % i, digest_size=8).digest()
+            )
+            for i in range(len(batches))
+        ]
+
+        def _slow_h0(eng) -> None:
+            # the same dispatch seam the scheduler tests use: h0 sleeps
+            # in its dispatch worker thread, so its queue backs up while
+            # the loop (and the other hosts) keep running
+            orig = eng._dispatch_multi
+
+            def wrapper(payloads, target=None, host=None, backend=None):
+                if host is not None and host.name == "h0":
+                    time.sleep(slow_s)
+                if host is None and backend is None:
+                    return orig(payloads, target)
+                return orig(payloads, target, host=host, backend=backend)
+
+            eng._dispatch_multi = wrapper
+
+        async def run_leg(affine: bool) -> dict:
+            metrics.reset()
+            cfg = VerifyConfig(
+                backend="cpu", batch_size=lane, max_wait=0.005,
+                pipeline_depth=1, cpu_threads=1, warmup=False,
+                mesh_hosts=hosts,
+            )
+            async with VerifyEngine(cfg) as eng:
+                _slow_h0(eng)
+                # the baseline's budget: ONE pipeline's feed ceiling,
+                # fleet-blind (pre-affinity MAX_VERIFY_PENDING shape);
+                # the affine leg's per-host gates carry the same
+                # ceiling PER HOST inside eng.host_pressured()
+                limit_global = eng._feed_limit()
+                pending = 0
+                deferrals = 0
+                futs = []
+
+                def _dec(_f, n: int) -> None:
+                    nonlocal pending
+                    pending -= n
+
+                t0 = time.perf_counter()
+                for b, key in zip(batches, keys):
+                    if affine:
+                        while eng.host_pressured(key):
+                            deferrals += 1
+                            await asyncio.sleep(retry_s)
+                    else:
+                        while pending >= limit_global:
+                            deferrals += 1
+                            await asyncio.sleep(retry_s)
+                    raw = pack_items(b)  # extract/pack inside the window
+                    pending += len(b)
+                    fut = asyncio.ensure_future(  # asyncsan: disable=raw-spawn
+                        eng.verify_raw(
+                            raw, priority="mempool",
+                            affinity=key if affine else None,
+                        )
+                    )
+                    fut.add_done_callback(
+                        lambda f, n=len(b): _dec(f, n)
+                    )
+                    futs.append(fut)
+                got = await asyncio.gather(*futs)
+                dt = time.perf_counter() - t0
+                st = eng.stats()
+            n = sum(len(g) for g in got)
+            assert n == sigs
+            fleet = st["fleet"]
+            out = {
+                "affine": affine,
+                "wall_s": round(dt, 3),
+                "sigs_per_s": round(sigs / dt, 1) if dt else 0.0,
+                "deferrals": deferrals,
+                "feed_idle": fleet["feed_idle"],
+                "steals": fleet["steals"],
+            }
+            if affine:
+                out["affinity"] = fleet["affinity"]
+            return out
+
+        async def campaign_affine() -> dict:
+            # the adversarial pool through the AFFINE path: every chunk
+            # keyed, verdicts bit-identical to the single-chip pass (a
+            # router that dropped, duplicated, or cross-wired a keyed
+            # submission would show up here, not just in throughput)
+            import random as _random
+
+            items_c, shapes, expects = build_pool(
+                24, _random.Random(0x13E5)
+            )
+
+            async def through(fleet_hosts: int) -> list:
+                cfg = VerifyConfig(
+                    backend="cpu", batch_size=64, max_wait=0.005,
+                    pipeline_depth=1, warmup=False,
+                    mesh_hosts=fleet_hosts,
+                )
+                async with VerifyEngine(cfg) as eng:
+                    futs, k, i = [], 0, 0
+                    sizes = [37, 53, 11, 97, 5]
+                    while k < len(items_c):
+                        n = sizes[i % len(sizes)]
+                        aff = (
+                            affinity_key(hashlib.blake2b(
+                                b"camp-%d" % i, digest_size=8
+                            ).digest())
+                            if fleet_hosts else None
+                        )
+                        i += 1
+                        # awaited in the return below (whole-list drain)
+                        futs.append(asyncio.ensure_future(  # asyncsan: disable=raw-spawn
+                            eng.verify(
+                                items_c[k : k + n], affinity=aff
+                            )
+                        ))
+                        k += n
+                    return [v for f in futs for v in await f]
+
+            affine_v = await through(hosts)
+            single_v = await through(0)
+            mism = [
+                (j, shapes[j])
+                for j, (g, e) in enumerate(zip(affine_v, expects))
+                if g != e
+            ]
+            return {
+                "items": len(items_c),
+                "mismatches": len(mism),
+                "single_chip_identical": affine_v == single_v,
+                "clean": not mism and affine_v == single_v,
+                **({"first_mismatches": mism[:5]} if mism else {}),
+            }
+
+        async def run() -> dict:
+            _progress("central-feed baseline leg...")
+            central = await run_leg(affine=False)
+            _progress("affine leg...")
+            affine = await run_leg(affine=True)
+            _progress("campaign through the affine path...")
+            camp = await campaign_affine()
+            ratio = (
+                round(affine["sigs_per_s"] / central["sigs_per_s"], 3)
+                if central["sigs_per_s"] else None
+            )
+            floor = 1.25
+            out = {
+                "ok": bool(camp["clean"])
+                and ratio is not None and ratio >= floor,
+                "proxy": "cpu-native",
+                "sigs": sigs,
+                "hosts": hosts,
+                "batch_items": batch_items,
+                "slow_host": {"host": "h0", "stall_s": slow_s},
+                "retry_s": retry_s,
+                "central": central,
+                "affine": affine,
+                "speedup": ratio,
+                "speedup_floor": floor,
+                "campaign": camp,
+            }
+            if not camp["clean"]:
+                out["fatal"] = True  # verdict divergence, never mask
+                out["error"] = "affine-path/single-chip verdict mismatch"
+            elif ratio is None:
+                out["error"] = "central baseline produced no rate"
+            elif ratio < floor:
+                out["error"] = (
+                    f"affine/central speedup {ratio} below the "
+                    f"{floor}x floor"
+                )
+            return out
+
+        print(json.dumps(asyncio.run(run())))
+    except Exception as e:  # noqa: BLE001 — worker reports, parent decides
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
+
+
 def _worker_mesh_device() -> None:
     """One device-mesh sharding sample (ISSUE 13; the watcher's
     ``kind="mesh"`` rungs): raw-batch dispatch through
@@ -1956,6 +2203,31 @@ def _mesh_section() -> dict:
     return res
 
 
+def _mesh_e2e_section() -> dict:
+    """The BENCH JSON ``mesh_e2e`` section (ISSUE 19): host-affine vs
+    central-feed e2e throughput at 4-way under a slow host (acceptance
+    floor 1.25x the central baseline), per-host feed-idle starvation
+    fractions for both legs, and the campaign verdict bit-identity pass
+    through the affine path, from a bounded worker subprocess.  Always
+    returns a dict — a failed/timed-out scenario is labeled, never
+    masked (a campaign mismatch is additionally marked ``fatal`` so the
+    driver exits nonzero, exactly like the headline's)."""
+    res = _run_worker(
+        "--mesh-e2e", T_MESH_E2E,
+        # cpu proxy by construction: backend="cpu" never imports jax;
+        # the pin is belt-and-braces against future drift
+        {"JAX_PLATFORMS": "cpu"},
+    )
+    if not res.get("ok") and "error" in res:
+        out = {"ok": False, "error": str(res["error"])[:300]}
+        for k in ("central", "affine", "speedup", "speedup_floor",
+                  "campaign", "fatal"):
+            if k in res:
+                out[k] = res[k]
+        return out
+    return res
+
+
 def _mempool_section() -> dict:
     """The BENCH JSON ``mempool`` section: ingest efficiency from the
     duplicate-heavy fan-in scenario, measured in a bounded worker
@@ -1988,10 +2260,12 @@ def _worker_observability() -> None:
         from tpunode.metrics import metrics
         from tpunode.timeseries import Timeline
 
+        from tpunode.verify.sched import host_names  # jax-free
+
         for i in range(100):
             metrics.inc("bench.obs_series_%d" % i, i + 1)
-        for h in range(8):
-            host = {"host": "h%d" % h}
+        for h, name in enumerate(host_names(8)):
+            host = {"host": name}
             metrics.set_gauge("sched.host_depth", float(h), labels=host)
             metrics.set_gauge("verify.breaker_state", 0.0, labels=host)
             metrics.set_gauge("mesh.host_chips", 4.0, labels=host)
@@ -2480,6 +2754,12 @@ def _main_locked() -> None:
     # 1/2/4/8-way on the cpu-native proxy (>= 0.8x ideal at 4-way) and
     # the campaign bit-identity pass — failure-labeled like the others.
     out["mesh"] = _mesh_section()
+    # Host-affine feed section (ISSUE 19): affine vs central-feed e2e
+    # throughput at 4-way under a slow host (>= 1.25x the central
+    # baseline), per-host feed-idle fractions, and the campaign
+    # bit-identity pass through the affine path — failure-labeled like
+    # the others.
+    out["mesh_e2e"] = _mesh_e2e_section()
     # Kernel point-form A/B section (ISSUE 8): projective vs affine step
     # time on cpu-jax, failure-labeled per batch like the sections above.
     # Named "kernel_ab" because the top-level "kernel" key already names
@@ -2498,7 +2778,12 @@ def _main_locked() -> None:
         isinstance(cell, dict) and cell.get("fatal")
         for cell in out["kernel_ab"].values()
     )
-    if res.get("fatal") or kab_fatal or out["mesh"].get("fatal"):
+    if (
+        res.get("fatal")
+        or kab_fatal
+        or out["mesh"].get("fatal")
+        or out["mesh_e2e"].get("fatal")
+    ):
         sys.exit(1)
 
 
@@ -2523,6 +2808,8 @@ if __name__ == "__main__":
         _worker_ibd()
     elif "--mesh-device" in sys.argv:
         _worker_mesh_device()
+    elif "--mesh-e2e" in sys.argv:
+        _worker_mesh_e2e()
     elif "--mesh" in sys.argv:
         _worker_mesh()
     elif "--observability" in sys.argv:

@@ -149,6 +149,13 @@ CONFIG_BUDGETS = {"config2": 600.0, "config5": 900.0, "config3": 900.0}
 # Observability-overhead slot (ISSUE 16/17): the worker is jax-free and
 # CPU-pinned, so the budget only covers interpreter start + micro-bench.
 OBS_BUDGET = float(os.environ.get("TPUNODE_WATCHER_OBS_BUDGET", 120))
+# Host-affine feed A/B slot (ISSUE 19): two 4-way cpu-native e2e legs
+# plus the campaign pass — jax-free like the observability slot, but
+# each leg carries real native verification, so the budget matches the
+# bench driver's section budget.
+MESH_E2E_BUDGET = float(
+    os.environ.get("TPUNODE_WATCHER_MESH_E2E_BUDGET", 240)
+)
 # Sweep order: config2 is cheap; config3 (full-node IBD on device) is
 # the VERDICT item-2 money shot and must be banked before config5,
 # whose ~150k-sig batch is the slowest compile during an outage.  One
@@ -635,6 +642,31 @@ def run_observability() -> bool:
     return False
 
 
+def run_mesh_e2e() -> bool:
+    """Once-per-round host-affine feed A/B sample (ISSUE 19): the
+    bench.py --mesh-e2e worker's affine-vs-central e2e throughput at
+    4-way under a slow host, per-host feed-idle fractions, and the
+    campaign pass through the affine path, banked as a
+    ``kind="mesh_e2e"`` row.  The worker is the cpu-native proxy
+    (JAX_PLATFORMS=cpu, jax never imported), so like the observability
+    slot it runs even when the device is down and never needs to yield
+    to bench.py.  A failed worker keeps the slot for a later window; a
+    campaign mismatch is fatal for the round (verdict divergence must
+    never be masked by a later passing sample)."""
+    res = _run_json(
+        [sys.executable, "bench.py", "--mesh-e2e"],
+        MESH_E2E_BUDGET, {"JAX_PLATFORMS": "cpu"},
+    )
+    if res.get("fatal"):
+        _record("fatal", res)
+        raise FatalMismatch(res.get("error", "affine verdict mismatch"))
+    if res.get("ok"):
+        _record("mesh_e2e", res)
+        return True
+    _log(f"mesh_e2e: {res.get('error', '?')}")
+    return False
+
+
 def run_config(name: str) -> dict | None:
     if _bench_running():
         _log(f"{name}: bench.py running — yielding the tunnel")
@@ -827,7 +859,8 @@ def handle_window(swept: set) -> float:
     once-per-round lazy-reduction sample (ISSUE 12), once-per-round
     pod-mesh sharding sample (ISSUE 13), once-per-round
     Mosaic diagnostic, once-per-round device-free observability-overhead
-    sample (ISSUE 16/17).  Mutates ``swept``
+    sample (ISSUE 16/17), once-per-round device-free host-affine feed
+    A/B sample (ISSUE 19).  Mutates ``swept``
     (the on-device captures so far this round) and returns the sleep
     interval until the next probe.  Raises FatalMismatch to stop the
     watcher for the round.
@@ -904,6 +937,11 @@ def handle_window(swept: set) -> float:
     # device-free, so it runs even when the tunnel is down.
     if "observability" not in swept and run_observability():
         swept.add("observability")
+    # Host-affine feed A/B sample (ISSUE 19): once per round, cpu-native
+    # and device-free like the observability slot — banks the
+    # affinity-on/off throughput row even when the tunnel is down.
+    if "mesh_e2e" not in swept and run_mesh_e2e():
+        swept.add("mesh_e2e")
     # Back off to the slow refresh cadence only once every config is
     # banked: with all of them captured the next window owes us nothing
     # but a headline refresh, but while configs are missing the next

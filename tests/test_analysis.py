@@ -163,6 +163,17 @@ import os
 def knob():
     return os.environ.get("TPUNODE_FIXTURE_UNDOCUMENTED")
 """,
+    # dynamically-formatted label value with no bounded source (ISSUE
+    # 19): the metric name itself is schema-valid and documented, so the
+    # one finding is the cardinality hazard, not a naming complaint
+    "label-cardinality": """\
+from tpunode.metrics import metrics
+
+def record(host_id):
+    metrics.set_gauge(
+        "sched.host_depth", 1.0, labels={"host": f"h{host_id}"}
+    )
+""",
 }
 
 

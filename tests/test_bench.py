@@ -133,6 +133,27 @@ _MESH_OK = {
                  "single_chip_identical": True, "clean": True},
 }
 
+# Canned healthy host-affine feed A/B result (ISSUE 19; the real
+# subprocess path is covered by test_mesh_e2e_worker_subprocess).
+_MESH_E2E_OK = {
+    "ok": True, "proxy": "cpu-native", "sigs": 12288, "hosts": 4,
+    "batch_items": 256, "slow_host": {"host": "h0", "stall_s": 0.05},
+    "retry_s": 0.25,
+    "central": {"affine": False, "wall_s": 4.006, "sigs_per_s": 3067.2,
+                "deferrals": 14,
+                "feed_idle": {"h0": 0.5152, "h1": 0.5152, "h2": 0.5152,
+                              "h3": 1.0},
+                "steals": 9},
+    "affine": {"affine": True, "wall_s": 2.71, "sigs_per_s": 4534.0,
+               "deferrals": 4,
+               "feed_idle": {"h0": 0.2308, "h1": 0.375, "h2": 0.2,
+                             "h3": 0.3125},
+               "steals": 11, "affinity": {"routed": 48, "spilled": 0}},
+    "speedup": 1.478, "speedup_floor": 1.25,
+    "campaign": {"items": 168, "mismatches": 0,
+                 "single_chip_identical": True, "clean": True},
+}
+
 # Canned healthy observability-overhead result (ISSUE 16; the real
 # subprocess path is covered by test_observability_worker_subprocess).
 _OBS_OK = {
@@ -197,6 +218,9 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         if mode == "--mesh":
             # likewise for the ride-along pod-mesh section (ISSUE 13)
             return dict(_MESH_OK)
+        if mode == "--mesh-e2e":
+            # likewise for the ride-along affine-feed A/B section (ISSUE 19)
+            return dict(_MESH_E2E_OK)
         if mode == "--observability":
             # likewise for the ride-along observability section (ISSUE 16)
             return dict(_OBS_OK)
@@ -243,7 +267,8 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         c for c in calls
         if c[0] not in (
             "--mempool", "--chaos", "--kernel-ab", "--recovery",
-            "--pipeline", "--ibd", "--mesh", "--observability",
+            "--pipeline", "--ibd", "--mesh", "--mesh-e2e",
+            "--observability",
         )
     ]
     return line, calls, rc
@@ -829,6 +854,151 @@ def test_mesh_section_fatal_mismatch_fails_the_run(monkeypatch):
     assert line["mesh"]["campaign"]["mismatches"] == 3
 
 
+def _is_mesh_e2e(mode, env):
+    return mode == "--mesh-e2e"
+
+
+def test_mesh_e2e_section_always_present(monkeypatch):
+    """ISSUE 19: the BENCH JSON carries a ``mesh_e2e`` section (host-
+    affine vs central-feed e2e throughput under a slow host, per-host
+    feed-idle starvation fractions, the affine campaign pass) on every
+    run."""
+    bench = _load_bench()
+    line, _, _ = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 1.0, "device": "tpu:v5e"}),
+        ],
+    )
+    me = line["mesh_e2e"]
+    assert me["ok"] is True
+    # the acceptance floor: affine >= 1.25x central, explicitly recorded
+    assert me["speedup_floor"] == 1.25
+    assert me["speedup"] >= me["speedup_floor"]
+    for leg in ("central", "affine"):
+        assert me[leg]["sigs_per_s"] > 0
+        assert set(me[leg]["feed_idle"]) == {"h0", "h1", "h2", "h3"}
+    # the starvation signal: the central feed idles the fleet harder
+    assert me["affine"]["feed_idle"]["h3"] < me["central"]["feed_idle"]["h3"]
+    assert me["affine"]["affinity"]["routed"] > 0
+    assert me["campaign"]["clean"] is True
+    assert me["campaign"]["single_chip_identical"] is True
+
+
+def test_mesh_e2e_section_worker_env_is_device_free(monkeypatch):
+    """The A/B worker runs on the cpu-native proxy (backend="cpu" never
+    imports jax); its env pins cpu anyway."""
+    bench = _load_bench()
+    seen = []
+    monkeypatch.setattr(
+        bench, "_run_worker",
+        lambda mode, timeout, env=None: (
+            seen.append((mode, timeout, dict(env or {})))
+            or dict(_MESH_E2E_OK)
+        ),
+    )
+    assert bench._mesh_e2e_section()["ok"] is True
+    ((mode, timeout, env),) = seen
+    assert mode == "--mesh-e2e"
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert timeout == bench.T_MESH_E2E
+
+
+def test_mesh_e2e_section_failure_labeled(monkeypatch):
+    """A below-floor (or timed-out) A/B is labeled — with whatever leg
+    evidence it produced — never masked, and never takes the headline
+    down with it."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_mesh_e2e, {"ok": False,
+                            "error": "affine/central speedup 1.02 below"
+                                     " the 1.25x floor",
+                            "speedup": 1.02, "speedup_floor": 1.25,
+                            "central": {"sigs_per_s": 4000.0},
+                            "affine": {"sigs_per_s": 4080.0}}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 9.0  # headline survived
+    me = line["mesh_e2e"]
+    assert me["ok"] is False
+    assert "below the 1.25x floor" in me["error"]
+    assert me["speedup"] == 1.02
+    assert me["central"]["sigs_per_s"] == 4000.0
+
+
+def test_mesh_e2e_section_fatal_mismatch_fails_the_run(monkeypatch):
+    """An affine-path/single-chip verdict divergence is a routing
+    correctness failure, not a perf miss: the section carries ``fatal``
+    and the driver exits nonzero exactly like the headline's."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_mesh_e2e, {"ok": False, "fatal": True,
+                            "error": "affine-path/single-chip verdict"
+                                     " mismatch",
+                            "campaign": {"items": 168, "mismatches": 2,
+                                         "clean": False}}),
+        ],
+    )
+    assert rc == 1
+    assert line["mesh_e2e"]["fatal"] is True
+    assert line["mesh_e2e"]["campaign"]["mismatches"] == 2
+
+
+def test_watcher_mesh_e2e_slot_banks_once_and_fatal_raises(monkeypatch):
+    """ISSUE 19 (satellite e): the watcher banks the affinity-on/off A/B
+    row once per round through the device-free slot; a failed worker
+    keeps the slot; a campaign mismatch records a fatal row and raises."""
+    from benchmarks import watcher as W
+
+    recorded = []
+    monkeypatch.setattr(W, "_record", lambda kind, p: recorded.append(kind))
+    calls = []
+
+    def fake_run(argv, timeout, env=None):
+        calls.append((list(argv), timeout, dict(env or {})))
+        return dict(_MESH_E2E_OK)
+
+    monkeypatch.setattr(W, "_run_json", fake_run)
+    assert W.run_mesh_e2e() is True
+    assert recorded == ["mesh_e2e"]
+    ((argv, timeout, env),) = calls
+    assert argv[-1] == "--mesh-e2e" and "bench.py" in argv[-2]
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert timeout == W.MESH_E2E_BUDGET
+
+    # transient failure: no row banked, slot kept for a later window
+    recorded.clear()
+    monkeypatch.setattr(
+        W, "_run_json",
+        lambda argv, t, env=None: {"ok": False, "error": "timed out"},
+    )
+    assert W.run_mesh_e2e() is False
+    assert recorded == []
+
+    # verdict divergence: fatal row + raise (never masked)
+    monkeypatch.setattr(
+        W, "_run_json",
+        lambda argv, t, env=None: {"ok": False, "fatal": True,
+                                   "error": "affine verdict mismatch"},
+    )
+    with pytest.raises(W.FatalMismatch):
+        W.run_mesh_e2e()
+    assert recorded == ["fatal"]
+
+
 @pytest.mark.slow  # four fleet runs + the campaign pass in a subprocess
 # (the tier-1 budget is seed-saturated on this box; the scripted pins
 # above cover the section contract)
@@ -992,6 +1162,41 @@ def test_mesh_worker_subprocess():
         assert cell["sigs_per_s"] > 0
     if (os.cpu_count() or 1) >= 4:
         assert line["ways"]["2"]["sigs_per_s"] > line["ways"]["1"]["sigs_per_s"]
+
+
+def test_mesh_e2e_worker_subprocess():
+    """The real ``--mesh-e2e`` worker end-to-end in a subprocess at a
+    reduced sig count: both legs complete with positive rates and full
+    per-host feed-idle maps, and the campaign pass through the affine
+    path is bit-identical.  The 1.25x speedup floor is NOT asserted
+    here — at this size on a loaded 1-core box both legs can be
+    compute-bound; a below-floor run is failure-labeled, which is the
+    contract, while a campaign mismatch would be fatal and IS pinned."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "bench.py"), "--mesh-e2e"],
+        env=dict(
+            os.environ,
+            TPUNODE_BENCH_MESH_E2E_SIGS="4096",
+            JAX_PLATFORMS="cpu",
+        ),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=200,
+    )
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "fatal" not in line, line
+    assert line["campaign"]["clean"] is True, line
+    assert line["campaign"]["single_chip_identical"] is True
+    assert line["speedup_floor"] == 1.25
+    hosts = {f"h{i}" for i in range(line["hosts"])}
+    for leg in ("central", "affine"):
+        assert line[leg]["sigs_per_s"] > 0
+        assert set(line[leg]["feed_idle"]) == hosts
+    assert line["affine"]["affinity"]["routed"] > 0
 
 
 def _is_ibd(mode, env):
@@ -1779,6 +1984,7 @@ def _setup_window(monkeypatch, W, head, why, mosaic=False):
     monkeypatch.setattr(W, "run_lazy", lambda: False)
     monkeypatch.setattr(W, "run_mesh", lambda: False)
     monkeypatch.setattr(W, "run_observability", lambda: False)
+    monkeypatch.setattr(W, "run_mesh_e2e", lambda: False)
     return configs, diags, recs
 
 

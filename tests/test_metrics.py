@@ -129,6 +129,35 @@ def test_drop_label_evicts_peer_series():
     assert m.series("peer.state") == {}
 
 
+def test_on_drop_hooks_fire_per_eviction_and_prune_dead():
+    """Lifecycle hooks (ISSUE 19): drop_label notifies registered
+    listeners with the evicted (key, value) pair; a listener that died
+    is pruned instead of raising."""
+    m = Metrics(disabled=False)
+    seen: list[tuple[str, str]] = []
+
+    def live_hook(key, value):
+        seen.append((key, value))
+
+    def doomed_hook(key, value):  # pragma: no cover - dies before firing
+        raise AssertionError("dead hook must never fire")
+
+    m.on_drop(live_hook)
+    m.on_drop(doomed_hook)
+    del doomed_hook
+    import gc
+
+    gc.collect()
+    m.inc("peer.msgs", labels={"peer": "a:1", "cmd": "ping"})
+    m.drop_label("peer", "a:1")
+    assert seen == [("peer", "a:1")]
+    assert len(m._drop_hooks) == 1  # the dead ref was pruned
+    # hooks fire even when nothing matched: the pair is the contract,
+    # letting listeners with private state (Timeline caps) stay in sync
+    m.drop_label("host", "h9")
+    assert seen == [("peer", "a:1"), ("host", "h9")]
+
+
 def test_gauge_and_counter_coexist():
     m = Metrics(disabled=False)
     m.inc("layer.things", 5)

@@ -19,6 +19,8 @@ Three tiers:
 """
 
 import asyncio
+import collections
+import itertools
 import threading
 import time
 
@@ -28,10 +30,13 @@ from tpunode.actors import Publisher, task_registry
 from tpunode.metrics import metrics
 from tpunode.verify.engine import VerifyConfig, VerifyEngine
 from tpunode.verify.sched import (
+    AffinityMap,
     FleetDispatcher,
     LanePacker,
     PRIORITIES,
     Submission,
+    affinity_key,
+    host_names,
     slice_payload,
 )
 from tpunode.watchdog import Watchdog, WatchdogConfig
@@ -966,6 +971,191 @@ async def test_fleet_mesh_shrink_soak(monkeypatch, threadsan_armed):
     assert task_registry.report_leaks() == []
     # threadsan (ISSUE 18): the whole 8->1->8 cycle — per-host breakers,
     # fleet dispatcher, canary probes, ledger charges — orders cleanly
+    assert threadsan_armed.lock_cycles == 0, threadsan_armed.findings
+    assert threadsan_armed.lock_reentries == 0, threadsan_armed.findings
+
+
+# --- host-affine feeds (ISSUE 19) --------------------------------------------
+
+
+def test_affinity_map_stable_placement():
+    """Rendezvous placement invariants: keys spread across the fleet,
+    removing a host remaps ONLY that host's keys, and a rejoin restores
+    the original placement exactly (shrink never re-shuffles the
+    steady state)."""
+    hosts = host_names(4)
+    assert hosts == ["h0", "h1", "h2", "h3"]
+    amap = AffinityMap(hosts)
+    keys = list(range(20000))
+    home = {k: amap.prefer(k) for k in keys}
+    # balance: a uniform mix lands every host within a loose band
+    counts = collections.Counter(home.values())
+    for h in hosts:
+        assert 0.15 < counts[h] / len(keys) < 0.35, counts
+    # shrink: only h2's keys move, everyone else's argmax is unchanged
+    active = [h for h in hosts if h != "h2"]
+    for k in keys:
+        routed = amap.route(k, active)
+        if home[k] == "h2":
+            assert routed != "h2"
+        else:
+            assert routed == home[k]
+    # rejoin: routing over the full set IS the original placement
+    assert all(amap.route(k, hosts) == home[k] for k in keys)
+    # dark fleet: no active host -> None (caller falls back to central)
+    assert amap.route(1, []) is None
+    # the txid key is the first 8 digest bytes, little-endian
+    assert affinity_key(bytes(range(1, 33))) == int.from_bytes(
+        bytes(range(1, 9)), "little"
+    )
+
+
+@pytest.mark.asyncio
+async def test_fleet_affine_routing_and_teardown_drops_series():
+    """Keyed submissions land on their rendezvous home host (routed
+    counters up, zero spills with the fleet healthy), verdicts conserve
+    through the affine path, and engine teardown retires every
+    host-labeled series from the registry (satellite a)."""
+    metrics.reset()
+    amap = AffinityMap(host_names(4))
+    batches = [make_items(5, tamper_every=3) for _ in range(12)]
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=8, max_wait=0.02, pipeline_depth=1,
+            mesh_hosts=4, warmup=False,
+        )
+    ) as eng:
+        futs = [
+            asyncio.ensure_future(eng.verify(items, affinity=k))
+            for k, (items, _) in enumerate(batches)
+        ]
+        got = await asyncio.gather(*futs)
+        st = eng.stats()["fleet"]
+        assert eng._fleet.affinity.prefer(0) == amap.prefer(0)  # same map
+        # while the engine is live, the affine feed surface is populated
+        assert set(st["feed_depths"]) == set(host_names(4))
+        assert set(st["feed_idle"]) == set(host_names(4))
+        routed = metrics.series("sched.affinity_routed")
+    for (items, expected), out in zip(batches, got):
+        assert out == expected
+    assert st["affinity"]["routed"] == len(batches)
+    assert st["affinity"]["spilled"] == 0
+    assert sum(routed.values()) == len(batches)
+    for lk, _ in routed.items():
+        assert dict(lk)["host"] in host_names(4)
+    # teardown dropped every host= series (registry half; the Timeline
+    # half is pinned in test_timeseries)
+    assert metrics.series("sched.host_depth") == {}
+    assert metrics.series("sched.feed_idle") == {}
+    assert metrics.series("sched.affinity_routed") == {}
+    assert task_registry.report_leaks() == []
+
+
+@pytest.mark.asyncio
+async def test_idle_host_steals_misaffined_lane():
+    """Affinity is a placement hint, not a fence (satellite c): with h1
+    wedged, lanes homed to h1 by their keys are stolen and served by
+    idle h0 — verdicts still conserve and the steal counters move."""
+    metrics.reset()
+    gate = threading.Event()
+    amap = AffinityMap(host_names(2))
+    h1_keys = [k for k in range(200) if amap.prefer(k) == "h1"]
+    assert len(h1_keys) >= 8
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=4, max_wait=0.0, pipeline_depth=1,
+            mesh_hosts=2, fleet_queue=2, warmup=False,
+        )
+    ) as eng:
+        orig = eng._dispatch_multi
+
+        def gated(payloads, target=None, host=None, backend=None):
+            if host is not None and host.name == "h1":
+                gate.wait(10)
+            return orig(payloads, target, host=host, backend=backend)
+
+        eng._dispatch_multi = gated
+        batches = [make_items(4, tamper_every=3) for _ in range(8)]
+        futs = [
+            asyncio.ensure_future(eng.verify(items, affinity=k))
+            for k, (items, _) in zip(h1_keys, batches)
+        ]
+        # every lane was homed to the wedged host; h0 must steal through
+        # the backlog while h1 wedges on (at most) its one in-flight lane
+        deadline = time.monotonic() + 10
+        while sum(f.done() for f in futs) < len(futs) - 1:
+            assert time.monotonic() < deadline, "h0 never stole"
+            await asyncio.sleep(0.01)
+        assert eng._fleet.steals >= 1
+        assert eng._fleet.host_steals["h0"] >= 1
+        # the keys ROUTED home (h1 stayed active); stealing isn't a spill
+        assert eng._fleet.affinity_routed == len(batches)
+        assert eng._fleet.affinity_spilled == 0
+        gate.set()
+        got = await asyncio.gather(*futs)
+    for (items, expected), out in zip(batches, got):
+        assert out == expected
+    assert task_registry.report_leaks() == []
+
+
+@pytest.mark.asyncio
+async def test_fleet_affine_partition_soak(threadsan_armed):
+    """Satellite c SOAK: partition -> requeue -> rejoin re-run with
+    affinity ON.  Every submission carries a key; h1's partition
+    deactivates it and its keyed work re-routes (spill or requeue)
+    while h0 serves; the rejoin restores home placement — and every
+    waiter still sees exactly one clean verdict throughout, with zero
+    threadsan findings."""
+    from tpunode.chaos import ChaosPlan, chaos
+
+    metrics.reset()
+    amap = AffinityMap(host_names(2))
+    keys = itertools.cycle(
+        [k for k in range(64) if amap.prefer(k) == "h1"][:4]
+        + [k for k in range(64) if amap.prefer(k) == "h0"][:2]
+    )
+    chaos.install(ChaosPlan.parse(
+        "seed=7;mesh.dispatch:partition:match=h1,n=2"
+    ))
+    try:
+        async with VerifyEngine(
+            VerifyConfig(
+                backend="cpu", batch_size=8, max_wait=0.005,
+                pipeline_depth=1, mesh_hosts=2, warmup=False,
+                breaker_cooldown=0.1,
+            )
+        ) as eng:
+            downs = []
+            for _ in range(10):
+                batches = [make_items(6, tamper_every=3) for _ in range(6)]
+                got = await asyncio.gather(
+                    *(
+                        eng.verify(i, affinity=next(keys))
+                        for i, _ in batches
+                    )
+                )
+                for (items, expected), out in zip(batches, got):
+                    assert out == expected  # exactly-once through spills
+                downs.append(len(eng._fleet.active_hosts()))
+                await asyncio.sleep(0.01)
+            assert min(downs) == 1, "partition never deactivated h1"
+            assert eng._fleet.requeued >= 1
+            # h1-homed keys kept flowing while it was down: routed to the
+            # runner-up (spill) — the affine path never strands work
+            assert eng._fleet.affinity_spilled >= 1
+            assert eng._fleet.affinity_routed >= 1
+            deadline = time.monotonic() + 5
+            while (
+                len(eng._fleet.active_hosts()) < 2
+                and time.monotonic() < deadline
+            ):
+                await asyncio.sleep(0.02)
+            assert len(eng._fleet.active_hosts()) == 2
+        assert task_registry.report_leaks() == []
+    finally:
+        chaos.uninstall()
+    # threadsan (ISSUE 18): the affine feed path — per-host packers,
+    # spills, deactivation re-routes — introduces no lock disorder
     assert threadsan_armed.lock_cycles == 0, threadsan_armed.findings
     assert threadsan_armed.lock_reentries == 0, threadsan_armed.findings
 

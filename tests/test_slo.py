@@ -388,3 +388,53 @@ async def test_per_class_latency_and_ledger_conservation():
         ledger["busy_seconds"], rel=0.05
     )
     assert ledger["busy_seconds"] > 0.0
+
+
+@pytest.mark.asyncio
+async def test_fleet_ledger_attributes_cost_to_executing_host():
+    """ISSUE 19 (satellite b): in fleet mode every rung charge carries a
+    host= label for the EXECUTING host, ``by_host`` conserves the busy
+    seconds, and the labeled verify.cost_seconds series conserve the
+    charged seconds — so per-host bills stay truthful under stealing."""
+    from tpunode.verify.engine import VerifyConfig, VerifyEngine
+    from tpunode.verify.sched import host_names
+
+    from tests.test_engine import make_items
+
+    metrics.reset()
+    async with VerifyEngine(
+        VerifyConfig(
+            backend="cpu", batch_size=8, max_wait=0.005, pipeline_depth=1,
+            mesh_hosts=2, warmup=False,
+        )
+    ) as eng:
+        batches = [make_items(6, tamper_every=3) for _ in range(8)]
+        got = await asyncio.gather(
+            *(
+                eng.verify(i, priority=p, affinity=k)
+                for k, ((i, _), p) in enumerate(
+                    zip(batches, ("block", "mempool", "bulk", "bulk") * 2)
+                )
+            )
+        )
+        ledger = eng.ledger()
+        series = metrics.series("verify.cost_seconds")
+    for (items, expected), out in zip(batches, got):
+        assert out == expected
+
+    by_host = ledger["by_host"]
+    assert set(by_host) <= set(host_names(2)) and by_host
+    # host attribution conserves busy seconds exactly (same dt, one add)
+    assert sum(by_host.values()) == pytest.approx(
+        ledger["busy_seconds"], rel=0.05
+    )
+    # every labeled charge names an executing host from the bounded set,
+    # and the host-labeled series sum back to the charged seconds
+    assert series
+    for lk, v in series.items():
+        labels = dict(lk)
+        assert labels["host"] in host_names(2)
+        assert labels["priority"] in ("block", "mempool", "bulk")
+    assert sum(series.values()) == pytest.approx(
+        ledger["charged_seconds"], rel=0.05
+    )

@@ -116,6 +116,58 @@ def test_max_series_cap_drops_and_counts():
     assert len(tl.names()) == 3
 
 
+def test_registry_drop_retires_rings_and_reopens_cap():
+    """Labeled-series lifecycle (ISSUE 19): when the registry evicts a
+    label pair (host retirement at engine teardown), the Timeline's
+    matching rings go too — and a key previously refused at the cap is
+    forgotten, so a reused host name gets a fresh ring."""
+    reg, tl = _timeline(max_series=2)
+    reg.set_gauge("sched.host_depth", 1.0, labels={"host": "h0"})
+    reg.set_gauge("sched.feed_idle", 0.5, labels={"host": "h0"})
+    tl.tick(now=1.0)
+    assert set(tl.names()) == {
+        'sched.host_depth{host="h0"}',
+        'sched.feed_idle{host="h0"}',
+    }
+    # a second host is refused at the cap and remembered as dropped
+    reg.set_gauge("sched.host_depth", 2.0, labels={"host": "h1"})
+    tl.tick(now=2.0)
+    assert 'sched.host_depth{host="h1"}' in tl._dropped
+    # retire h0: its rings vanish, h1's cap entry stays (different host)
+    reg.drop_label("host", "h0")
+    assert tl.names() == []
+    assert 'sched.host_depth{host="h1"}' in tl._dropped
+    # retire h1 too: the cap entry is discarded, so a future fleet that
+    # reuses the name regrows a ring instead of being silently refused
+    reg.drop_label("host", "h1")
+    assert 'sched.host_depth{host="h1"}' not in tl._dropped
+    tl.max_series = 8  # room to regrow (tsdb.* self-metrics also enter)
+    reg.set_gauge("sched.host_depth", 3.0, labels={"host": "h1"})
+    tl.tick(now=3.0)
+    assert 'sched.host_depth{host="h1"}' in tl.names()
+
+
+def test_affine_feed_families_are_ring_worthy():
+    """The ISSUE 19 feed gauges are bounded by the fixed host set and
+    belong on the allowlist next to sched.host_depth."""
+    assert set(DEFAULT_LABEL_FAMILIES) >= {
+        "sched.feed_idle", "sched.affinity_routed",
+    }
+
+
+def test_timeline_churn_does_not_leak_drop_hooks():
+    """on_drop holds the Timeline's bound method weakly: churned
+    timelines die, and the next eviction prunes their dead hooks."""
+    import gc
+
+    reg = Metrics(disabled=False)
+    for _ in range(8):
+        Timeline(interval=1.0, registry=reg, disabled=False)
+    gc.collect()
+    reg.drop_label("host", "h0")  # prunes the dead weakrefs
+    assert len(reg._drop_hooks) == 0
+
+
 # --- query surface -----------------------------------------------------------
 
 

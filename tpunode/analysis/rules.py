@@ -583,6 +583,163 @@ def _event_name(ctx: FileContext) -> None:
                 ctx.report("event-name", node, f"event type {why}")
 
 
+# --- label-cardinality -------------------------------------------------------
+
+# Registered bounded label-value sources: helpers whose return set is
+# fixed and small by construction, so a label value drawn from one
+# cannot grow series cardinality.  ``multichip.host_names`` is the
+# canonical fleet-name source (ISSUE 19): AffinityMap seeds hash the
+# name strings, so every layer that labels by host must already route
+# through it — which is exactly what makes it safe to allowlist.
+_BOUNDED_LABEL_SOURCES = frozenset({"host_names"})
+
+
+def _dynamic_format(expr: ast.AST) -> bool:
+    """Is this expression a dynamically-formatted string — an f-string
+    with interpolation, a ``.format(...)`` call, or a ``%`` format?"""
+    if isinstance(expr, ast.JoinedStr):
+        return any(
+            isinstance(v, ast.FormattedValue) for v in expr.values
+        )
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Attribute)
+        and expr.func.attr == "format"
+    ):
+        return True
+    return (
+        isinstance(expr, ast.BinOp)
+        and isinstance(expr.op, ast.Mod)
+        and isinstance(expr.left, ast.Constant)
+        and isinstance(expr.left.value, str)
+    )
+
+
+def _has_bounded_call(ctx: FileContext, expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            qual = ctx.resolve(n.func) or ""
+            if qual.split(".")[-1] in _BOUNDED_LABEL_SOURCES:
+                return True
+    return False
+
+
+def _binding_index(ctx: FileContext) -> dict:
+    """name -> every expression bound to it anywhere in the file
+    (assignments, loop targets, comprehension targets).  File-wide on
+    purpose: for a lint, over-approximation beats scope bookkeeping —
+    an unbounded formatted binding ANYWHERE taints the name unless a
+    bounded source also feeds it."""
+    out: dict = {}
+
+    def bind(target: ast.AST, expr: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            out.setdefault(target.id, []).append(expr)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                bind(el, expr)
+        elif isinstance(target, ast.Starred):
+            bind(target.value, expr)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                bind(t, node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            bind(node.target, node.value)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            bind(node.target, node.iter)
+        elif isinstance(node, ast.comprehension):
+            bind(node.target, node.iter)
+    return out
+
+
+def _labeled_metric_calls(ctx: FileContext):
+    """Yield ``(report_node, labels_expr)`` for every labeled metric
+    call: the ``labels=`` keyword of inc/observe/set_gauge, and the
+    third element of each literal inc_batch tuple."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not isinstance(
+            node.func, ast.Attribute
+        ):
+            continue
+        if node.func.attr in _METRIC_ATTRS:
+            for kw in node.keywords:
+                if kw.arg == "labels" and kw.value is not None:
+                    yield node, kw.value
+        elif node.func.attr == "inc_batch":
+            for arg in node.args:
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    for el in arg.elts:
+                        if (
+                            isinstance(el, (ast.Tuple, ast.List))
+                            and len(el.elts) >= 3
+                        ):
+                            yield el, el.elts[2]
+
+
+@rule(
+    "label-cardinality",
+    "dynamically-formatted label value on a metric without a bounded "
+    "source (series cardinality = label-value cardinality: route fleet "
+    "names through multichip.host_names, or pin the value set)",
+)
+def _label_cardinality(ctx: FileContext) -> None:
+    """ISSUE 19 satellite: a labeled series is born per distinct label
+    value, and the registry/Timeline only stay bounded when every label
+    value comes from a bounded set (fixed hosts, declared SLOs, enum
+    classes).  An f-string/``.format``/``%``-formatted value is the
+    canonical unbounded-source smell — flag it unless the formatted
+    input demonstrably comes from a registered bounded helper
+    (``_BOUNDED_LABEL_SOURCES``)."""
+    bindings: "dict | None" = None
+
+    def get_bindings() -> dict:
+        nonlocal bindings
+        if bindings is None:
+            bindings = _binding_index(ctx)
+        return bindings
+
+    def taint(expr: ast.AST) -> "str | None":
+        if _dynamic_format(expr):
+            return "is dynamically formatted inline"
+        if isinstance(expr, ast.Name):
+            bound = get_bindings().get(expr.id, [])
+            if any(_has_bounded_call(ctx, e) for e in bound):
+                return None
+            if any(_dynamic_format(e) for e in bound):
+                return (
+                    f"is bound to a dynamically formatted value "
+                    f"({expr.id!r})"
+                )
+        return None
+
+    for node, labels in _labeled_metric_calls(ctx):
+        dicts = []
+        if isinstance(labels, ast.Dict):
+            dicts.append(labels)
+        elif isinstance(labels, ast.Name):
+            # labels passed by name: lint the dict literal(s) the name
+            # was assigned, but report at the metric call (that is
+            # where the pragma belongs)
+            dicts.extend(
+                e for e in get_bindings().get(labels.id, [])
+                if isinstance(e, ast.Dict)
+            )
+        for d in dicts:
+            for k_node, v in zip(d.keys, d.values):
+                why = taint(v)
+                if why is not None:
+                    key = _literal(k_node) if k_node is not None else None
+                    ctx.report(
+                        "label-cardinality", node,
+                        f"label {key or '?'!r} value {why} — label "
+                        "values must come from a bounded source "
+                        "(register one in _BOUNDED_LABEL_SOURCES, or "
+                        "pin the set)",
+                    )
+
+
 # --- doc-drift ---------------------------------------------------------------
 
 # OBSERVABILITY.md relative to this file (tpunode/analysis/ -> repo

@@ -24,9 +24,10 @@ import math
 import os
 import threading
 import time
+import weakref
 from bisect import bisect_left
 from collections import deque
-from typing import Iterable, Optional, Sequence
+from typing import Callable, Iterable, Optional, Sequence
 
 from . import threadsan
 
@@ -57,6 +58,15 @@ def _render_key(name: str, lk: _LabelKey) -> str:
         return name
     inner = ",".join(f'{k}="{v}"' for k, v in lk)
     return f"{name}{{{inner}}}"
+
+
+def _weak_callable(fn):
+    """A weak reference to ``fn`` suitable for callback lists: bound
+    methods need WeakMethod (a plain ref to the transient bound-method
+    object dies immediately)."""
+    if hasattr(fn, "__self__"):
+        return weakref.WeakMethod(fn)
+    return weakref.ref(fn)
 
 
 def percentiles(values: Sequence[float], ps: Iterable[float]) -> dict[str, float]:
@@ -199,6 +209,13 @@ class Metrics:
         # metric family -> help text (# HELP exposition lines); optional,
         # registered at first use via describe()
         self._help: dict[str, str] = {}
+        # drop_label listeners (ISSUE 19 labeled-series lifecycle):
+        # weakly-referenced callables invoked OUTSIDE the lock with
+        # (key, value) after an eviction, so downstream samplers (the
+        # Timeline) retire the same series instead of re-growing them.
+        # Weak refs: a churned Timeline must not be kept alive (or
+        # called) by the process-global registry.
+        self._drop_hooks: list = []
         self._created = time.monotonic()
 
     def describe(self, name: str, help_: str) -> None:
@@ -289,18 +306,42 @@ class Metrics:
             self._inc_locked((seconds_name, ()), dt, now)
             self._inc_locked((count_name, ()), 1.0, now)
 
+    def on_drop(self, hook: Callable[[str, str], None]) -> None:
+        """Register a ``(key, value)`` callback fired after every
+        :meth:`drop_label` eviction.  Held by WEAK reference — callers
+        must keep the callable alive (a bound method of a live object
+        does); dead refs are pruned on the next drop."""
+        with self._lock:
+            self._drop_hooks.append(_weak_callable(hook))
+
     def drop_label(self, key: str, value: str) -> None:
         """Evict every labeled series carrying ``key=value`` (all names).
 
         Per-peer labeled series (``peer.msgs{peer=...}``, ``peer.rtt``)
         would otherwise grow the registry without bound on a long-running
         node churning through addresses; the peer manager calls this when
-        a session ends.  Unlabeled aggregates are untouched."""
+        a session ends — and the verify engine retires its fleet's
+        ``host=`` series at teardown (ISSUE 19).  Unlabeled aggregates
+        are untouched.  Registered :meth:`on_drop` hooks fire after the
+        eviction, outside the lock."""
         pair = (str(key), str(value))
         with self._lock:
             for table in (self._counters, self._gauges, self._hists):
                 for k in [k for k in table if pair in k[1]]:
                     del table[k]
+            hooks = list(self._drop_hooks)
+        live = []
+        for ref in hooks:
+            fn = ref()
+            if fn is None:
+                continue
+            live.append(ref)
+            fn(pair[0], pair[1])
+        if len(live) != len(hooks):
+            with self._lock:
+                self._drop_hooks = [
+                    r for r in self._drop_hooks if r() is not None
+                ]
 
     # -- read path -----------------------------------------------------------
 

@@ -58,6 +58,7 @@ from .txverify import (
     wants_amount,
 )
 from .verify.engine import VerifyConfig, VerifyEngine
+from .verify.sched import affinity_key
 from .params import NODE_NETWORK, Network
 from .peer import (
     CannotDecodePayload,
@@ -336,6 +337,7 @@ class Node:
                 peer_mgr=self.peer_mgr,
                 utxo=self.utxo,
                 pressure=self._ibd_pressure,
+                pressure_key=self._ibd_pressure_key,
                 on_failure=self._component_failed,
             )
             if cfg.ibd is not None
@@ -356,6 +358,7 @@ class Node:
                 submit=self._mempool_submit,
                 prevout_lookup=cfg.prevout_lookup,
                 pressure=self._ingest_pressure,
+                pressure_key=self._ingest_pressure_key,
                 on_failure=self._component_failed,
             )
             if cfg.mempool is not None
@@ -376,6 +379,12 @@ class Node:
         w = cfg.extract_workers
         self._extract_workers = w if w > 0 else min(4, os.cpu_count() or 1)
         self._extract_pool: Optional[ThreadPoolExecutor] = None
+        # Host-affine pool slices (ISSUE 19, fleet mode only): one lazy
+        # sub-pool per verify host so a tx is parsed/prepped by the
+        # worker slice feeding its verifying host.  Keyed by host name;
+        # built in _pool_for, shut down with the shared pool.
+        self._extract_pools: Optional[dict] = None
+        self._host_pool_workers = 1
         self._extract_ring = asyncio.Semaphore(self.EXTRACT_RING)
         self._ring_busy = 0
         # shed-event aggregation (a flood must not also flood the bus),
@@ -459,6 +468,15 @@ class Node:
                 max_workers=self._extract_workers,
                 thread_name_prefix="extract",
             )
+            if self._fleet_affine() and self._extract_workers > 1:
+                # per-host slices (ISSUE 19): each verify host gets its
+                # own extract sub-pool, sized so the slices sum to about
+                # the configured worker budget
+                hosts = len(self.verify_engine._hosts)
+                self._extract_pools = {}
+                self._host_pool_workers = max(
+                    1, self._extract_workers // max(1, hosts)
+                )
         if self.verify_engine is not None or self.utxo is not None:
             # utxo-only nodes still spawn supervised block-connect tasks
             await self._stack.enter_async_context(self._verify_tasks)
@@ -585,6 +603,12 @@ class Node:
                         wait=False, cancel_futures=True
                     )
                     self._extract_pool = None
+                if self._extract_pools is not None:
+                    # host-affine slices (ISSUE 19): same non-blocking
+                    # discipline as the shared pool above
+                    for pool in self._extract_pools.values():
+                        pool.shutdown(wait=False, cancel_futures=True)
+                    self._extract_pools = None
                 if self._attributor is not None:
                     self._attributor.stop()
                     self._attributor = None
@@ -809,14 +833,46 @@ class Node:
                 continue  # unparseable: was never admitted
             self.mempool.verdict(txid, False, (), error="shed")
 
+    def _fleet_affine(self) -> bool:
+        """Host-affine ingest on?  True when the engine runs a verify
+        fleet (ISSUE 19): intake then partitions by target host."""
+        eng = self.verify_engine
+        return eng is not None and getattr(eng, "_fleet", None) is not None
+
+    def _affine_host(self, txid: bytes) -> Optional[str]:
+        """The fleet host this txid's verify work routes to right now
+        (None without a fleet, or with every host dark)."""
+        if not self._fleet_affine():
+            return None
+        assert self.verify_engine is not None
+        return self.verify_engine.route_host(affinity_key(txid))
+
     def _ingest_pressure(self) -> bool:
         """Is the verify ingest saturated?  The mempool defers fetch
         scheduling while true, so inv floods degrade into a stale
-        want-list instead of feeding the shed path."""
-        return (
-            len(self._tx_accum) >= self.MAX_TX_ACCUM // 2
-            or self._verify_pending >= self.MAX_VERIFY_PENDING
-        )
+        want-list instead of feeding the shed path.  Fleet mode
+        (ISSUE 19): the global gate trips only when EVERY active host
+        is over its feed ceiling — one slow host alone must never
+        stall the whole fleet's intake (its own keys defer through
+        :meth:`_ingest_pressure_key` instead)."""
+        if len(self._tx_accum) >= self.MAX_TX_ACCUM // 2:
+            return True
+        if self._fleet_affine():
+            assert self.verify_engine is not None
+            return self.verify_engine.hosts_all_pressured()
+        return self._verify_pending >= self.MAX_VERIFY_PENDING
+
+    def _ingest_pressure_key(self, txid: bytes) -> bool:
+        """Per-tx intake gate (ISSUE 19): is THIS txid's target host
+        over its feed ceiling?  The mempool skips fetching just these
+        txids while true; everything else keeps flowing.  Falls back to
+        the global gate semantics without a fleet."""
+        if len(self._tx_accum) >= self.MAX_TX_ACCUM // 2:
+            return True  # the accumulator is a global memory bound
+        if self._fleet_affine():
+            assert self.verify_engine is not None
+            return self.verify_engine.host_pressured(affinity_key(txid))
+        return self._verify_pending >= self.MAX_VERIFY_PENDING
 
     def _ibd_pressure(self) -> bool:
         """Should the IBD planner defer scheduling more block batches?
@@ -827,6 +883,15 @@ class Node:
             self._verify_pending >= self.MAX_VERIFY_PENDING // 2
             or len(self._utxo_pending) >= self.MAX_UTXO_PENDING // 2
         )
+
+    def _ibd_pressure_key(self, block_hash: bytes) -> bool:
+        """Per-batch IBD gate (ISSUE 19): is this block's target verify
+        host over its feed ceiling?  False without a fleet — the global
+        :meth:`_ibd_pressure` gate already covers that case."""
+        if not self._fleet_affine():
+            return False
+        assert self.verify_engine is not None
+        return self.verify_engine.host_pressured(affinity_key(block_hash))
 
     def _block_priority(self) -> str:
         """Engine priority class for block verify submissions: planner-era
@@ -1313,24 +1378,66 @@ class Node:
     # call overhead beats the parallelism.
     MIN_SHARD_TXS = 64
 
-    async def _run_extract(self, fn, *args, **kw):
-        """Run one native-extraction step off-loop: in the shared worker
-        pool when parallel extraction is on, via ``to_thread`` otherwise."""
-        if self._extract_pool is not None:
+    def _pool_for(self, host: Optional[str]) -> Optional[ThreadPoolExecutor]:
+        """The extract pool feeding ``host`` (ISSUE 19): its lazy
+        per-host slice in fleet-affine mode, the shared pool otherwise.
+        Host names come from the engine's fixed fleet, so the slice dict
+        is bounded by construction."""
+        if host is None or self._extract_pools is None:
+            return self._extract_pool
+        pool = self._extract_pools.get(host)
+        if pool is None:
+            pool = ThreadPoolExecutor(
+                max_workers=self._host_pool_workers,
+                thread_name_prefix=f"extract-{host}",
+            )
+            self._extract_pools[host] = pool
+        return pool
+
+    async def _run_extract(self, fn, *args, _pool=None, **kw):
+        """Run one native-extraction step off-loop: in the given pool
+        (a host-affine slice), else the shared worker pool, else via
+        ``to_thread``."""
+        pool = _pool if _pool is not None else self._extract_pool
+        if pool is not None:
             return await asyncio.get_running_loop().run_in_executor(
-                self._extract_pool, functools.partial(fn, *args, **kw)
+                pool, functools.partial(fn, *args, **kw)
             )
         return await asyncio.to_thread(fn, *args, **kw)
 
-    def _shard_batch(self, batch: list) -> list[list]:
-        """Split a drain batch into contiguous per-worker tx ranges
-        (mempool txs are independent: ``intra_amounts`` is off, so the
-        shards share nothing but the prevout oracle)."""
-        if self._extract_workers <= 1 or len(batch) < 2 * self.MIN_SHARD_TXS:
+    def _split_shards(self, batch: list, workers: int) -> list[list]:
+        if workers <= 1 or len(batch) < 2 * self.MIN_SHARD_TXS:
             return [batch]
-        n = min(self._extract_workers, len(batch) // self.MIN_SHARD_TXS)
+        n = min(workers, len(batch) // self.MIN_SHARD_TXS)
         size = (len(batch) + n - 1) // n
         return [batch[i : i + size] for i in range(0, len(batch), size)]
+
+    def _shard_batch(self, batch: list) -> list[list]:
+        """Split a drain batch into per-worker tx ranges (mempool txs
+        are independent: ``intra_amounts`` is off, so the shards share
+        nothing but the prevout oracle).  Fleet-affine mode (ISSUE 19)
+        groups by TARGET HOST first — every tx in a shard routes to the
+        same verify host, so one shard is one affinity-keyed engine
+        submission prepped by that host's extract slice — then splits
+        within each group; central mode keeps contiguous ranges."""
+        if not self._fleet_affine():
+            return self._split_shards(batch, self._extract_workers)
+        groups: dict = {}  # host (or None) -> records in arrival order
+        for rec in batch:
+            try:
+                host = self._affine_host(rec[1].txid)
+            except Exception:
+                host = None
+            groups.setdefault(host, []).append(rec)
+        per_group = (
+            self._host_pool_workers
+            if self._extract_pools is not None
+            else self._extract_workers
+        )
+        out: list[list] = []
+        for group in groups.values():
+            out.extend(self._split_shards(group, per_group))
+        return out
 
     @staticmethod
     def _begin_tx_spans(batch: list, name: str) -> list:
@@ -1361,7 +1468,7 @@ class Node:
         finally:
             region.close()
 
-    async def _run_extract_owned(self, region, **kw):
+    async def _run_extract_owned(self, region, _pool=None, **kw):
         """Submit the extract with close-ownership attached: the worker
         thread closes the region when the job RUNS (`_extract_and_close`);
         a job cancelled while still QUEUED (node teardown, pool
@@ -1374,8 +1481,9 @@ class Node:
         wrapper regardless of ``concurrent.Future.cancel()`` failing) —
         closing on that signal is the very use-after-free this path
         exists to avoid (review finding)."""
-        assert self._extract_pool is not None  # built with the engine
-        cfut = self._extract_pool.submit(
+        pool = _pool if _pool is not None else self._extract_pool
+        assert pool is not None  # built with the engine
+        cfut = pool.submit(
             self._extract_and_close, region, **kw
         )
         cfut.add_done_callback(
@@ -1391,11 +1499,20 @@ class Node:
         from .txextract import ParsedTxRegion
 
         concat = b"".join(r for _, _, r, _ in shard)
+        # host-affine prep (ISSUE 19): the shard's txs all route to one
+        # verify host (grouped in _shard_batch), so parse + extract run
+        # on that host's pool slice
+        pool = None
+        if self._extract_pools is not None:
+            try:
+                pool = self._pool_for(self._affine_host(shard[0][1].txid))
+            except Exception:
+                pool = None
         region = None
         submitted = False
         try:
             region = await self._run_extract(
-                ParsedTxRegion, concat, len(shard)
+                ParsedTxRegion, concat, len(shard), _pool=pool
             )
             # oracle lookups stay on the loop thread (they read
             # mempool/utxo state owned by it)
@@ -1403,6 +1520,7 @@ class Node:
             submitted = True  # from here the job owns close
             return await self._run_extract_owned(
                 region,
+                _pool=pool,
                 bch=bch,
                 intra_amounts=False,
                 ext_amounts=ext,
@@ -1517,10 +1635,19 @@ class Node:
                 try:
                     assert self.verify_engine is not None
                     # the verify.queue span lands in the first traced
-                    # submitter's tree (the packer's act0 convention)
+                    # submitter's tree (the packer's act0 convention).
+                    # Affinity (ISSUE 19): the shard was grouped by
+                    # target host in _shard_batch, so its first txid's
+                    # key routes the whole submission home.
+                    aff = None
+                    if self._fleet_affine():
+                        try:
+                            aff = affinity_key(shard[0][1].txid)
+                        except Exception:
+                            aff = None
                     with _activate_trace(act0):
                         verdicts = await self.verify_engine.verify_raw(
-                            items, priority="mempool"
+                            items, priority="mempool", affinity=aff
                         )
                 except asyncio.CancelledError:
                     raise
@@ -1738,8 +1865,21 @@ class Node:
             priority = (
                 self._block_priority() if block is not None else "mempool"
             )
+            # block affinity (ISSUE 19): a block's shards share one key
+            # (the block hash) so the whole block verifies on one host —
+            # its shards pack together instead of scattering
+            aff = None
+            if self._fleet_affine():
+                try:
+                    aff = affinity_key(
+                        block.header.hash if block is not None
+                        else txs[0].txid if txs else b""
+                    )
+                except Exception:
+                    aff = None
             clean = all(await asyncio.gather(*(
-                self._commit_items(peer, it, priority) for it in shards
+                self._commit_items(peer, it, priority, aff)
+                for it in shards
             )))
             if block is not None and clean:
                 # persistent UTXO connect only AFTER the block's verdicts
@@ -1756,7 +1896,9 @@ class Node:
             # the item's pipeline trace (if any) ends with its verdicts
             _finish_active_trace()
 
-    async def _commit_items(self, peer, items, priority: str) -> bool:
+    async def _commit_items(
+        self, peer, items, priority: str, affinity: Optional[int] = None
+    ) -> bool:
         """Engine round + verdict publication for one RawSigItems batch
         (a whole message, or one tx-range shard of a block).  Returns
         False when the engine failed (error verdicts published)."""
@@ -1765,7 +1907,7 @@ class Node:
         if items.count:
             try:
                 verdicts = await self.verify_engine.verify_raw(
-                    items, priority=priority
+                    items, priority=priority, affinity=affinity
                 )
             except asyncio.CancelledError:
                 raise

@@ -62,6 +62,9 @@ DEFAULT_LABEL_FAMILIES: tuple[str, ...] = (
     "verify.breaker_state",
     "mesh.host_chips",
     "slo.burn_rate",
+    # host-affine feed surface (ISSUE 19): bounded by the fixed host set
+    "sched.feed_idle",
+    "sched.affinity_routed",
 )
 
 
@@ -94,6 +97,12 @@ class Timeline:
         self._rings: dict[str, tuple[deque, ...]] = {}
         self._ticks = 0
         self._dropped: set[str] = set()
+        # Labeled-series lifecycle (ISSUE 19): when the registry evicts
+        # a label pair (host retirement at engine teardown, peer-session
+        # end), retire the matching rings too — otherwise fleet churn
+        # regrows them from the drop cap forever.  on_drop holds the
+        # hook weakly; the bound method dies with this Timeline.
+        self.registry.on_drop(self.drop_label)
 
     # -- capture --------------------------------------------------------------
 
@@ -146,6 +155,20 @@ class Timeline:
         self.registry.inc("tsdb.samples")
         self.registry.set_gauge("tsdb.series", float(len(self._rings)))
         return written
+
+    def drop_label(self, key: str, value: str) -> None:
+        """Retire every ring whose rendered series key carries
+        ``key="value"`` — the Timeline half of the registry's
+        :meth:`Metrics.drop_label` eviction (wired via ``on_drop`` at
+        construction).  Matching keys leave the ``_dropped`` set too:
+        a host name REUSED by a future fleet gets a fresh ring instead
+        of being silently discarded against the old cap entry."""
+        needle = f'{key}="{value}"'
+        with self._lock:
+            for name in [n for n in self._rings if needle in n]:
+                del self._rings[name]
+            for name in [n for n in self._dropped if needle in n]:
+                self._dropped.discard(name)
 
     # -- query ----------------------------------------------------------------
 

@@ -592,9 +592,18 @@ class CostLedger:
         # (priority, rung) -> [charged seconds, items]
         self._cells: dict[tuple[str, str], list] = {}
         self._busy = 0.0  # total measured rung busy seconds
+        # host -> charged seconds: per-host attribution (ISSUE 19) —
+        # charged to the EXECUTING host, so a stolen lane bills the
+        # thief and per-host shares stay truthful under heavy stealing
+        self._by_host: dict[str, float] = {}
 
     def charge(
-        self, class_counts: dict[str, int], total: int, dt: float, rung: str
+        self,
+        class_counts: dict[str, int],
+        total: int,
+        dt: float,
+        rung: str,
+        host: Optional[str] = None,
     ) -> None:
         if total <= 0 or dt < 0:
             return
@@ -603,18 +612,21 @@ class CostLedger:
         ]
         with self._lock:
             self._busy += dt
+            if host is not None:
+                self._by_host[host] = self._by_host.get(host, 0.0) + dt
             for p, n, share in shares:
                 cell = self._cells.get((p, rung))
                 if cell is None:
                     cell = self._cells[(p, rung)] = [0.0, 0]
                 cell[0] += share
                 cell[1] += n
+        host_labels = {} if host is None else {"host": host}
         metrics.inc_batch(
             (
                 (
                     "verify.cost_seconds",
                     share,
-                    {"priority": p, "rung": rung},
+                    {"priority": p, "rung": rung, **host_labels},
                 )
                 for p, _, share in shares
             )
@@ -627,6 +639,7 @@ class CostLedger:
         with self._lock:
             cells = {k: list(v) for k, v in self._cells.items()}
             busy = self._busy
+            by_host = dict(self._by_host)
         charged = sum(v[0] for v in cells.values())
         by_class: dict[str, dict] = {}
         for (p, rung), (secs, items) in sorted(cells.items()):
@@ -641,11 +654,17 @@ class CostLedger:
         for c in by_class.values():
             c["seconds"] = round(c["seconds"], 6)
             c["share"] = round(c["seconds"] / charged, 4) if charged else 0.0
-        return {
+        out = {
             "busy_seconds": round(busy, 6),
             "charged_seconds": round(charged, 6),
             "by_class": by_class,
         }
+        if by_host:
+            # fleet mode only (ISSUE 19): busy seconds by EXECUTING host
+            out["by_host"] = {
+                h: round(s, 6) for h, s in sorted(by_host.items())
+            }
+        return out
 
 
 class VerifyEngine:
@@ -705,9 +724,14 @@ class VerifyEngine:
         self._fleet_hybrid_state = "cold"
         self._room: Optional[asyncio.Event] = None
         if self.cfg.mesh_hosts >= 2:
+            # canonical names from sched.py (ISSUE 19): the affinity
+            # map's rendezvous seeds hash these strings, so the naming
+            # scheme must be stable across layers
+            from .sched import host_names
+
             self._hosts = {
-                f"h{i}": _HostState(f"h{i}", i, self.cfg)
-                for i in range(self.cfg.mesh_hosts)
+                name: _HostState(name, i, self.cfg)
+                for i, name in enumerate(host_names(self.cfg.mesh_hosts))
             }
             self._fleet = FleetDispatcher(
                 list(self._hosts), self._packer,
@@ -851,7 +875,14 @@ class VerifyEngine:
 
     def queue_depth(self) -> dict:
         """Current backlog: queued submissions, total unclaimed items,
-        and the per-priority split (``by_priority`` is itself a dict)."""
+        and the per-priority split (``by_priority`` is itself a dict).
+        Fleet mode aggregates the central + per-host packers."""
+        if self._fleet is not None:
+            return {
+                "batches": self._fleet.batches(),
+                "items": self._fleet.uncut_pending(),
+                "by_priority": self._fleet.depths(),
+            }
         return {
             "batches": self._packer.batches(),
             "items": self._packer.pending(),
@@ -914,6 +945,16 @@ class VerifyEngine:
                 },
                 "chips": {
                     name: hs.chips for name, hs in self._hosts.items()
+                },
+                # host-affine feed surface (ISSUE 19)
+                "feed_depths": self._fleet.feed_depths(),
+                "feed_idle": {
+                    h: round(v, 4)
+                    for h, v in self._fleet.feed_idle().items()
+                },
+                "affinity": {
+                    "routed": self._fleet.affinity_routed,
+                    "spilled": self._fleet.affinity_spilled,
                 },
             }
         occ = metrics.histogram("verify.occupancy")
@@ -979,28 +1020,57 @@ class VerifyEngine:
                 for sub, _, _ in lane.slices:
                     if not sub.fut.done():
                         sub.fut.cancel()
-        # fail any stragglers still queued (or partially claimed)
-        for sub in self._packer.drain():
-            if not sub.fut.done():
-                sub.fut.cancel()
+            # stragglers across the central AND per-host packers
+            for sub in self._fleet.drain_submissions():
+                if not sub.fut.done():
+                    sub.fut.cancel()
+            # Permanent host retirement (ISSUE 19 labeled-series
+            # lifecycle): engine teardown is the one point a fleet's
+            # hosts deactivate for good — drop their host= series from
+            # the registry (and, via the registry's drop hooks, from
+            # any Timeline sampler) so fleet churn across engine
+            # lifetimes can't grow label cardinality unboundedly.
+            for name in self._hosts:
+                metrics.drop_label("host", name)
+        else:
+            # fail any stragglers still queued (or partially claimed)
+            for sub in self._packer.drain():
+                if not sub.fut.done():
+                    sub.fut.cancel()
 
     # -- API -----------------------------------------------------------------
 
     async def verify(
-        self, items: Sequence[VerifyItem], priority: str = "bulk"
+        self,
+        items: Sequence[VerifyItem],
+        priority: str = "bulk",
+        affinity: Optional[int] = None,
     ) -> list[bool]:
         """Queue items; resolves when their lanes have been verified.
         ``priority``: ``block`` > ``mempool`` > ``bulk`` (sched.py) — the
-        class whose lanes pack and dispatch first under saturation."""
-        return await self._enqueue(list(items), priority)
+        class whose lanes pack and dispatch first under saturation.
+        ``affinity`` (fleet mode, ISSUE 19): a ``sched.affinity_key``
+        routing this submission to its home host's packer — a placement
+        hint only, never a correctness input."""
+        return await self._enqueue(list(items), priority, affinity)
 
-    async def verify_raw(self, raw, priority: str = "bulk") -> list[bool]:
+    async def verify_raw(
+        self,
+        raw,
+        priority: str = "bulk",
+        affinity: Optional[int] = None,
+    ) -> list[bool]:
         """Queue a packed batch (RawBatch, or anything `as_raw_batch`
         coerces, e.g. txextract.RawSigItems): the native-extract fast path —
         no per-item Python objects anywhere between wire bytes and device."""
-        return await self._enqueue(as_raw_batch(raw), priority)
+        return await self._enqueue(as_raw_batch(raw), priority, affinity)
 
-    async def _enqueue(self, payload, priority: str = "bulk") -> list[bool]:
+    async def _enqueue(
+        self,
+        payload,
+        priority: str = "bulk",
+        affinity: Optional[int] = None,
+    ) -> list[bool]:
         if not len(payload):
             return []
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -1013,10 +1083,55 @@ class VerifyEngine:
             tr = act[0]
             rec = tr.begin("verify.queue", act[1], items=len(payload))
             fut.add_done_callback(lambda _f, tr=tr, rec=rec: tr.end(rec))
-        self._packer.push(Submission(payload, fut, act, priority))
+        sub = Submission(payload, fut, act, priority, affinity=affinity)
+        if self._fleet is not None:
+            # host-affine route (ISSUE 19): keyed submissions land in
+            # their home host's packer; keyless work stays central
+            self._fleet.push(sub)
+        else:
+            self._packer.push(sub)
         assert self._kick is not None, "engine not started"
         self._kick.set()
         return await fut
+
+    # -- host-affine feed surface (ISSUE 19) ----------------------------------
+
+    def route_host(self, key: int) -> Optional[str]:
+        """The ACTIVE host an affinity key routes to right now (None
+        without a fleet, or with every host dark) — upstream ingest
+        sharding partitions parse/prep work by this."""
+        if self._fleet is None:
+            return None
+        return self._fleet.affinity.route(key, self._fleet.active_hosts())
+
+    def _feed_limit(self) -> int:
+        """Per-host feed-depth ceiling for intake gating: the host's
+        queue allowance plus one lane of headroom, in items."""
+        return (self.cfg.fleet_queue + 1) * self._lane_target()
+
+    def host_pressured(self, key: int) -> bool:
+        """Is the TARGET host of ``key`` over its feed ceiling?  The
+        per-host backpressure signal (ISSUE 19): intake for one slow
+        host's keys defers without stalling the rest of the fleet.
+        False without a fleet or with every host dark — callers fall
+        back to their global gates."""
+        if self._fleet is None:
+            return False
+        host = self._fleet.affinity.route(key, self._fleet.active_hosts())
+        if host is None:
+            return False
+        return self._fleet.feed_depth(host) >= self._feed_limit()
+
+    def hosts_all_pressured(self) -> bool:
+        """Every ACTIVE host over its feed ceiling (the fleet-wide
+        intake gate: one slow host alone must never trip it)."""
+        if self._fleet is None:
+            return False
+        active = self._fleet.active_hosts()
+        if not active:
+            return False
+        limit = self._feed_limit()
+        return all(self._fleet.feed_depth(h) >= limit for h in active)
 
     def verify_sync(self, items: Sequence[VerifyItem]) -> list[bool]:
         """Blocking verification (benchmarks, scripts): no queueing."""
@@ -1037,6 +1152,18 @@ class VerifyEngine:
             else self.cfg.batch_size
         )
 
+    def _uncut_pending(self) -> int:
+        """Unclaimed queued items across every packer (fleet mode sums
+        the central + per-host packers — ISSUE 19)."""
+        if self._fleet is not None:
+            return self._fleet.uncut_pending()
+        return self._packer.pending()
+
+    def _uncut_oldest(self) -> Optional[float]:
+        if self._fleet is not None:
+            return self._fleet.oldest_enqueued()
+        return self._packer.oldest_enqueued()
+
     async def _run(self) -> None:
         """Pipeline scheduler loop: linger toward full lanes, then keep up
         to ``pipeline_depth`` packed lanes in flight (each in its own
@@ -1048,7 +1175,7 @@ class VerifyEngine:
         assert self._kick is not None and self._slots is not None
         while True:
             # wait for work
-            while not self._packer.pending():
+            while not self._uncut_pending():
                 await self._kick.wait()
                 self._kick.clear()
             target = self._lane_target()
@@ -1059,8 +1186,8 @@ class VerifyEngine:
             # remainder lingers for later submissions to pack with only
             # while its submitter is younger than max_wait (ISSUE 10:
             # max-linger — a lone small batch still dispatches promptly).
-            while self._packer.pending() < target:
-                oldest = self._packer.oldest_enqueued()
+            while self._uncut_pending() < target:
+                oldest = self._uncut_oldest()
                 if oldest is None:
                     break
                 remain = oldest + self.cfg.max_wait - time.monotonic()
@@ -1071,7 +1198,7 @@ class VerifyEngine:
                 except asyncio.TimeoutError:
                     break
                 self._kick.clear()
-            if not self._packer.pending():
+            if not self._uncut_pending():
                 continue
             if self._fleet is not None:
                 await self._feed_fleet()
@@ -1095,23 +1222,36 @@ class VerifyEngine:
         task.add_done_callback(self._lane_tasks.discard)
 
     async def _feed_fleet(self) -> None:
-        """Cut ONE lane and hand it to the fleet (ISSUE 13).  Admission
-        is queue room on some active host (shallow queues keep late
-        high-priority submissions packing ahead of un-cut work); with
-        every host lost, the lane is served through the LOCAL ladder
-        under the ordinary pipeline slots — a fully-dark fleet still
-        produces verdicts."""
+        """Cut ONE lane and hand it to the fleet (ISSUE 13, host-affine
+        since ISSUE 19).  ``cut_next`` picks the globally most-urgent
+        feedable source — an active host's HOME packer (lane lands on
+        that host's own queue) or the central packer (lane lands on the
+        shallowest queue) — so per-host packing preserves the global
+        priority order.  Admission is a feedable source (shallow queues
+        keep late high-priority submissions packing ahead of un-cut
+        work); with every host lost, lanes are served through the LOCAL
+        ladder under the ordinary pipeline slots — a fully-dark fleet
+        still produces verdicts."""
         assert self._fleet is not None and self._room is not None
         assert self._slots is not None
-        while not self._fleet.has_room() and self._fleet.active_hosts():
+        while not self._fleet.feedable() and self._fleet.active_hosts():
             self._room.clear()
             await self._room.wait()
-        lane = self._packer.pop_lane(self._lane_target())
+        if not self._fleet.active_hosts():
+            # no active host at all: local fallback, traffic never stops
+            lane = self._fleet.pop_any(self._lane_target())
+            if lane is None:
+                return
+            await self._slots.acquire()
+            self._spawn_lane_task(lane)
+            return
+        lane, host = self._fleet.cut_next(self._lane_target())
         if lane is None:
             return
-        host = self._fleet.assign(lane)
         if host is None:
-            # no active host at all: local fallback, traffic never stops
+            # cut from the central packer but no queue had room (raced
+            # with other cuts): serve locally rather than re-queueing —
+            # the lane exists now and must resolve exactly once
             await self._slots.acquire()
             self._spawn_lane_task(lane)
             return
@@ -1351,7 +1491,8 @@ class VerifyEngine:
             # charge to "bulk".
             classes = getattr(self._tls, "classes", None)
             self._ledger.charge(
-                classes if classes else {"bulk": total}, total, dt, served
+                classes if classes else {"bulk": total}, total, dt, served,
+                host=host.name if host is not None else None,
             )
             events.emit(
                 "verify.dispatch", backend=served, size=total,

@@ -50,6 +50,10 @@ except ImportError:  # pragma: no cover - older jax
 
 from ..trace import span
 from .ecdsa_cpu import Point
+# Canonical fleet host names: owned by sched.py (next to the AffinityMap
+# that seeds rendezvous scores from them, ISSUE 19), re-exported here so
+# topology callers keep one import site.
+from .sched import host_names
 from .kernel import (
     ARG_IS_2D,
     kernel_modes,
@@ -64,6 +68,7 @@ __all__ = [
     "HYBRID_AXES",
     "make_mesh",
     "make_hybrid_mesh",
+    "host_names",
     "host_submesh",
     "sharded_verify_fn",
     "verify_batch_sharded",
@@ -131,6 +136,8 @@ def make_hybrid_mesh(
         )
     grid = np.array(devs[:need]).reshape(hosts, chips_per_host)
     return Mesh(grid, HYBRID_AXES)
+
+
 
 
 def host_submesh(

@@ -42,17 +42,34 @@ carried submissions exactly once, because a lane lives in exactly one
 queue (or exactly one host's in-flight set) at a time and
 :class:`Submission` bookkeeping is slice-indexed, not host-indexed.
 
+Host-affine feeds (ISSUE 19): at pod scale the single shared packer is
+the feed bottleneck — every tx funnels through one queue before a lane
+ships to the host that verifies it.  :class:`AffinityMap` gives every
+submission key a stable home host via rendezvous (highest-random-weight)
+hashing: removing a host remaps ONLY that host's keys, and a rejoin
+restores exactly the old placement, so a rebalance never re-shuffles
+the steady state.  :class:`FleetDispatcher` grows one
+:class:`LanePacker` PER HOST fed by :meth:`FleetDispatcher.push`;
+lanes are cut per-host but in GLOBAL priority order (the feed loop
+compares per-packer head classes before cutting), and head-steal stays
+as the anti-starvation fallback — affinity is a placement hint, never
+a starvation source.
+
 Telemetry: ``sched.queue_depth{priority=}`` gauges, the
 ``sched.pack_efficiency`` histogram (lane occupancy at dispatch),
 ``sched.lanes`` / ``sched.packed_submissions`` counters, and the fleet
 surface — ``sched.host_depth{host=}`` gauges, ``sched.steals`` /
-``sched.requeued`` counters, ``sched.steal`` events (OBSERVABILITY.md).
+``sched.requeued`` counters, ``sched.steal`` events, plus the affine
+feed surface: ``sched.affinity_routed{host=}`` / ``sched.affinity_spilled``
+counters and ``sched.feed_idle{host=}`` gauges (queue-idle fraction —
+the per-host feed-starvation metric; OBSERVABILITY.md).
 """
 
 from __future__ import annotations
 
 import asyncio
 import collections
+import hashlib
 import time
 from typing import Optional, Sequence
 
@@ -62,6 +79,9 @@ from ..metrics import metrics
 __all__ = [
     "OCCUPANCY_BUCKETS",
     "PRIORITIES",
+    "affinity_key",
+    "host_names",
+    "AffinityMap",
     "Submission",
     "PackedLane",
     "LanePacker",
@@ -97,6 +117,94 @@ def slice_payload(payload, lo: int, hi: int):
     return as_raw_batch(payload).slice(lo, hi)
 
 
+_MASK64 = (1 << 64) - 1
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer: a cheap, well-distributed 64-bit mixer —
+    rendezvous hashing only needs per-(key, host) scores that are
+    independent across hosts, not cryptographic strength."""
+    x &= _MASK64
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK64
+    return x ^ (x >> 31)
+
+
+def affinity_key(txid: bytes) -> int:
+    """The affinity key for a txid / block hash: its first 8 bytes as a
+    little-endian integer.  Hash digests are already uniform, so no
+    extra mixing is needed here — :class:`AffinityMap` mixes the key
+    against each host's seed anyway."""
+    return int.from_bytes(txid[:8], "little")
+
+
+def host_names(n: int) -> list:
+    """Canonical fleet host names (``h0`` .. ``h{n-1}``).  Owned HERE —
+    next to :class:`AffinityMap`, which seeds per-host rendezvous
+    scores from these strings: a renamed host is a re-shuffled steady
+    state, so the engine fleet, the topology module, the bench proxy,
+    and the timeline's host-series parsing must agree on one naming
+    scheme.  Jax-free on purpose (multichip re-exports it): the
+    analyzer's label-cardinality rule allowlists this as the bounded
+    source for ``host=`` label values, so jax-free workers must be able
+    to import it too."""
+    return [f"h{i}" for i in range(n)]
+
+
+class AffinityMap:
+    """Stable key→host placement via rendezvous (HRW) hashing.
+
+    Every ``(key, host)`` pair gets an independent score
+    ``_mix64(key ^ seed(host))``; a key's home is the highest-scoring
+    host.  The property ISSUE 19 needs falls out directly: removing a
+    host remaps ONLY the keys that host owned (every other key's argmax
+    is unchanged), and re-adding it restores exactly the old placement —
+    a shrink/rejoin cycle never re-shuffles the steady state, unlike
+    modulo placement where every key moves.
+
+    Pure arithmetic, no mutable state beyond the fixed seed table:
+    safe to call from any thread.
+    """
+
+    def __init__(self, hosts: Sequence[str]):
+        hosts = list(hosts)
+        if not hosts:
+            raise ValueError("AffinityMap needs at least one host")
+        self.hosts = hosts
+        self._seed = {
+            h: _mix64(
+                int.from_bytes(
+                    hashlib.blake2b(h.encode(), digest_size=8).digest(),
+                    "big",
+                )
+            )
+            for h in hosts
+        }
+
+    def prefer(self, key: int) -> str:
+        """The key's home host over the FULL host set (ignores health —
+        the steady-state placement a rejoin restores)."""
+        return self._argmax(key, self.hosts)
+
+    def route(self, key: int, active: Sequence[str]) -> Optional[str]:
+        """The key's home host over ``active`` — the live routing
+        decision.  None when no host is active (dark fleet: the caller
+        falls back to the central path)."""
+        if not active:
+            return None
+        return self._argmax(key, active)
+
+    def _argmax(self, key: int, hosts: Sequence[str]) -> str:
+        key &= _MASK64
+        best = None
+        best_score = -1
+        for h in hosts:
+            score = _mix64(key ^ self._seed[h])
+            if score > best_score:
+                best, best_score = h, score
+        return best
+
+
 class Submission:
     """One queued verify request: a payload plus the future its caller
     awaits.  ``results`` fills in slices as the lanes carrying this
@@ -106,7 +214,7 @@ class Submission:
 
     __slots__ = (
         "payload", "n", "fut", "act", "priority", "enqueued",
-        "taken", "results", "remaining", "failed",
+        "taken", "results", "remaining", "failed", "affinity",
     )
 
     def __init__(
@@ -116,6 +224,7 @@ class Submission:
         act: Optional[tuple],
         priority: str,
         enqueued: Optional[float] = None,
+        affinity: Optional[int] = None,
     ):
         if priority not in PRIORITIES:
             raise ValueError(
@@ -126,6 +235,7 @@ class Submission:
         self.fut = fut
         self.act = act
         self.priority = priority
+        self.affinity = affinity
         self.enqueued = time.monotonic() if enqueued is None else enqueued
         self.taken = 0  # items already claimed into lanes
         self.results: list = [None] * self.n
@@ -210,9 +320,16 @@ class LanePacker:
 
     Not thread-safe by design: every method runs on the event loop (the
     engine's queue loop and ``_enqueue``).
+
+    ``gauge=False`` silences the ``sched.queue_depth{priority=}``
+    gauges: the fleet's per-host packers (ISSUE 19) would otherwise
+    last-writer-win the same gauge keys as the central packer.  The
+    counters/histogram stay on — they are process totals and sum
+    correctly across packers.
     """
 
-    def __init__(self):
+    def __init__(self, gauge: bool = True):
+        self._gauge_on = gauge
         self._q: dict[str, collections.deque[Submission]] = {
             p: collections.deque() for p in PRIORITIES
         }
@@ -226,14 +343,20 @@ class LanePacker:
     # -- intake ---------------------------------------------------------------
 
     def push(self, sub: Submission) -> None:
+        # Unclaimed remainder, not sub.n: a host deactivation re-routes
+        # its packer's queue through push(), and a partially-claimed
+        # submission must not inflate the depth by items already cut
+        # into lanes (ISSUE 19).
+        rem = sub.n - sub.taken
         self._q[sub.priority].append(sub)
-        self._pending_items += sub.n
-        self._depth[sub.priority] += sub.n
-        metrics.set_gauge(
-            "sched.queue_depth",
-            float(self._depth[sub.priority]),
-            labels={"priority": sub.priority},
-        )
+        self._pending_items += rem
+        self._depth[sub.priority] += rem
+        if self._gauge_on:
+            metrics.set_gauge(
+                "sched.queue_depth",
+                float(self._depth[sub.priority]),
+                labels={"priority": sub.priority},
+            )
 
     # -- introspection --------------------------------------------------------
 
@@ -254,6 +377,16 @@ class LanePacker:
         submission still dispatches promptly."""
         heads = [q[0].enqueued for q in self._q.values() if q]
         return min(heads) if heads else None
+
+    def head_class(self) -> Optional[int]:
+        """Index into PRIORITIES of the highest class with unclaimed
+        items (None when empty) — the fleet feed loop compares per-host
+        packers by this before cutting, so per-host packing preserves
+        GLOBAL priority order (ISSUE 19)."""
+        for i, p in enumerate(PRIORITIES):
+            if self._depth[p] > 0:
+                return i
+        return None
 
     # -- packing --------------------------------------------------------------
 
@@ -286,11 +419,12 @@ class LanePacker:
                 self._depth[p] -= take
                 if sub.taken >= sub.n:
                     q.popleft()
-            metrics.set_gauge(
-                "sched.queue_depth",
-                float(self._depth[p]),
-                labels={"priority": p},
-            )
+            if self._gauge_on:
+                metrics.set_gauge(
+                    "sched.queue_depth",
+                    float(self._depth[p]),
+                    labels={"priority": p},
+                )
             if room <= 0:
                 break
         if not slices:
@@ -315,9 +449,10 @@ class LanePacker:
             out.extend(q)
             q.clear()
             self._depth[p] = 0
-            metrics.set_gauge(
-                "sched.queue_depth", 0.0, labels={"priority": p}
-            )
+            if self._gauge_on:
+                metrics.set_gauge(
+                    "sched.queue_depth", 0.0, labels={"priority": p}
+                )
         self._pending_items = 0
         return out
 
@@ -360,11 +495,43 @@ class FleetDispatcher:
         # per-thief steal totals: the fleet timeline's per-host steal
         # series (tpunode/timeseries.py) — bounded by the fixed host set
         self.host_steals: dict = {h: 0 for h in hosts}
+        # Host-affine feeds (ISSUE 19): one packer per host, routed by
+        # rendezvous hashing.  The shared self.packer stays as the
+        # central path for affinity-less submissions and the dark-fleet
+        # fallback; per-host packers run gauge-silenced so they don't
+        # stomp the central sched.queue_depth series.
+        self.affinity = AffinityMap(hosts)
+        self._packers: dict = {h: LanePacker(gauge=False) for h in hosts}
+        self.affinity_routed = 0
+        self.affinity_spilled = 0
+        # feed starvation: take attempts that found the host's own
+        # queue dry, over all take attempts — the queue-idle fraction
+        self._takes: dict = {h: 0 for h in hosts}
+        self._idle_takes: dict = {h: 0 for h in hosts}
 
     # -- intake ---------------------------------------------------------------
 
     def push(self, sub: Submission) -> None:
-        self.packer.push(sub)
+        """Route a submission to its packer.  Affinity-keyed work goes
+        to its home host's packer over the ACTIVE set — a lost host's
+        keys spill to their rendezvous runner-up (counted as a spill),
+        and a rejoin restores the steady-state placement for new work.
+        Affinity-less submissions and dark-fleet traffic take the
+        central packer."""
+        if sub.affinity is None:
+            self.packer.push(sub)
+            return
+        host = self.affinity.route(sub.affinity, self.active_hosts())
+        if host is None:
+            self.packer.push(sub)
+            return
+        self._packers[host].push(sub)
+        if host == self.affinity.prefer(sub.affinity):
+            self.affinity_routed += 1
+            metrics.inc("sched.affinity_routed", labels={"host": host})
+        else:
+            self.affinity_spilled += 1
+            metrics.inc("sched.affinity_spilled")
 
     # -- introspection --------------------------------------------------------
 
@@ -387,11 +554,58 @@ class FleetDispatcher:
     def queued_lanes(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
+    def uncut_pending(self) -> int:
+        """Unclaimed items across the central AND every per-host packer
+        (what the engine's linger loop measures)."""
+        return self.packer.pending() + sum(
+            p.pending() for p in self._packers.values()
+        )
+
     def pending(self) -> int:
         """Unclaimed packer items + items already cut into host lanes."""
-        return self.packer.pending() + sum(
+        return self.uncut_pending() + sum(
             lane.total for q in self._queues.values() for lane in q
         )
+
+    def batches(self) -> int:
+        return self.packer.batches() + sum(
+            p.batches() for p in self._packers.values()
+        )
+
+    def depths(self) -> dict[str, int]:
+        """Unclaimed items per priority, summed over every packer."""
+        out = self.packer.depths()
+        for p in self._packers.values():
+            for k, v in p.depths().items():
+                out[k] += v
+        return out
+
+    def oldest_enqueued(self) -> Optional[float]:
+        heads = [self.packer.oldest_enqueued()] + [
+            p.oldest_enqueued() for p in self._packers.values()
+        ]
+        heads = [h for h in heads if h is not None]
+        return min(heads) if heads else None
+
+    def feed_depth(self, host: str) -> int:
+        """Uncut items homed to ``host`` plus items already cut into
+        its queue — the per-host backpressure signal (ISSUE 19):
+        node/mempool intake gates on the TARGET host's feed depth, not
+        a global counter, so one slow host can't stall fleet intake."""
+        return self._packers[host].pending() + self.host_depth(host)
+
+    def feed_depths(self) -> dict:
+        return {h: self.feed_depth(h) for h in self.hosts}
+
+    def feed_idle(self) -> dict:
+        """Per-host queue-idle fraction of take attempts (the feed
+        starvation metric: 0.0 = always fed, → 1.0 = starved)."""
+        return {
+            h: (self._idle_takes[h] / self._takes[h])
+            if self._takes[h]
+            else 0.0
+            for h in self.hosts
+        }
 
     def has_room(self) -> bool:
         """May the scheduler cut + assign another lane?  (Backpressure:
@@ -401,6 +615,21 @@ class FleetDispatcher:
             self._active[h] and len(self._queues[h]) < self.max_queue
             for h in self.hosts
         )
+
+    def feedable(self) -> bool:
+        """Is there a lane the feed loop could cut + place right now?
+        True when an active host with queue room has a nonempty home
+        packer, or the central packer has work and any active queue has
+        room."""
+        central = self.packer.pending() > 0
+        for h in self.hosts:
+            if not self._active[h]:
+                continue
+            if len(self._queues[h]) >= self.max_queue:
+                continue
+            if central or self._packers[h].pending() > 0:
+                return True
+        return False
 
     def _gauge(self, host: str) -> None:
         metrics.set_gauge(
@@ -439,11 +668,86 @@ class FleetDispatcher:
         self._gauge(best)
         return best
 
+    def cut_next(
+        self, target: int
+    ) -> tuple[Optional[PackedLane], Optional[str]]:
+        """Cut the globally most-urgent feedable lane and place it.
+
+        Candidate sources: each active host's home packer (the lane
+        lands on that host's OWN queue — host-local feed, no cross-host
+        placement decision) and the central packer (the lane lands on
+        the shallowest active queue).  The winner is the source whose
+        head is highest-class, ties broken by oldest enqueue — per-host
+        packing thus preserves the GLOBAL block > mempool > ibd > bulk
+        order (ISSUE 19).  Returns ``(lane, host)``; ``(None, None)``
+        when nothing was cut; ``(lane, None)`` when a central lane was
+        cut but no queue had room (caller dispatches it locally —
+        traffic never stops)."""
+        best_key = None
+        best_host: Optional[str] = None
+        for h in self.hosts:
+            if not self._active[h]:
+                continue
+            if len(self._queues[h]) >= self.max_queue:
+                continue
+            cls = self._packers[h].head_class()
+            if cls is None:
+                continue
+            key = (cls, self._packers[h].oldest_enqueued() or 0.0)
+            if best_key is None or key < best_key:
+                best_key, best_host = key, h
+        central_cls = self.packer.head_class()
+        if central_cls is not None and self.has_room():
+            key = (central_cls, self.packer.oldest_enqueued() or 0.0)
+            if best_key is None or key < best_key:
+                best_key, best_host = key, None
+        if best_key is None:
+            return None, None
+        if best_host is not None:
+            lane = self._packers[best_host].pop_lane(target)
+            if lane is None:  # only failed-submission residue queued
+                return None, None
+            self._queues[best_host].append(lane)
+            self._gauge(best_host)
+            return lane, best_host
+        lane = self.packer.pop_lane(target)
+        if lane is None:
+            return None, None
+        return lane, self.assign(lane)
+
+    def pop_any(self, target: int) -> Optional[PackedLane]:
+        """Cut a lane from ANY packer, priority-first (dark fleet: the
+        engine's local-CPU fallback drains the affine packers too, so
+        affinity never strands work when every host is down)."""
+        best_key = None
+        best_packer = None
+        for p in (self.packer, *self._packers.values()):
+            cls = p.head_class()
+            if cls is None:
+                continue
+            key = (cls, p.oldest_enqueued() or 0.0)
+            if best_key is None or key < best_key:
+                best_key, best_packer = key, p
+        if best_packer is None:
+            return None
+        return best_packer.pop_lane(target)
+
     def take(self, host: str, steal: bool = True) -> Optional[PackedLane]:
         """Next lane for ``host``: its own queue head, else (``steal``)
         the OLDEST lane of the deepest peer queue.  The deque pop is the
         atomic hand-off — once taken, no other host can reach this lane."""
         q = self._queues[host]
+        # Feed starvation accounting (ISSUE 19): a take that finds the
+        # host's own queue dry is a feed miss, counted BEFORE stealing —
+        # a steal hides compute starvation but not feed starvation.
+        self._takes[host] += 1
+        if not q:
+            self._idle_takes[host] += 1
+        metrics.set_gauge(
+            "sched.feed_idle",
+            self._idle_takes[host] / self._takes[host],
+            labels={"host": host},
+        )
         if q:
             lane = q.popleft()
             self._gauge(host)
@@ -530,6 +834,14 @@ class FleetDispatcher:
             metrics.inc("sched.requeued")
             moved += 1
         self._gauge(host)
+        # Re-route the lost host's UNCUT feed through push(): rendezvous
+        # re-homes each key over the remaining active set (counted as
+        # spills), affinity-less work falls back to the central packer.
+        # Runs after the active flag flipped so route() skips this host;
+        # push()'s remainder accounting keeps partially-claimed
+        # submissions' depths truthful.
+        for sub in self._packers[host].drain():
+            self.push(sub)
         return moved
 
     def activate(self, host: str) -> None:
@@ -545,4 +857,13 @@ class FleetDispatcher:
             out.extend(q)
             q.clear()
             self._gauge(h)
+        return out
+
+    def drain_submissions(self) -> list[Submission]:
+        """Remove and return every queued submission across the central
+        and per-host packers (engine teardown: the caller cancels their
+        futures)."""
+        out = self.packer.drain()
+        for p in self._packers.values():
+            out.extend(p.drain())
         return out
