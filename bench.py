@@ -133,6 +133,10 @@ T_MESH_E2E = float(os.environ.get("TPUNODE_BENCH_MESH_E2E_TIMEOUT", 240))
 # flight-recorder bundle build, measured over a synthetic registry.
 # jax is never imported (timeseries/blackbox are stdlib-only).
 T_OBS = float(os.environ.get("TPUNODE_BENCH_OBS_TIMEOUT", 90))
+# Multi-tenant serve firehose (ISSUE 20): >=1000 real-socket clients,
+# Zipf duplicates, the induced-burn shed leg and the receipt audit, on
+# the cpu-native proxy (jax is never imported).
+T_SERVE = float(os.environ.get("TPUNODE_BENCH_SERVE_TIMEOUT", 240))
 # Total ceiling: probe (<=120s) + ladder (<=600s) + fallback (<=210s)
 # + mempool (<=150s) keeps the worst case ~18 min; r03's artifact
 # demonstrated the driver tolerating 810s, and the in-round watcher
@@ -1459,6 +1463,328 @@ def _worker_mesh_e2e() -> None:
         print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
 
 
+def _worker_serve() -> None:
+    """Multi-tenant serve firehose (ISSUE 20): >=1000 simulated clients
+    over REAL sockets against a live ServeServer on the cpu-native
+    proxy.  Zipf-distributed duplicates over a ~2048-unique signed-row
+    pool (the shared verdict cache must absorb the repeats at zero
+    verify cost), 8 tenants across all four priority classes.  Two
+    legs: (1) the firehose — per-class verdict-latency p50/p99, cache
+    hit-rate, and the CONSERVATION pin: the engine verifies each unique
+    row exactly once (first submitter wins, duplicates coalesce/hit),
+    and every verdict matches the pool's known validity pattern — any
+    divergence is ``fatal`` exactly like a headline verdict mismatch;
+    (2) the induced-burn leg — the server's SLO hook reports a
+    fast-window burn, and ONLY bulk-class tenants may shed while
+    block-class p99 stays inside the DEFAULT_SLOS block objective.  A
+    receipt log rides the whole run in a tempdir and must audit clean
+    (hash chain + CRC walk); its per-append overhead is reported.
+    Prints one JSON line; the parent watchdog bounds it.
+    """
+    import asyncio
+    import contextlib
+    import itertools
+    import random
+    import tempfile
+
+    clients_n = int(os.environ.get("TPUNODE_BENCH_SERVE_CLIENTS", 1000))
+    frames_per = int(os.environ.get("TPUNODE_BENCH_SERVE_FRAMES", 3))
+    items_per = int(os.environ.get("TPUNODE_BENCH_SERVE_ITEMS", 12))
+    try:
+        from benchmarks.common import make_triples
+        from tpunode.metrics import metrics
+        from tpunode.receipts import ReceiptLog, audit
+        from tpunode.serve import ServeServer, TenantConfig
+        from tpunode.slo import DEFAULT_SLOS
+        from tpunode.verify.cpu_native import load_native_verifier
+        from tpunode.verify.engine import VerifyConfig, VerifyEngine
+
+        if load_native_verifier() is None:
+            print(json.dumps(
+                {"ok": False, "error": "native verifier unavailable"}
+            ))
+            return
+        uniq_n = 2048
+        invalid_every = 16
+        _progress(f"generating {uniq_n} unique signed rows...")
+        triples = make_triples(uniq_n, invalid_every=invalid_every)
+        rows = [
+            [
+                z.to_bytes(32, "big").hex(),
+                (
+                    b"\x04"
+                    + q.x.to_bytes(32, "big")
+                    + q.y.to_bytes(32, "big")
+                ).hex(),
+                (r.to_bytes(32, "big") + s.to_bytes(32, "big")).hex(),
+            ]
+            for (q, z, r, s) in triples
+        ]
+        # make_triples corrupts every invalid_every-th message: the
+        # expected verdict per row index is known a priori, so every
+        # client checks every reply bit (the conservation tally's twin)
+        expected = [
+            i % invalid_every != invalid_every - 1 for i in range(uniq_n)
+        ]
+        # Zipf(1.1) over the pool: head rows repeat constantly (cache
+        # fodder), the tail keeps fresh verify work arriving
+        cum_w = list(itertools.accumulate(
+            1.0 / (i + 1) ** 1.1 for i in range(uniq_n)
+        ))
+        classes = ("block", "mempool", "ibd", "bulk")
+        tenants = [
+            TenantConfig(
+                name=f"t{i}", token=f"tok-{i}",
+                priority=classes[i % len(classes)],
+                rate=1e9, burst=1e9, max_inflight=8192,
+            )
+            for i in range(8)
+        ]
+        block_slo = next(
+            s for s in DEFAULT_SLOS
+            if s.kind == "latency" and s.priority == "block"
+        )
+
+        async def run() -> dict:
+            metrics.reset()
+            burn: dict = {"on": False}
+            counted = {"verify_items": 0}
+            tmp = tempfile.mkdtemp(prefix="tpunode-serve-bench-")
+            cfg = VerifyConfig(
+                backend="cpu", batch_size=256, max_wait=0.002,
+                pipeline_depth=1, cpu_threads=1, warmup=False,
+            )
+            receipts = ReceiptLog(tmp)
+            async with VerifyEngine(cfg) as eng:
+                orig_verify = eng.verify
+
+                async def counting_verify(items, **kw):
+                    counted["verify_items"] += len(items)
+                    return await orig_verify(items, **kw)
+
+                eng.verify = counting_verify
+                async with ServeServer(
+                    eng, tenants, port=0,
+                    slo_burning=lambda: (
+                        ["verdict-latency-block"] if burn["on"] else []
+                    ),
+                    receipts=receipts,
+                ) as srv:
+                    lat: dict = {}
+                    sem = asyncio.Semaphore(250)  # fd + loop sanity
+
+                    async def one_client(
+                        ci: int, leg: str, tally: dict
+                    ) -> None:
+                        t = tenants[ci % len(tenants)]
+                        rng = random.Random(0x5E12C1 ^ (ci * 2654435761))
+                        async with sem:
+                            reader, writer = await asyncio.open_connection(
+                                "127.0.0.1", srv.port
+                            )
+                            try:
+                                for fi in range(frames_per):
+                                    idxs = rng.choices(
+                                        range(uniq_n), cum_weights=cum_w,
+                                        k=items_per,
+                                    )
+                                    frame = {
+                                        "tenant": t.name, "token": t.token,
+                                        "items": [rows[j] for j in idxs],
+                                        "id": fi,
+                                    }
+                                    data = json.dumps(
+                                        frame, separators=(",", ":")
+                                    ).encode()
+                                    t0 = time.perf_counter()
+                                    writer.write(
+                                        len(data).to_bytes(4, "big") + data
+                                    )
+                                    await writer.drain()
+                                    hdr = await reader.readexactly(4)
+                                    body = await reader.readexactly(
+                                        int.from_bytes(hdr, "big")
+                                    )
+                                    dt = time.perf_counter() - t0
+                                    reply = json.loads(body)
+                                    lat.setdefault(
+                                        (leg, t.priority), []
+                                    ).append(dt)
+                                    if reply.get("ok"):
+                                        vs = reply["verdicts"]
+                                        tally["verdicts"] += len(vs)
+                                        tally["cached"] += reply.get(
+                                            "cached", 0
+                                        )
+                                        tally["seen"].update(idxs)
+                                        tally["wrong"] += sum(
+                                            1
+                                            for j, v in zip(idxs, vs)
+                                            if bool(v) != expected[j]
+                                        )
+                                    elif reply.get("error") == "shed":
+                                        shed = tally["shed_by_class"]
+                                        shed[t.priority] = (
+                                            shed.get(t.priority, 0)
+                                            + len(reply.get("verdicts") or ())
+                                        )
+                                    elif reply.get("error") == "throttled":
+                                        tally["throttled"] += 1
+                                    else:
+                                        tally["errors"] += 1
+                            finally:
+                                with contextlib.suppress(Exception):
+                                    writer.close()
+                                    await writer.wait_closed()
+
+                    def fresh_tally() -> dict:
+                        return {
+                            "verdicts": 0, "cached": 0, "wrong": 0,
+                            "throttled": 0, "errors": 0,
+                            "shed_by_class": {}, "seen": set(),
+                        }
+
+                    _progress(f"firehose leg: {clients_n} clients...")
+                    fire = fresh_tally()
+                    t0 = time.perf_counter()
+                    await asyncio.gather(*(
+                        one_client(ci, "fire", fire)
+                        for ci in range(clients_n)
+                    ))
+                    fire_wall = time.perf_counter() - t0
+                    verified_fire = counted["verify_items"]
+
+                    burn_clients = max(256, len(tenants) * 16)
+                    _progress(
+                        f"induced-burn leg: {burn_clients} clients..."
+                    )
+                    burn["on"] = True
+                    bleg = fresh_tally()
+                    await asyncio.gather(*(
+                        one_client(ci, "burn", bleg)
+                        for ci in range(burn_clients)
+                    ))
+                    burn["on"] = False
+                    srv_stats = srv.stats()
+            receipts.close()
+            verdict = audit(tmp)
+
+            def pcts(key) -> dict:
+                xs = sorted(lat.get(key, ()))
+                if not xs:
+                    return {"p50": None, "p99": None, "n": 0}
+                return {
+                    "p50": round(xs[len(xs) // 2], 4),
+                    "p99": round(xs[min(len(xs) - 1, int(len(xs) * 0.99))], 4),
+                    "n": len(xs),
+                }
+
+            # conservation: every unique row that reached admission was
+            # verified EXACTLY once during the firehose; everything else
+            # (the Zipf mass) came out of the shared cache
+            conserve_ok = (
+                verified_fire == len(fire["seen"])
+                and fire["cached"] + verified_fire == fire["verdicts"]
+            )
+            wrong = fire["wrong"] + bleg["wrong"]
+            shed_classes = sorted(bleg["shed_by_class"])
+            burn_block_p99 = pcts(("burn", "block"))["p99"]
+            shed_ok = (
+                bool(bleg["shed_by_class"])
+                and shed_classes == ["bulk"]
+                and not fire["shed_by_class"]
+            )
+            p99_ok = (
+                burn_block_p99 is not None
+                and burn_block_p99 <= block_slo.threshold
+            )
+            appended = metrics.get("receipts.appended")
+            out = {
+                "ok": (
+                    wrong == 0 and conserve_ok and shed_ok and p99_ok
+                    and bool(verdict["ok"]) and fire["errors"] == 0
+                    and bleg["errors"] == 0
+                ),
+                "proxy": "cpu-native",
+                "clients": clients_n + burn_clients,
+                "tenants": len(tenants),
+                "unique_rows": uniq_n,
+                "frames_per_client": frames_per,
+                "items_per_frame": items_per,
+                "firehose": {
+                    "wall_s": round(fire_wall, 3),
+                    "verdicts": fire["verdicts"],
+                    "verified_unique": verified_fire,
+                    "unique_submitted": len(fire["seen"]),
+                    "cache_hits": fire["cached"],
+                    "cache_hit_rate": round(
+                        fire["cached"] / fire["verdicts"], 4
+                    ) if fire["verdicts"] else None,
+                    "throttled": fire["throttled"],
+                    "wire_errors": fire["errors"],
+                },
+                "latency": {
+                    cls: pcts(("fire", cls)) for cls in classes
+                },
+                "burn_leg": {
+                    "shed_by_class": bleg["shed_by_class"],
+                    "shed_classes": shed_classes,
+                    "block_p99": burn_block_p99,
+                    "block_objective_s": round(block_slo.threshold, 4),
+                    "verdicts": bleg["verdicts"],
+                    "wire_errors": bleg["errors"],
+                },
+                "conservation": {
+                    "ok": conserve_ok,
+                    "verified": verified_fire,
+                    "unique_submitted": len(fire["seen"]),
+                },
+                "receipts": {
+                    "records": verdict["records"],
+                    "segments": verdict["segments"],
+                    "audit_ok": bool(verdict["ok"]),
+                    "findings": verdict["findings"][:5],
+                    "append_ms_avg": round(
+                        1e3 * metrics.get("receipts.append_seconds")
+                        / appended, 4
+                    ) if appended else None,
+                },
+                "spend_by_tenant": srv_stats.get("spend", {}).get(
+                    "by_tenant", {}
+                ),
+            }
+            if wrong:
+                out["fatal"] = True  # verdict divergence, never mask
+                out["error"] = (
+                    f"{wrong} served verdicts diverged from the pool's "
+                    "known validity pattern"
+                )
+            elif not conserve_ok:
+                out["fatal"] = True
+                out["error"] = (
+                    "verdict conservation broke: "
+                    f"verified {verified_fire} != unique "
+                    f"{len(fire['seen'])} (or hits+verified != verdicts)"
+                )
+            elif not verdict["ok"]:
+                out["error"] = "receipt audit found findings"
+            elif not shed_ok:
+                out["error"] = (
+                    f"shed classes {shed_classes or 'none'} — expected "
+                    "exactly ['bulk'] under burn and none before it"
+                )
+            elif not p99_ok:
+                out["error"] = (
+                    f"block-class p99 {burn_block_p99}s breached the "
+                    f"{block_slo.threshold:.3f}s objective under burn"
+                )
+            return out
+
+        print(json.dumps(asyncio.run(run())))
+    except Exception as e:  # noqa: BLE001 — worker reports, parent decides
+        print(json.dumps({"ok": False, "error": f"{type(e).__name__}: {e}"[:500]}))
+
+
 def _worker_mesh_device() -> None:
     """One device-mesh sharding sample (ISSUE 13; the watcher's
     ``kind="mesh"`` rungs): raw-batch dispatch through
@@ -2228,6 +2554,32 @@ def _mesh_e2e_section() -> dict:
     return res
 
 
+def _serve_section() -> dict:
+    """The BENCH JSON ``serve`` section (ISSUE 20): the multi-tenant
+    firehose — per-class verdict-latency p50/p99, cache hit-rate, the
+    verdict-conservation pin, the induced-burn shed leg (only bulk-class
+    tenants shed; block-class p99 inside its SLO objective), and the
+    receipt-log audit + per-append overhead — from a bounded worker
+    subprocess.  Always returns a dict — a failed/timed-out scenario is
+    labeled, never masked (a verdict divergence or conservation break is
+    additionally marked ``fatal`` so the driver exits nonzero, exactly
+    like the headline's)."""
+    res = _run_worker(
+        "--serve", T_SERVE,
+        # cpu proxy by construction: backend="cpu" never imports jax;
+        # the pin is belt-and-braces against future drift
+        {"JAX_PLATFORMS": "cpu"},
+    )
+    if not res.get("ok") and "error" in res:
+        out = {"ok": False, "error": str(res["error"])[:300]}
+        for k in ("latency", "firehose", "burn_leg", "conservation",
+                  "receipts", "fatal"):
+            if k in res:
+                out[k] = res[k]
+        return out
+    return res
+
+
 def _mempool_section() -> dict:
     """The BENCH JSON ``mempool`` section: ingest efficiency from the
     duplicate-heavy fan-in scenario, measured in a bounded worker
@@ -2770,6 +3122,9 @@ def _main_locked() -> None:
     # the retrospective stack's overhead is a tracked number —
     # failure-labeled like the others.
     out["observability"] = _observability_section()
+    # Multi-tenant serve section (ISSUE 20): the firehose + shed +
+    # receipt-audit acceptance — failure-labeled like the others.
+    out["serve"] = _serve_section()
     print(json.dumps(out))
     # A fatal anywhere is a kernel correctness failure (device/oracle or
     # affine/oracle verdict mismatch) and must not look like success —
@@ -2783,6 +3138,7 @@ def _main_locked() -> None:
         or kab_fatal
         or out["mesh"].get("fatal")
         or out["mesh_e2e"].get("fatal")
+        or out["serve"].get("fatal")
     ):
         sys.exit(1)
 
@@ -2810,6 +3166,8 @@ if __name__ == "__main__":
         _worker_mesh_device()
     elif "--mesh-e2e" in sys.argv:
         _worker_mesh_e2e()
+    elif "--serve" in sys.argv:
+        _worker_serve()
     elif "--mesh" in sys.argv:
         _worker_mesh()
     elif "--observability" in sys.argv:

@@ -156,6 +156,11 @@ OBS_BUDGET = float(os.environ.get("TPUNODE_WATCHER_OBS_BUDGET", 120))
 MESH_E2E_BUDGET = float(
     os.environ.get("TPUNODE_WATCHER_MESH_E2E_BUDGET", 240)
 )
+# Multi-tenant serve firehose slot (ISSUE 20): >=1000 real-socket
+# clients against a live ServeServer on the cpu-native proxy — jax-free
+# like the mesh_e2e slot, same budget shape as the bench driver's
+# section budget.
+SERVE_BUDGET = float(os.environ.get("TPUNODE_WATCHER_SERVE_BUDGET", 240))
 # Sweep order: config2 is cheap; config3 (full-node IBD on device) is
 # the VERDICT item-2 money shot and must be banked before config5,
 # whose ~150k-sig batch is the slowest compile during an outage.  One
@@ -667,6 +672,30 @@ def run_mesh_e2e() -> bool:
     return False
 
 
+def run_serve() -> bool:
+    """Once-per-round multi-tenant serve sample (ISSUE 20): the bench.py
+    --serve worker's firehose — per-class verdict latency, cache
+    hit-rate, the conservation pin, the induced-burn shed leg, and the
+    receipt audit — banked as a ``kind="serve"`` row.  The worker is the
+    cpu-native proxy (JAX_PLATFORMS=cpu, jax never imported), so like
+    the mesh_e2e slot it runs even when the device is down and never
+    needs to yield to bench.py.  A failed worker keeps the slot for a
+    later window; a verdict divergence or conservation break is fatal
+    for the round (never masked by a later passing sample)."""
+    res = _run_json(
+        [sys.executable, "bench.py", "--serve"],
+        SERVE_BUDGET, {"JAX_PLATFORMS": "cpu"},
+    )
+    if res.get("fatal"):
+        _record("fatal", res)
+        raise FatalMismatch(res.get("error", "serve verdict mismatch"))
+    if res.get("ok"):
+        _record("serve", res)
+        return True
+    _log(f"serve: {res.get('error', '?')}")
+    return False
+
+
 def run_config(name: str) -> dict | None:
     if _bench_running():
         _log(f"{name}: bench.py running — yielding the tunnel")
@@ -942,6 +971,11 @@ def handle_window(swept: set) -> float:
     # affinity-on/off throughput row even when the tunnel is down.
     if "mesh_e2e" not in swept and run_mesh_e2e():
         swept.add("mesh_e2e")
+    # Multi-tenant serve sample (ISSUE 20): once per round, cpu-native
+    # and device-free like the slots above — banks the firehose/shed/
+    # receipt-audit row even when the tunnel is down.
+    if "serve" not in swept and run_serve():
+        swept.add("serve")
     # Back off to the slow refresh cadence only once every config is
     # banked: with all of them captured the next window owes us nothing
     # but a headline refresh, but while configs are missing the next

@@ -168,6 +168,30 @@ _OBS_OK = {
             "burn_detection": {"ticks": 7, "seconds": 7.0}},
 }
 
+# Canned healthy multi-tenant serve firehose result (ISSUE 20; field
+# shapes from a real `bench.py --serve` run on this box).
+_SERVE_OK = {
+    "ok": True, "proxy": "cpu-native", "clients": 1256, "tenants": 8,
+    "unique_rows": 2048, "frames_per_client": 3, "items_per_frame": 12,
+    "firehose": {"wall_s": 1.719, "verdicts": 36000,
+                 "verified_unique": 1870, "unique_submitted": 1870,
+                 "cache_hits": 34130, "cache_hit_rate": 0.9481,
+                 "throttled": 0, "wire_errors": 0},
+    "latency": {"block": {"p50": 0.0598, "p99": 0.1736, "n": 750},
+                "mempool": {"p50": 0.0593, "p99": 0.1742, "n": 750},
+                "ibd": {"p50": 0.0592, "p99": 0.1754, "n": 750},
+                "bulk": {"p50": 0.0592, "p99": 0.1753, "n": 750}},
+    "burn_leg": {"shed_by_class": {"bulk": 2304},
+                 "shed_classes": ["bulk"], "block_p99": 0.1126,
+                 "block_objective_s": 0.5243, "verdicts": 6912,
+                 "wire_errors": 0},
+    "conservation": {"ok": True, "verified": 1870,
+                     "unique_submitted": 1870},
+    "receipts": {"records": 1129, "segments": 1, "audit_ok": True,
+                 "findings": [], "append_ms_avg": 0.0217},
+    "spend_by_tenant": {"t0": {"seconds": 0.0966, "items": 246}},
+}
+
 # Canned healthy chaos-resilience result (the real subprocess path is
 # covered by test_chaos_worker_subprocess).
 _CHAOS_OK = {
@@ -224,6 +248,9 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         if mode == "--observability":
             # likewise for the ride-along observability section (ISSUE 16)
             return dict(_OBS_OK)
+        if mode == "--serve":
+            # likewise for the ride-along serve section (ISSUE 20)
+            return dict(_SERVE_OK)
         raise AssertionError(f"unexpected worker call: {mode} {env_extra}")
 
     monkeypatch.setattr(bench, "_run_worker", fake_run_worker)
@@ -268,7 +295,7 @@ def _run_main(monkeypatch, bench, script, device_run=None, evidence=None):
         if c[0] not in (
             "--mempool", "--chaos", "--kernel-ab", "--recovery",
             "--pipeline", "--ibd", "--mesh", "--mesh-e2e",
-            "--observability",
+            "--observability", "--serve",
         )
     ]
     return line, calls, rc
@@ -996,6 +1023,158 @@ def test_watcher_mesh_e2e_slot_banks_once_and_fatal_raises(monkeypatch):
     )
     with pytest.raises(W.FatalMismatch):
         W.run_mesh_e2e()
+    assert recorded == ["fatal"]
+
+
+def _is_serve(mode, env):
+    return mode == "--serve"
+
+
+def test_serve_section_always_present(monkeypatch):
+    """ISSUE 20: the BENCH JSON carries a ``serve`` section (the
+    multi-tenant firehose: per-class latency, cache hit-rate, the
+    conservation pin, the burn-shed leg, the receipt audit) on every
+    run."""
+    bench = _load_bench()
+    line, _, _ = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 1.0, "device": "tpu:v5e"}),
+        ],
+    )
+    sv = line["serve"]
+    assert sv["ok"] is True
+    assert sv["clients"] >= 1000
+    # verdict conservation: each unique row verified exactly once
+    assert sv["conservation"]["ok"] is True
+    assert (
+        sv["conservation"]["verified"]
+        == sv["conservation"]["unique_submitted"]
+    )
+    # Zipf duplicates came out of the shared cache, and the rate is a
+    # reported number
+    assert sv["firehose"]["cache_hit_rate"] > 0.5
+    # all four priority classes measured
+    assert set(sv["latency"]) == {"block", "mempool", "ibd", "bulk"}
+    # under induced burn ONLY bulk-class tenants shed, and block-class
+    # p99 stayed inside its DEFAULT_SLOS objective
+    assert sv["burn_leg"]["shed_classes"] == ["bulk"]
+    assert sv["burn_leg"]["block_p99"] <= sv["burn_leg"]["block_objective_s"]
+    # the receipt log rode the run and audited clean
+    assert sv["receipts"]["audit_ok"] is True
+    assert sv["receipts"]["records"] > 0
+
+
+def test_serve_section_worker_env_is_device_free(monkeypatch):
+    """The serve worker runs on the cpu-native proxy (backend="cpu"
+    never imports jax); its env pins cpu anyway."""
+    bench = _load_bench()
+    seen = []
+    monkeypatch.setattr(
+        bench, "_run_worker",
+        lambda mode, timeout, env=None: (
+            seen.append((mode, timeout, dict(env or {})))
+            or dict(_SERVE_OK)
+        ),
+    )
+    assert bench._serve_section()["ok"] is True
+    ((mode, timeout, env),) = seen
+    assert mode == "--serve"
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert timeout == bench.T_SERVE
+
+
+def test_serve_section_failure_labeled(monkeypatch):
+    """A failed (or timed-out) serve scenario is labeled — with whatever
+    leg evidence it produced — never masked, and never takes the
+    headline down with it."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_serve, {"ok": False,
+                         "error": "shed classes ['mempool', 'bulk'] — "
+                                  "expected exactly ['bulk'] under burn"
+                                  " and none before it",
+                         "burn_leg": {"shed_classes": ["mempool", "bulk"],
+                                      "block_p99": 0.2}}),
+        ],
+    )
+    assert rc == 0
+    assert line["value"] == 9.0  # headline survived
+    sv = line["serve"]
+    assert sv["ok"] is False
+    assert "expected exactly ['bulk']" in sv["error"]
+    assert sv["burn_leg"]["shed_classes"] == ["mempool", "bulk"]
+
+
+def test_serve_section_fatal_divergence_fails_the_run(monkeypatch):
+    """A served-verdict divergence or conservation break is a
+    correctness failure, not a perf miss: the section carries ``fatal``
+    and the driver exits nonzero exactly like the headline's."""
+    bench = _load_bench()
+    line, _, rc = _run_main(
+        monkeypatch,
+        bench,
+        [
+            (_is_probe, {"ok": True, "platform": "tpu", "init_s": 1.0}),
+            (_batch(32768), {"ok": True, "rate": 9.0, "device": "tpu:v5e"}),
+            (_is_serve, {"ok": False, "fatal": True,
+                         "error": "verdict conservation broke: verified"
+                                  " 1871 != unique 1870",
+                         "conservation": {"ok": False, "verified": 1871,
+                                          "unique_submitted": 1870}}),
+        ],
+    )
+    assert rc == 1
+    assert line["serve"]["fatal"] is True
+    assert line["serve"]["conservation"]["ok"] is False
+
+
+def test_watcher_serve_slot_banks_once_and_fatal_raises(monkeypatch):
+    """ISSUE 20 (satellite d): the watcher banks the serve firehose row
+    once per round through the device-free slot; a failed worker keeps
+    the slot; a verdict divergence records a fatal row and raises."""
+    from benchmarks import watcher as W
+
+    recorded = []
+    monkeypatch.setattr(W, "_record", lambda kind, p: recorded.append(kind))
+    calls = []
+
+    def fake_run(argv, timeout, env=None):
+        calls.append((list(argv), timeout, dict(env or {})))
+        return dict(_SERVE_OK)
+
+    monkeypatch.setattr(W, "_run_json", fake_run)
+    assert W.run_serve() is True
+    assert recorded == ["serve"]
+    ((argv, timeout, env),) = calls
+    assert argv[-1] == "--serve" and "bench.py" in argv[-2]
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert timeout == W.SERVE_BUDGET
+
+    # transient failure: no row banked, slot kept for a later window
+    recorded.clear()
+    monkeypatch.setattr(
+        W, "_run_json",
+        lambda argv, t, env=None: {"ok": False, "error": "timed out"},
+    )
+    assert W.run_serve() is False
+    assert recorded == []
+
+    # verdict divergence: fatal row + raise (never masked)
+    monkeypatch.setattr(
+        W, "_run_json",
+        lambda argv, t, env=None: {"ok": False, "fatal": True,
+                                   "error": "served verdict divergence"},
+    )
+    with pytest.raises(W.FatalMismatch):
+        W.run_serve()
     assert recorded == ["fatal"]
 
 
@@ -1985,6 +2164,7 @@ def _setup_window(monkeypatch, W, head, why, mosaic=False):
     monkeypatch.setattr(W, "run_mesh", lambda: False)
     monkeypatch.setattr(W, "run_observability", lambda: False)
     monkeypatch.setattr(W, "run_mesh_e2e", lambda: False)
+    monkeypatch.setattr(W, "run_serve", lambda: False)
     return configs, diags, recs
 
 

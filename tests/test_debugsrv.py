@@ -299,6 +299,52 @@ async def test_slo_endpoint():
 
 
 @pytest.mark.asyncio
+async def test_serve_and_receipts_endpoints():
+    """ISSUE 20 satellite: /serve serves the tenant/quota/cache snapshot
+    and /receipts pages the hash-chained record tail; without the serve
+    layer both report {"enabled": false}."""
+
+    class FakeReceipts:
+        def records(self, start=0, limit=100):
+            return [{"seq": s, "rung": "cpu"}
+                    for s in range(start, min(start + limit, 7))]
+
+        def stats(self):
+            return {"records": 7, "segment": 0}
+
+    serve_snap = {
+        "port": 4242,
+        "tenants": {"alpha": {"priority": "block", "frames": 3}},
+        "cache": {"entries": 5, "max_entries": 64},
+    }
+    reg = Metrics(disabled=False)
+    async with DebugServer(
+        port=0, registry=reg, serve=lambda: dict(serve_snap),
+        receipts=FakeReceipts(),
+    ) as srv:
+        status, headers, body = await _get(srv.port, "/serve")
+        assert status == 200
+        assert headers["content-type"].startswith("application/json")
+        assert json.loads(body) == serve_snap
+
+        status, _, body = await _get(srv.port, "/receipts")
+        assert status == 200
+        got = json.loads(body)
+        assert [r["seq"] for r in got["records"]] == list(range(7))
+        assert got["stats"]["records"] == 7
+
+        status, _, body = await _get(srv.port, "/receipts?start=5&n=1")
+        assert status == 200
+        assert [r["seq"] for r in json.loads(body)["records"]] == [5]
+
+    async with DebugServer(port=0, registry=reg) as srv:
+        for target in ("/serve", "/receipts"):
+            status, _, body = await _get(srv.port, target)
+            assert status == 200
+            assert json.loads(body) == {"enabled": False}
+
+
+@pytest.mark.asyncio
 async def test_non_get_rejected_and_garbage_ignored():
     async with DebugServer(port=0, registry=Metrics(disabled=False)) as srv:
         reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)

@@ -486,8 +486,12 @@ KNOWN_LAYERS = frozenset({
     "node",       # node composition/ingest (tpunode/node.py)
     "peer",       # wire sessions (tpunode/peer.py)
     "peermgr",    # fleet manager (tpunode/peermgr.py)
+    "receipts",   # hash-chained verdict receipt log (tpunode/receipts.py,
+                  # ISSUE 20)
     "sched",      # lane-packing verify scheduler (tpunode/verify/sched.py,
                   # ISSUE 10; incl. the node-side extract ring gauges)
+    "serve",      # multi-tenant verification-as-a-service front-end
+                  # (tpunode/serve.py, ISSUE 20)
     "slo",        # SLO engine: burn rates + budgets (tpunode/slo.py,
                   # ISSUE 17)
     "store",      # KV store (tpunode/store.py)
@@ -591,7 +595,10 @@ def _event_name(ctx: FileContext) -> None:
 # canonical fleet-name source (ISSUE 19): AffinityMap seeds hash the
 # name strings, so every layer that labels by host must already route
 # through it — which is exactly what makes it safe to allowlist.
-_BOUNDED_LABEL_SOURCES = frozenset({"host_names"})
+# ``serve.tenant_names`` (ISSUE 20) is its tenant-registry twin: it
+# validates and bounds the tenant set (<= serve.MAX_TENANTS, pinned name
+# charset), so a ``tenant=`` value drawn from it cannot grow series.
+_BOUNDED_LABEL_SOURCES = frozenset({"host_names", "tenant_names"})
 
 
 def _dynamic_format(expr: ast.AST) -> bool:
@@ -691,7 +698,13 @@ def _label_cardinality(ctx: FileContext) -> None:
     classes).  An f-string/``.format``/``%``-formatted value is the
     canonical unbounded-source smell — flag it unless the formatted
     input demonstrably comes from a registered bounded helper
-    (``_BOUNDED_LABEL_SOURCES``)."""
+    (``_BOUNDED_LABEL_SOURCES``).
+
+    ISSUE 20 extension: the ``tenant=`` label key additionally gets a
+    POSITIVE check — its value must be a string literal, or visibly
+    trace to the bounded tenant registry (``serve.tenant_names``),
+    because tenant names arrive from config/wire input where a merely
+    not-formatted value is no evidence of boundedness."""
     bindings: "dict | None" = None
 
     def get_bindings() -> dict:
@@ -714,6 +727,20 @@ def _label_cardinality(ctx: FileContext) -> None:
                 )
         return None
 
+    def unbounded_tenant(v: ast.AST) -> bool:
+        """True when a ``tenant=`` value shows no bounded provenance:
+        not a literal, no inline ``tenant_names(...)`` call, and no
+        file-wide binding of the name routed through one."""
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return False
+        if _has_bounded_call(ctx, v):
+            return False
+        if isinstance(v, ast.Name):
+            bound = get_bindings().get(v.id, [])
+            if any(_has_bounded_call(ctx, e) for e in bound):
+                return False
+        return True
+
     for node, labels in _labeled_metric_calls(ctx):
         dicts = []
         if isinstance(labels, ast.Dict):
@@ -728,9 +755,14 @@ def _label_cardinality(ctx: FileContext) -> None:
             )
         for d in dicts:
             for k_node, v in zip(d.keys, d.values):
+                key = _literal(k_node) if k_node is not None else None
                 why = taint(v)
+                if why is None and key == "tenant" and unbounded_tenant(v):
+                    why = (
+                        "does not visibly trace to the bounded tenant "
+                        "registry (serve.tenant_names)"
+                    )
                 if why is not None:
-                    key = _literal(k_node) if k_node is not None else None
                     ctx.report(
                         "label-cardinality", node,
                         f"label {key or '?'!r} value {why} — label "

@@ -19,6 +19,11 @@ the telemetry that already exists in-process:
   (tpunode/blackbox.py)
 * ``GET /slo`` — the SLO evaluator's snapshot (tpunode/slo.py):
   definitions, burn rates, remaining budgets, burn history, cost ledger
+* ``GET /serve`` — the serve layer's tenant/quota/cache snapshot
+  (tpunode/serve.py, ISSUE 20): per-tenant frames/items/shed/throttle
+  counters, verdict-cache occupancy, per-tenant spend attribution
+* ``GET /receipts?start=&n=`` — verdict receipt records by sequence
+  number from the hash-chained log (tpunode/receipts.py) + chain tip
 * ``GET /`` — the endpoint catalog itself as JSON (machine-discoverable:
   an operator with just the port can enumerate everything above)
 
@@ -63,6 +68,8 @@ ENDPOINTS: dict[str, str] = {
     "/fleet": "per-host fleet state now + sampled history",
     "/flightrecords?n=": "flight recorder post-mortem bundles",
     "/slo": "SLO burn rates, budgets, burn history, cost ledger",
+    "/serve": "serve-layer tenant/quota/cache snapshot",
+    "/receipts?start=&n=": "hash-chained verdict receipt records",
 }
 
 
@@ -87,6 +94,8 @@ class DebugServer:
         blackbox=None,  # tpunode.blackbox.FlightRecorder (or None)
         fleet: Optional[Callable[[], dict]] = None,  # live fleet state
         slo: Optional[Callable[[], dict]] = None,  # SloEvaluator.snapshot
+        serve: Optional[Callable[[], dict]] = None,  # ServeServer.stats
+        receipts=None,  # tpunode.receipts.ReceiptLog (or None)
     ):
         self._want_port = port
         self.host = host
@@ -100,6 +109,8 @@ class DebugServer:
         self.blackbox = blackbox
         self.fleet = fleet
         self.slo = slo
+        self.serve = serve
+        self.receipts = receipts
         self._server: Optional[asyncio.base_events.Server] = None
         self.port: Optional[int] = None  # actual bound port once started
 
@@ -267,6 +278,26 @@ class DebugServer:
                 self._respond(writer, 200, self.slo())
             else:
                 self._respond(writer, 200, {"enabled": False})
+        elif path == "/serve":
+            if self.serve is not None:
+                self._respond(writer, 200, self.serve())
+            else:
+                self._respond(writer, 200, {"enabled": False})
+        elif path == "/receipts":
+            if self.receipts is None:
+                self._respond(writer, 200, {"enabled": False})
+            else:
+                start = qint("start", 0, cap=(1 << 62))
+                self._respond(
+                    writer,
+                    200,
+                    {
+                        "records": self.receipts.records(
+                            start=start, limit=qint("n", 64, cap=1024)
+                        ),
+                        "stats": self.receipts.stats(),
+                    },
+                )
         else:
             self._respond(
                 writer,

@@ -59,6 +59,7 @@ from .events import events
 from .metrics import metrics
 from .params import Network
 from .peer import CannotDecodePayload, Peer, get_txs
+from .seenlru import SeenLru
 from .trace import span
 from .tracectx import discard_active as _discard_active_trace
 from .txverify import needs_prevout
@@ -243,8 +244,13 @@ class Mempool:
         # fetch tasks are crash-isolated: one failed getdata RPC must
         # never tear the node down (death is handled via _FetchDone)
         self._fetchers = Supervisor(name="mempool-fetch")
-        self._seen: "OrderedDict[bytes, _Entry]" = OrderedDict()
-        self._alias: dict[bytes, bytes] = {}  # wtxid -> txid (differs)
+        # seen/verdict LRU (extracted structure: seenlru.py) — keyed by
+        # txid with a wtxid alias; PENDING entries are pinned (verdict
+        # in flight: a re-push would double-verify) up to the hard 2x
+        # ceiling the structure enforces
+        self._seen: SeenLru = SeenLru(
+            cfg.max_txs, pinned=lambda e: e.state == TxState.PENDING
+        )
         self._orphans: "OrderedDict[bytes, _Entry]" = OrderedDict()
         self._waiting: dict[bytes, set[bytes]] = {}  # parent -> orphans
         self._want: "OrderedDict[bytes, _Want]" = OrderedDict()
@@ -342,7 +348,7 @@ class Mempool:
 
     def contains(self, txid: bytes) -> bool:
         """Is ``txid`` an active (pending or valid) mempool member?"""
-        e = self._seen.get(txid) or self._seen.get(self._alias.get(txid, b""))
+        e = self._seen.lookup(txid)
         return e is not None and e.state in (TxState.PENDING, TxState.VALID)
 
     def get(self, txid: bytes):
@@ -421,7 +427,7 @@ class Mempool:
             # fast dedup: one double-SHA over the wire bytes (== wtxid
             # for witness serializations, == txid otherwise), no parse
             k = double_sha256(raw)
-            known = self._alias.get(k, k)
+            known = self._seen.resolve(k)
             if known in self._seen:
                 self._dedup_hit(peer, known)
                 return False
@@ -448,7 +454,7 @@ class Mempool:
             self._dedup_hit(peer, txid)
             return False
         if wtxid != txid:
-            self._alias[wtxid] = txid
+            self._seen.alias(wtxid, txid)
         if not force:
             missing = self._missing_parents(tx)
             if missing:
@@ -477,7 +483,7 @@ class Mempool:
         metrics.inc("mempool.dedup_hits")
         e = self._seen.get(txid)
         if e is not None:
-            self._seen.move_to_end(txid)  # recently relevant: keep in LRU
+            self._seen.touch(txid)  # recently relevant: keep in LRU
             if e.state == TxState.INVALID:
                 # a verdict served from cache: zero verify work, and the
                 # peer relaying a known-invalid tx is counted against it
@@ -508,33 +514,16 @@ class Mempool:
         return missing
 
     def _insert_seen(self, entry: _Entry) -> None:
-        self._seen[entry.txid] = entry
-        self._seen.move_to_end(entry.txid)
-        scanned, max_scan = 0, len(self._seen)
-        while len(self._seen) > self.cfg.max_txs and scanned < max_scan:
-            old_txid, old = self._seen.popitem(last=False)
-            scanned += 1
-            if (
-                old.state == TxState.PENDING
-                and len(self._seen) < 2 * self.cfg.max_txs
-            ):
-                # verdict in flight: don't forget it mid-verify (a re-push
-                # would double-verify) — rotate it to the tail and keep
-                # scanning, so a PENDING head never shields evictable
-                # entries behind it.  The rotation is bounded (max_scan:
-                # all-PENDING maps accept the overshoot) and capped by a
-                # hard 2x ceiling: with no verify engine (or one wedged)
-                # every entry stays PENDING forever, and "never evict
-                # pending" would be an unbounded leak.
-                self._seen[old_txid] = old
-                continue
+        # eviction policy (PENDING rotation, 2x ceiling) lives in the
+        # extracted structure; this actor owns index teardown + metrics
+        for old_txid, old in self._seen.insert(entry.txid, entry):
             self._forget(old_txid, old)
             metrics.inc("mempool.evicted")
 
     def _forget(self, txid: bytes, e: _Entry) -> None:
         """Drop every index entry for a seen txid (LRU eviction)."""
         if e.wtxid != txid:
-            self._alias.pop(e.wtxid, None)
+            self._seen.drop_alias(e.wtxid)
         if e.state in (TxState.PENDING, TxState.VALID):
             self._size -= 1
             metrics.set_gauge("mempool.size", self._size)
@@ -567,7 +556,7 @@ class Mempool:
             self._unpark(old_txid, old, pop=False)
             self._seen.pop(old_txid, None)
             if old.wtxid != old_txid:
-                self._alias.pop(old.wtxid, None)
+                self._seen.drop_alias(old.wtxid)
             metrics.inc("mempool.orphan_evicted")
             # same contract as TTL expiry: the embedder gets a verdict
             # for every ingested tx — size pressure degrades the oldest
@@ -712,7 +701,7 @@ class Mempool:
         _bump_label(self._announcers, _label(peer), len(txids))
         metrics.inc("mempool.announcements", len(txids))
         for txid in txids:
-            e_txid = self._alias.get(txid, txid)
+            e_txid = self._seen.resolve(txid)
             if e_txid in self._seen:
                 self._dedup_hit(peer, e_txid)
                 continue
@@ -819,7 +808,7 @@ class Mempool:
             if w is None or w.inflight is not peer:
                 continue
             w.inflight = None
-            if ok or self._alias.get(txid, txid) in self._seen:
+            if ok or self._seen.resolve(txid) in self._seen:
                 # served (or delivered by another path mid-flight): the
                 # push path owns admission from here
                 del self._want[txid]

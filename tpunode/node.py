@@ -72,6 +72,8 @@ from .peer import (
 )
 from .peermgr import PeerMgr, PeerMgrConfig, SockAddr
 from .store import KVStore, Namespaced
+from .receipts import ReceiptLog
+from .serve import ServeServer, TenantConfig
 from .ibd import BlockFetcher, IbdConfig
 from .utxo import UNDO_DEPTH_DEFAULT, UTXO_NAMESPACE, UtxoStore
 from .wire import (
@@ -255,6 +257,22 @@ class NodeConfig:
     # resumes from the watermark re-fetching nothing below it.  Requires
     # ``utxo=True`` (the watermark IS the sync cursor).
     ibd: Optional[IbdConfig] = None
+    # multi-tenant verification-as-a-service (tpunode/serve.py, ISSUE 20):
+    # when set, the node exposes the batch verify engine over a
+    # length-prefixed JSON TCP API to the registered ``serve_tenants`` —
+    # token auth, per-tenant token-bucket quota + inflight caps,
+    # priority-class mapping onto packer lanes, a shared verdict cache,
+    # and SLO-burn shedding of the lowest class first.  None = off (the
+    # default); 0 binds an ephemeral port, readable from
+    # ``node.serve_server.port``.  Requires ``verify`` and >=1 tenant.
+    serve_port: Optional[int] = None
+    serve_tenants: tuple = ()
+    # tamper-evident verdict receipts (tpunode/receipts.py, ISSUE 20):
+    # when set, every served verify batch appends one hash-chained,
+    # CRC-framed record (batch digest, verdict digest, kernel-modes
+    # tuple, dispatching rung) to a segmented log in this directory —
+    # auditable offline with ``python -m tpunode.receipts --audit``.
+    receipts_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.connect is None:
@@ -264,6 +282,19 @@ class NodeConfig:
                 "NodeConfig.ibd requires utxo=True: the persistent UTXO "
                 "watermark is the fetch planner's sync cursor"
             )
+        if self.serve_port is not None:
+            if self.verify is None:
+                raise ValueError(
+                    "NodeConfig.serve_port requires verify: the serve "
+                    "layer is a tenant front-end over the batch verify "
+                    "engine"
+                )
+            if not self.serve_tenants:
+                raise ValueError(
+                    "NodeConfig.serve_port requires at least one "
+                    "TenantConfig in serve_tenants (unauthenticated "
+                    "serving is not a mode)"
+                )
 
 
 class Node:
@@ -402,6 +433,10 @@ class Node:
         self.timeline: Optional[Timeline] = None
         self.blackbox: Optional[FlightRecorder] = None
         self.slo: Optional[SloEvaluator] = None
+        # serve layer (ISSUE 20): built in _start (needs the SLO
+        # evaluator's burn signal), closed in __aexit__
+        self.serve_server: Optional[ServeServer] = None
+        self.receipts: Optional[ReceiptLog] = None
 
     @staticmethod
     def _verify_task_died(task, exc) -> None:
@@ -522,6 +557,25 @@ class Node:
             )
             if not self.slo.disabled:
                 self._tasks.link(self.slo.run(), name="slo-evaluator")
+        if self.cfg.serve_port is not None:
+            # serve layer (ISSUE 20): tenant-facing verify service.  The
+            # receipt log opens first so the server's very first batch is
+            # already bound into the hash chain; it closes in __aexit__
+            # AFTER the exit stack has drained the server's connections.
+            if self.cfg.receipts_dir is not None:
+                self.receipts = ReceiptLog(self.cfg.receipts_dir)
+            self.serve_server = ServeServer(
+                self.verify_engine,
+                self.cfg.serve_tenants,
+                port=self.cfg.serve_port,
+                slo_burning=(
+                    (lambda: self.slo.burning("fast"))
+                    if self.slo is not None
+                    else None
+                ),
+                receipts=self.receipts,
+            )
+            await self._stack.enter_async_context(self.serve_server)
         if self.cfg.blackbox:
             # bundle state sources: each is one lock-cheap snapshot call,
             # safe from whatever thread the trigger event fires on
@@ -534,6 +588,8 @@ class Node:
                 sources["utxo"] = self.utxo.stats
             if self.slo is not None:
                 sources["slo"] = self.slo.snapshot
+            if self.serve_server is not None:
+                sources["serve"] = self.serve_server.stats
             sources["threadsan"] = threadsan.registry.snapshot
             self.blackbox = FlightRecorder(
                 FlightRecorderConfig(dir=self.cfg.blackbox_dir),
@@ -555,6 +611,12 @@ class Node:
                 slo=(
                     self.slo.snapshot if self.slo is not None else None
                 ),
+                serve=(
+                    self.serve_server.stats
+                    if self.serve_server is not None
+                    else None
+                ),
+                receipts=self.receipts,
             )
             await self._stack.enter_async_context(self.debug_server)
         log.info(
@@ -612,6 +674,11 @@ class Node:
                 if self._attributor is not None:
                     self._attributor.stop()
                     self._attributor = None
+                if self.receipts is not None:
+                    # after the stack: the serve server has drained its
+                    # connections, so no append can race the close
+                    self.receipts.close()
+                    self.receipts = None
                 # asyncsan task-leak sweep: everything this node owned is
                 # now cancelled+awaited, so any still-pending registered
                 # task with no live open owner is an orphan — report it
@@ -795,6 +862,13 @@ class Node:
             "slo": (
                 self.slo.snapshot()
                 if self.slo is not None
+                else {"enabled": False}
+            ),
+            # serve layer (ISSUE 20): per-tenant frames/items/spend,
+            # cache occupancy, receipt-chain tip
+            "serve": (
+                self.serve_server.stats()
+                if self.serve_server is not None
                 else {"enabled": False}
             ),
         }

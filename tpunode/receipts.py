@@ -1,0 +1,430 @@
+"""Tamper-evident verdict receipts: an append-only, hash-chained log.
+
+The serve layer (serve.py) verifies batches on behalf of tenants who
+cannot see the TPU.  A **receipt** is the service's auditable claim
+about one dispatched batch: it binds
+
+* the batch digest (what was submitted),
+* the verdict digest (what the engine answered),
+* the kernel mode tuple (``kernel_modes()`` — HOW it was verified:
+  field/point representation, ladder, window bits) or a
+  ``no-device-kernel`` marker when the dispatching rung never touched
+  the device kernel (cpu/oracle),
+* the engine rung that served it (``tpu``/``cpu``/``oracle``), and
+* the chain hash of the previous receipt,
+
+so a tenant can audit *what was verified and in which kernel mode*
+offline, without re-running any of it (the ACE-style replayable-receipt
+idea from PAPERS.md applied to verdicts instead of execution).
+
+On disk this reuses store.py's v2 segmented-log machinery byte-for-byte
+(``TPK2`` file header, CRC-prefixed records, ``.NNNNNNNN.seg`` segment
+naming) — one record grammar, one definition.  Integrity is two layers:
+the per-record CRC32 catches any flipped byte inside a record, and the
+SHA-256 chain (``chain_i = sha256(chain_{i-1} || value_i)``, genesis all
+zeros, each record carrying ``prev = chain_{i-1}``) catches record
+replacement, reordering, and truncation even by an adversary who
+recomputes CRCs.  The offline auditor —
+
+    python -m tpunode.receipts --audit <dir>
+
+— re-walks every segment strictly: bad header, CRC mismatch, sequence
+gap, chain break, or trailing bytes are all findings; a clean log has
+zero.  Unlike LogKV's replay (which quietly truncates a torn tail and
+quarantines salvageable segments to keep a *node* bootable), the
+receipt log is strict on reopen too: receipts exist to be believed, so
+any anomaly raises :class:`ReceiptCorruption` instead of healing.
+
+Not thread-safe: the owner (ServeServer) appends from its event loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+import zlib
+from collections import deque
+from typing import Optional
+
+from .events import events
+from .metrics import metrics
+
+# Same-package reuse of the v2 on-disk format (store.py owns it).  The
+# private names are imported deliberately: receipts segments are
+# bit-compatible with LogKV segments by design, and the record grammar
+# must have exactly one definition.
+from .store import (
+    _FILE_HDR,
+    _FMT_VERSION,
+    _KIND_LOG,
+    _MAGIC,
+    _OP_PUT,
+    _REC_V2,
+    _REC_V2_BODY,
+    _fsync_dir,
+    _list_segments,
+    _seg_path,
+)
+
+__all__ = ["ReceiptLog", "ReceiptCorruption", "audit", "GENESIS"]
+
+#: Chain hash before the first receipt.
+GENESIS = b"\x00" * 32
+
+#: Segment basename inside the receipt directory.
+_BASE = "receipts"
+
+#: Bounded in-memory tail kept for the ``/receipts`` debug endpoint —
+#: older records are re-read from disk on demand.
+_RING = 1024
+
+metrics.describe("receipts.appended", "receipt records appended")
+metrics.describe("receipts.append_seconds", "wall seconds spent appending receipts")
+metrics.describe("receipts.rotations", "receipt log segment rotations")
+
+
+class ReceiptCorruption(Exception):
+    """The receipt log failed its strict integrity walk.
+
+    ``findings`` holds the auditor's per-anomaly dicts."""
+
+    def __init__(self, path: str, findings: list):
+        self.findings = findings
+        first = findings[0] if findings else {}
+        super().__init__(
+            f"receipt log {path!r}: {len(findings)} integrity finding(s); "
+            f"first: {first}"
+        )
+
+
+def _canonical(body: dict) -> bytes:
+    """The signed bytes of a receipt body: canonical (sorted, compact)
+    JSON, so the chain hash is stable across writers."""
+    return json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+
+
+def _chain_hash(prev: bytes, value: bytes) -> bytes:
+    return hashlib.sha256(prev + value).digest()
+
+
+def _jsonable_modes(modes) -> list:
+    return [
+        m if isinstance(m, (str, int, float, bool)) else str(m) for m in modes
+    ]
+
+
+# ---------------------------------------------------------------------------
+# offline auditor
+
+
+def audit(path: str) -> dict:
+    """Strictly re-walk the receipt log under ``path``.
+
+    Returns ``{"ok", "records", "segments", "tip", "findings"}`` where
+    ``findings`` is a list of ``{"segment", "offset", "error"}`` dicts —
+    empty on a clean log.  Every byte of every segment is covered: file
+    headers are checked field-by-field, each record's CRC is recomputed,
+    per-segment and global sequence numbers must be gapless, each body's
+    ``prev`` must equal the recomputed chain hash of its predecessor,
+    and trailing bytes that don't form a full valid record are an
+    anomaly (this log has no quiet torn-tail tolerance — see module
+    docstring)."""
+    findings: list[dict] = []
+
+    def flag(segment: int, offset: int, error: str) -> None:
+        findings.append(
+            {"segment": segment, "offset": offset, "error": error}
+        )
+
+    base = os.path.join(path, _BASE)
+    segs = _list_segments(base)
+    gseq = 0  # global receipt sequence across segments
+    tip = GENESIS
+    expect_seg = 0
+    for seg_seq, spath in segs:
+        if seg_seq != expect_seg:
+            flag(seg_seq, 0, f"segment sequence gap: expected {expect_seg}")
+        expect_seg = seg_seq + 1
+        try:
+            with open(spath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            flag(seg_seq, 0, f"unreadable segment: {e}")
+            continue
+        if len(data) < _FILE_HDR.size:
+            flag(seg_seq, 0, "short file header")
+            continue
+        magic, ver, kind, hdr_seq = _FILE_HDR.unpack_from(data, 0)
+        if magic != _MAGIC:
+            flag(seg_seq, 0, f"bad magic {magic!r}")
+            continue
+        if ver != _FMT_VERSION:
+            flag(seg_seq, 0, f"bad format version {ver}")
+        if kind != _KIND_LOG:
+            flag(seg_seq, 0, f"bad file kind {kind}")
+        if hdr_seq != seg_seq:
+            flag(seg_seq, 0, f"header segment seq {hdr_seq} != filename")
+        off = _FILE_HDR.size
+        rec_seq = 0  # per-segment record sequence (v2 format contract)
+        while off < len(data):
+            if len(data) - off < _REC_V2.size:
+                flag(seg_seq, off, f"{len(data) - off} trailing bytes")
+                break
+            crc, rseq, op, klen, vlen = _REC_V2.unpack_from(data, off)
+            end = off + _REC_V2.size + klen + vlen
+            if end > len(data):
+                flag(seg_seq, off, "torn record (past end of segment)")
+                break
+            body = data[off + 4 : end]
+            if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                # the lengths themselves are untrusted now: stop walking
+                # this segment rather than resync (strict by design)
+                flag(seg_seq, off, "record CRC mismatch")
+                break
+            if rseq != rec_seq:
+                flag(seg_seq, off, f"record seq {rseq}, expected {rec_seq}")
+            if op != _OP_PUT:
+                flag(seg_seq, off, f"unexpected op {op}")
+            k = data[off + _REC_V2.size : off + _REC_V2.size + klen]
+            v = data[off + _REC_V2.size + klen : end]
+            if klen != 8:
+                flag(seg_seq, off, f"key length {klen}, expected 8")
+            elif int.from_bytes(k, "big") != gseq:
+                flag(
+                    seg_seq, off,
+                    f"receipt seq {int.from_bytes(k, 'big')}, expected {gseq}",
+                )
+            try:
+                rec = json.loads(v)
+            except ValueError as e:
+                flag(seg_seq, off, f"unparseable receipt body: {e}")
+                rec = None
+            if rec is not None:
+                if rec.get("seq") != gseq:
+                    flag(seg_seq, off, f"body seq {rec.get('seq')} != {gseq}")
+                if rec.get("prev") != tip.hex():
+                    flag(seg_seq, off, "chain break: prev hash mismatch")
+            tip = _chain_hash(tip, v)
+            gseq += 1
+            rec_seq += 1
+            off = end
+    return {
+        "ok": not findings,
+        "records": gseq,
+        "segments": len(segs),
+        "tip": tip.hex() if gseq else None,
+        "findings": findings,
+    }
+
+
+# ---------------------------------------------------------------------------
+# writer
+
+
+class ReceiptLog:
+    """Append-only hash-chained receipt log over v2 segments.
+
+    ``segment_bytes`` bounds each segment (rotation happens on the
+    append that would cross it); ``fsync`` makes each append durable
+    before returning (off by default — receipts protect against
+    tampering, not power loss, and the serve hot path should not eat an
+    fsync per batch).
+
+    Reopen is strict: the constructor re-audits the whole log and
+    raises :class:`ReceiptCorruption` on any finding; on success it
+    resumes the chain tip and starts a fresh segment (append-only —
+    existing segments are never reopened for write).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        segment_bytes: int = 1 << 20,
+        fsync: bool = False,
+    ):
+        os.makedirs(path, exist_ok=True)
+        self.path = path
+        self._base = os.path.join(path, _BASE)
+        self._segment_bytes = max(int(segment_bytes), _FILE_HDR.size + 1)
+        self._fsync = fsync
+        self._ring: "deque[dict]" = deque(maxlen=_RING)
+        self._appended = 0
+        self._rotations = 0
+        res = audit(path)
+        if res["findings"]:
+            raise ReceiptCorruption(path, res["findings"])
+        self._seq = res["records"]
+        self._tip = bytes.fromhex(res["tip"]) if res["tip"] else GENESIS
+        segs = _list_segments(self._base)
+        self._seg_seq = segs[-1][0] + 1 if segs else 0
+        self._rec_seq = 0
+        self._f = self._new_segment(self._seg_seq)
+
+    # -- segments ------------------------------------------------------------
+
+    def _new_segment(self, seq: int):
+        f = open(_seg_path(self._base, seq), "xb")
+        f.write(_FILE_HDR.pack(_MAGIC, _FMT_VERSION, _KIND_LOG, seq))
+        f.flush()
+        if self._fsync:
+            os.fsync(f.fileno())
+            _fsync_dir(os.path.dirname(self._base))
+        return f
+
+    def _rotate(self) -> None:
+        self._f.flush()
+        if self._fsync:
+            os.fsync(self._f.fileno())
+        self._f.close()
+        self._seg_seq += 1
+        self._rec_seq = 0
+        self._f = self._new_segment(self._seg_seq)
+        self._rotations += 1
+        metrics.inc("receipts.rotations")
+        events.emit("receipts.rotate", segment=self._seg_seq)
+
+    # -- append --------------------------------------------------------------
+
+    def append(
+        self,
+        batch_digest: bytes,
+        verdict_digest: bytes,
+        modes: tuple,
+        rung: str,
+    ) -> dict:
+        """Append one receipt; returns the record dict (body + its own
+        ``chain`` hash, which is the new log tip)."""
+        t0 = time.monotonic()
+        seq = self._seq
+        body = {
+            "seq": seq,
+            "batch": batch_digest.hex(),
+            "verdict": verdict_digest.hex(),
+            "modes": _jsonable_modes(modes),
+            "rung": rung,
+            "prev": self._tip.hex(),
+            "ts": round(time.time(), 6),
+        }
+        v = _canonical(body)
+        if self._rec_seq > 0 and self._f.tell() >= self._segment_bytes:
+            self._rotate()
+        k = seq.to_bytes(8, "big")
+        rec_body = (
+            _REC_V2_BODY.pack(self._rec_seq, _OP_PUT, len(k), len(v)) + k + v
+        )
+        crc = zlib.crc32(rec_body) & 0xFFFFFFFF
+        self._f.write(crc.to_bytes(4, "little") + rec_body)
+        self._f.flush()
+        if self._fsync:
+            os.fsync(self._f.fileno())
+        self._seq = seq + 1
+        self._rec_seq += 1
+        self._tip = _chain_hash(self._tip, v)
+        self._appended += 1
+        record = dict(body, chain=self._tip.hex())
+        self._ring.append(record)
+        dt = time.monotonic() - t0
+        metrics.inc("receipts.appended")
+        metrics.inc("receipts.append_seconds", dt)
+        metrics.observe("receipts.append_latency", dt)
+        return record
+
+    # -- reads ---------------------------------------------------------------
+
+    @property
+    def seq(self) -> int:
+        """The next receipt sequence number (== records appended ever)."""
+        return self._seq
+
+    @property
+    def tip(self) -> bytes:
+        return self._tip
+
+    def records(self, start: int = 0, limit: int = 100) -> "list[dict]":
+        """Records ``[start, start+limit)`` — recent ones from the
+        in-memory ring, older ones re-read from disk (best effort: a
+        disk walk stops quietly at the first anomaly; strictness is the
+        auditor's job)."""
+        limit = max(0, min(int(limit), _RING))
+        end = min(start + limit, self._seq)
+        if start >= end:
+            return []
+        ring_lo = self._seq - len(self._ring)
+        if start >= ring_lo:
+            return [r for r in self._ring if start <= r["seq"] < end]
+        out = []
+        for rec in self._iter_disk(start):
+            if rec["seq"] >= end:
+                break
+            out.append(rec)
+        return out
+
+    def _iter_disk(self, start: int):
+        for seg_seq, spath in _list_segments(self._base):
+            try:
+                with open(spath, "rb") as f:
+                    data = f.read()
+            except OSError:
+                return
+            off = _FILE_HDR.size
+            while len(data) - off >= _REC_V2.size:
+                crc, rseq, op, klen, vlen = _REC_V2.unpack_from(data, off)
+                end = off + _REC_V2.size + klen + vlen
+                if end > len(data):
+                    return
+                body = data[off + 4 : end]
+                if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                    return
+                v = data[off + _REC_V2.size + klen : end]
+                off = end
+                try:
+                    rec = json.loads(v)
+                except ValueError:
+                    return
+                if rec.get("seq", -1) >= start:
+                    prev = bytes.fromhex(rec.get("prev", ""))
+                    yield dict(rec, chain=_chain_hash(prev, v).hex())
+
+    def stats(self) -> dict:
+        return {
+            "records": self._seq,
+            "tip": self._tip.hex(),
+            "segment": self._seg_seq,
+            "appended": self._appended,
+            "rotations": self._rotations,
+        }
+
+    def close(self) -> None:
+        if self._f is None:
+            return
+        self._f.flush()
+        if self._fsync:
+            os.fsync(self._f.fileno())
+        self._f.close()
+        self._f = None
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpunode.receipts",
+        description="Offline receipt-chain auditor (strict; exit 1 on "
+        "any integrity finding).",
+    )
+    ap.add_argument("--audit", metavar="DIR", required=True,
+                    help="receipt log directory to walk")
+    args = ap.parse_args(argv)
+    res = audit(args.audit)
+    print(json.dumps(res, indent=2, sort_keys=True))
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,611 @@
+"""servesrv — multi-tenant verification-as-a-service (ISSUE 20).
+
+ROADMAP item 3: the node's batch verify engine, leased over the network
+to registered **tenants** — the permissioned-blockchain shape of
+PAPERS.md's arXiv:2112.02229, where one shared ECDSA verify pipeline
+serves many validators.  This module is the traffic-facing layer on top
+of substrates that already exist separately:
+
+* **Wire API** — a zero-dep asyncio TCP server (debugsrv-style;
+  ``NodeConfig.serve_port``, default off) speaking length-prefixed JSON
+  frames.  A frame authenticates a registered tenant (name + shared
+  token) and submits either pre-extracted signature rows
+  (``(digest, pubkey, sig)``) or raw transaction bytes; every frame
+  gets exactly one explicit reply — verdicts, a throttle, or a shed
+  error.  Nothing is ever silently dropped (the mempool's verdict
+  contract, applied to the network edge).
+* **Quota admission** — per-tenant token bucket (sigs/sec + burst) and
+  max-inflight-items cap, both from :class:`TenantConfig`.  An
+  over-quota frame is answered with ``error=throttled`` (+
+  ``retry_after``) and costs zero verify work.
+* **QoS shedding** — when the node's own SLO evaluator reports a
+  fast-window burn (slo.py), admission sheds the lowest
+  priority-class tenants first (never ``block``-class), with explicit
+  per-frame error verdicts and ``serve.shed{tenant=,reason=}``
+  accounting — the verify engine's headroom goes to the classes whose
+  SLOs are burning.
+* **Shared verdict-cache tier** — the mempool's extracted seen/verdict
+  LRU (seenlru.py) mounted service-wide: Zipf-skewed duplicate
+  submissions across tenants hit the cache (or coalesce onto the
+  in-flight future of the first submitter) and cost zero TPU work,
+  with per-tenant hit accounting (``serve.cache_hits{tenant=}``).
+* **Cost attribution** — submissions carry ``tenant=`` through the
+  packer into the engine's :class:`~tpunode.verify.engine.CostLedger`,
+  so ``stats()["serve"]`` reports per-tenant charged rung seconds under
+  the same conservation pin as the per-class ledger (ISSUE 17).
+* **Verdict receipts** — every dispatched batch appends a hash-chained
+  receipt (receipts.py) binding the batch digest, verdict digest,
+  kernel mode tuple and serving rung, so tenants can audit the service
+  offline without re-verifying.
+
+The tenant registry is bounded (``MAX_TENANTS``) and
+:func:`tenant_names` is the canonical — analyzer-allowlisted — source
+of ``tenant=`` label values, exactly like ``sched.host_names`` for
+``host=`` (PR 19's label-cardinality rule).
+
+Single-threaded: all state lives on the event loop (the asyncio server
+callbacks); nothing here takes locks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+import hmac
+import json
+import logging
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from .events import events
+from .metrics import metrics
+from .seenlru import SeenLru
+from .txverify import extract_sig_items
+from .util import double_sha256
+from .verify.ecdsa_cpu import decode_pubkey
+from .verify.sched import PRIORITIES
+from .wire import Reader, Tx
+
+__all__ = ["TenantConfig", "ServeServer", "tenant_names", "MAX_TENANTS"]
+
+log = logging.getLogger("tpunode.serve")
+
+#: Hard bound on the tenant registry: the ``tenant=`` label set (and the
+#: per-tenant state table) must stay small by construction.
+MAX_TENANTS = 64
+
+_MAX_FRAME = 8 << 20  # wire frame byte cap (pre-parse bound)
+_MAX_ITEMS = 8192  # items per frame (one packer lane's worth of slack)
+
+#: Default service-wide verdict-cache entries.
+DEFAULT_CACHE = 65536
+
+metrics.describe("serve.frames", "wire frames received per tenant")
+metrics.describe("serve.items", "signature items submitted per tenant")
+metrics.describe(
+    "serve.cache_hits",
+    "items served from the shared verdict cache (zero verify work)",
+)
+metrics.describe("serve.shed", "items shed under SLO burn per tenant")
+metrics.describe("serve.throttled", "items refused by quota admission")
+metrics.describe("serve.verified", "items dispatched to the verify engine")
+metrics.describe(
+    "serve.latency", "frame admission->reply latency per tenant (seconds)"
+)
+
+
+def tenant_names(tenants) -> list:
+    """Canonical tenant-name list for a registry (configs or plain
+    names), validating the bound.  Owned HERE — next to the server that
+    keys its state tables and its ``tenant=`` metric labels by these
+    strings: the analyzer's label-cardinality rule allowlists this as
+    the bounded source for ``tenant=`` label values (exactly like
+    ``sched.host_names`` for ``host=``), which is only sound because
+    every name must pass this validator to be registered at all."""
+    names: list = []
+    for t in tenants:
+        name = t if isinstance(t, str) else t.name
+        if (
+            not name
+            or len(name) > 32
+            or not all(c.isalnum() or c in "_-" for c in name)
+        ):
+            raise ValueError(f"invalid tenant name {name!r}")
+        if name in names:
+            raise ValueError(f"duplicate tenant name {name!r}")
+        names.append(name)
+    if len(names) > MAX_TENANTS:
+        raise ValueError(
+            f"{len(names)} tenants exceeds MAX_TENANTS={MAX_TENANTS}"
+        )
+    return names
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """One registered tenant: identity, lane mapping, and quota."""
+
+    name: str
+    token: str  # shared-secret auth token (compared constant-time)
+    priority: str = "bulk"  # packer lane: block > mempool > ibd > bulk
+    rate: float = 5000.0  # token-bucket refill, signature items / second
+    burst: float = 10000.0  # token-bucket depth, items
+    max_inflight: int = 8192  # items in the engine at once
+
+    def __post_init__(self):
+        if self.priority not in PRIORITIES:
+            raise ValueError(
+                f"tenant {self.name!r}: unknown priority "
+                f"{self.priority!r}: one of {PRIORITIES}"
+            )
+
+
+class _TokenBucket:
+    __slots__ = ("rate", "burst", "tokens", "stamp")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.stamp = time.monotonic()
+
+    def take(self, n: int, now: Optional[float] = None) -> float:
+        """Try to spend ``n`` tokens.  Returns 0.0 on success, else the
+        seconds until ``n`` tokens will have refilled (the throttle
+        reply's ``retry_after``) — nothing is spent on refusal."""
+        if now is None:
+            now = time.monotonic()
+        self.tokens = min(self.burst, self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        if n <= self.tokens:
+            self.tokens -= n
+            return 0.0
+        if self.rate <= 0:
+            return float("inf")
+        return (n - self.tokens) / self.rate
+
+
+class _TenantState:
+    __slots__ = (
+        "cfg", "bucket", "inflight", "frames", "items", "cache_hits",
+        "verified", "shed", "throttled",
+    )
+
+    def __init__(self, cfg: TenantConfig):
+        self.cfg = cfg
+        self.bucket = _TokenBucket(cfg.rate, cfg.burst)
+        self.inflight = 0  # items currently in the engine
+        self.frames = 0
+        self.items = 0
+        self.cache_hits = 0
+        self.verified = 0
+        self.shed = 0
+        self.throttled = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "priority": self.cfg.priority,
+            "frames": self.frames,
+            "items": self.items,
+            "cache_hits": self.cache_hits,
+            "verified": self.verified,
+            "shed": self.shed,
+            "throttled": self.throttled,
+            "inflight": self.inflight,
+            "tokens": round(self.bucket.tokens, 1),
+        }
+
+
+def _kernel_modes_now() -> tuple:
+    """The device kernel's mode tuple when the device kernel is actually
+    in play, else a marker.  Gated on the module being imported — the
+    cpu/oracle rungs never touch it, and importing it pulls in jax
+    (which the serve bench's cpu-proxy worker must never do)."""
+    k = sys.modules.get("tpunode.verify.kernel")
+    if k is None:
+        return ("no-device-kernel",)
+    try:
+        return tuple(k.kernel_modes())
+    except Exception:  # modes must never fail a verify reply
+        return ("kernel-modes-error",)
+
+
+def _parse_row(row) -> tuple:
+    """One pre-extracted wire row ``[digest_hex, pubkey_hex, sig_hex]``
+    (sig = 64-byte compact r||s) to a VerifyItem tuple.  Malformed rows
+    become the degenerate ``(None, 0, 0, 0)`` item — an explicit False
+    verdict, never a dropped one (the engine's own contract for
+    undecodable keys)."""
+    try:
+        digest = bytes.fromhex(row[0])
+        pub = bytes.fromhex(row[1])
+        sig = bytes.fromhex(row[2])
+        if len(digest) != 32 or len(sig) != 64:
+            return (None, 0, 0, 0)
+        q = decode_pubkey(pub)
+        if q is None:
+            return (None, 0, 0, 0)
+        return (
+            q,
+            int.from_bytes(digest, "big"),
+            int.from_bytes(sig[:32], "big"),
+            int.from_bytes(sig[32:], "big"),
+        )
+    except (ValueError, TypeError, IndexError):
+        return (None, 0, 0, 0)
+
+
+class ServeServer:
+    """The verification service: TCP front, quota admission, shared
+    verdict cache, receipts.  Lifecycle mirrors DebugServer::
+
+        async with ServeServer(engine, tenants, port=0) as srv:
+            ...  # connect to 127.0.0.1:{srv.port}
+
+    ``slo_burning`` is the shed signal — a callable returning the list
+    of SLOs burning in the fast window (``SloEvaluator.burning``); None
+    disables shedding.  ``receipts`` is an optional
+    :class:`~tpunode.receipts.ReceiptLog`.
+    """
+
+    def __init__(
+        self,
+        engine,
+        tenants: Sequence[TenantConfig],
+        port: int = 0,
+        host: str = "127.0.0.1",
+        slo_burning: Optional[Callable[[], list]] = None,
+        receipts=None,
+        cache_entries: int = DEFAULT_CACHE,
+    ):
+        self._engine = engine
+        self._slo_burning = slo_burning
+        self._receipts = receipts
+        self._want_port = port
+        self.host = host
+        self.port: Optional[int] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+        # registry keys come from the ONE bounded source of tenant=
+        # label values (tenant_names — analyzer-pinned); cfg order is
+        # registration order
+        self._tenants: "dict[str, _TenantState]" = {}
+        cfgs = list(tenants)
+        for tname in tenant_names(cfgs):
+            for cfg in cfgs:
+                if cfg.name == tname:
+                    self._tenants[tname] = _TenantState(cfg)
+        # shared verdict-cache tier: key -> asyncio.Future[bool].  An
+        # unresolved future IS the in-flight marker — duplicates
+        # coalesce on it (exactly one verify per unique item), and the
+        # LRU pins it against eviction exactly like the mempool pins
+        # PENDING entries (same extracted structure, same 2x ceiling).
+        self._cache: SeenLru = SeenLru(
+            max(1, cache_entries), pinned=lambda f: not f.done()
+        )
+        self._conns = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> "ServeServer":
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self._want_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("[Serve] listening on %s:%d (%d tenants)",
+                 self.host, self.port, len(self._tenants))
+        return self
+
+    async def aclose(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            with contextlib.suppress(Exception):
+                await self._server.wait_closed()
+            self._server = None
+        # labeled-series lifecycle (ISSUE 19): retire this service's
+        # tenant= series so a churned registry can't grow the registry
+        for tname in tenant_names(st.cfg for st in self._tenants.values()):
+            metrics.drop_label("tenant", tname)
+
+    async def __aenter__(self) -> "ServeServer":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.aclose()
+
+    # -- wire ----------------------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._conns += 1
+        try:
+            while True:
+                try:
+                    hdr = await reader.readexactly(4)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                size = int.from_bytes(hdr, "big")
+                if size > _MAX_FRAME:
+                    self._send(writer, {"ok": False, "error": "frame-too-large"})
+                    return
+                body = await reader.readexactly(size)
+                try:
+                    frame = json.loads(body)
+                    if not isinstance(frame, dict):
+                        raise ValueError("frame must be an object")
+                except ValueError as e:
+                    self._send(writer, {
+                        "ok": False, "error": f"bad-frame: {str(e)[:100]}",
+                    })
+                    return
+                reply = await self._handle_frame(frame)
+                reply["id"] = frame.get("id")
+                self._send(writer, reply)
+                await writer.drain()
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # one frame's bug must not kill the service
+            log.exception("[Serve] frame handling failed")
+            with contextlib.suppress(Exception):
+                self._send(writer, {"ok": False, "error": "internal"})
+        finally:
+            self._conns -= 1
+            with contextlib.suppress(Exception):
+                writer.close()
+
+    @staticmethod
+    def _send(writer: asyncio.StreamWriter, obj: dict) -> None:
+        data = json.dumps(obj, separators=(",", ":")).encode()
+        writer.write(len(data).to_bytes(4, "big") + data)
+
+    # -- admission + dispatch ------------------------------------------------
+
+    def _shed_class(self) -> Optional[str]:
+        """The priority class admission sheds under burn: the LOWEST
+        class any registered tenant occupies — but never ``block``
+        (live-ingest-equivalent traffic is what shedding protects)."""
+        present = {st.cfg.priority for st in self._tenants.values()}
+        for p in reversed(PRIORITIES):
+            if p in present:
+                return p if p != "block" else None
+        return None
+
+    async def _handle_frame(self, frame: dict) -> dict:
+        t0 = time.monotonic()
+        tname = frame.get("tenant")
+        st = self._tenants.get(tname) if isinstance(tname, str) else None
+        if st is None or not hmac.compare_digest(
+            str(frame.get("token", "")), st.cfg.token
+        ):
+            metrics.inc("serve.auth_failures")
+            return {"ok": False, "error": "auth"}
+        st.frames += 1
+        metrics.inc("serve.frames", labels={"tenant": tname})
+
+        # decode the submission (either pre-extracted rows or raw txs)
+        rows = frame.get("items")
+        raws = frame.get("raw")
+        try:
+            keys, items, per_tx = self._decode(rows, raws)
+        except ValueError as e:
+            return {"ok": False, "error": str(e)[:200]}
+        n = len(keys)
+        st.items += n
+        metrics.inc("serve.items", n, labels={"tenant": tname})
+        if n == 0:
+            return {"ok": True, "verdicts": []}
+
+        # QoS shed (before any quota spend): under fast-window SLO burn
+        # the lowest-class tenants are refused with explicit error
+        # verdicts — the mempool's verdict contract at the network edge
+        if self._slo_burning is not None:
+            burning = self._slo_burning()
+            if burning and st.cfg.priority == self._shed_class():
+                st.shed += n
+                metrics.inc(
+                    "serve.shed", n,
+                    labels={"tenant": tname, "reason": "slo-burn"},
+                )
+                events.emit(
+                    "serve.shed", tenant=tname, reason="slo-burn",
+                    items=n, burning=burning[:4],
+                )
+                return {
+                    "ok": False, "error": "shed", "reason": "slo-burn",
+                    "verdicts": [None] * len(per_tx if raws else keys),
+                }
+
+        # quota admission: token bucket, then the inflight-items cap —
+        # a refusal is an explicit throttle reply, never a silent drop
+        retry = st.bucket.take(n, t0)
+        if retry > 0.0:
+            st.throttled += n
+            metrics.inc(
+                "serve.throttled", n,
+                labels={"tenant": tname, "reason": "rate"},
+            )
+            return {
+                "ok": False, "error": "throttled", "reason": "rate",
+                "retry_after": round(min(retry, 3600.0), 4),
+            }
+        if st.inflight + n > st.cfg.max_inflight:
+            st.throttled += n
+            metrics.inc(
+                "serve.throttled", n,
+                labels={"tenant": tname, "reason": "inflight"},
+            )
+            return {"ok": False, "error": "throttled", "reason": "inflight"}
+
+        # shared verdict-cache pass: resolved futures are free hits,
+        # unresolved ones coalesce this frame onto the first submitter's
+        # in-flight verify; misses become OUR futures to resolve
+        futs: list = []
+        fresh_futs: list = []
+        fresh_keys: list = []
+        fresh_items: list = []
+        hits = 0
+        for key, item in zip(keys, items):
+            fut = self._cache.get(key)
+            if fut is not None:
+                self._cache.touch(key)
+                hits += 1
+                futs.append(fut)
+                continue
+            fut = asyncio.get_running_loop().create_future()
+            self._cache.insert(key, fut)
+            futs.append(fut)
+            fresh_futs.append(fut)
+            fresh_keys.append(key)
+            fresh_items.append((key, item))
+        if hits:
+            st.cache_hits += hits
+            metrics.inc("serve.cache_hits", hits, labels={"tenant": tname})
+
+        if fresh_items:
+            st.inflight += len(fresh_items)
+            st.verified += len(fresh_items)
+            metrics.inc(
+                "serve.verified", len(fresh_items), labels={"tenant": tname}
+            )
+            try:
+                verdicts = await self._engine.verify(
+                    [it for _, it in fresh_items],
+                    priority=st.cfg.priority,
+                    tenant=tname,
+                )
+            except Exception as e:
+                # engine failure: un-cache the keys this frame owns (a
+                # retry must re-verify, not inherit a dead future), fail
+                # only OUR futures (coalescers on them learn the error;
+                # futures owned by other in-flight frames are theirs to
+                # resolve) and answer with an explicit error
+                for key, _ in fresh_items:
+                    self._cache.pop(key)
+                err = f"verify-failed: {type(e).__name__}: {e}"[:200]
+                for fut in fresh_futs:
+                    if not fut.done():
+                        fut.set_exception(RuntimeError(err))
+                        fut.add_done_callback(lambda f: f.exception())
+                return {"ok": False, "error": err}
+            finally:
+                st.inflight -= len(fresh_items)
+            for fut, verdict in zip(fresh_futs, verdicts):
+                if not fut.done():
+                    fut.set_result(bool(verdict))
+            self._append_receipt(fresh_keys, verdicts)
+
+        # gather (ours resolve immediately; coalesced may still wait)
+        try:
+            flat = [bool(await f) for f in futs]
+        except Exception as e:
+            return {"ok": False, "error": f"verify-failed: {e}"[:200]}
+
+        if raws:
+            # raw-tx form: one verdict per submitted transaction — all
+            # of its extracted signatures must pass (inputs that extract
+            # nothing contribute nothing, same as the node's own path)
+            out = []
+            pos = 0
+            for count in per_tx:
+                # all() over the tx's extracted items — vacuously True
+                # for zero extractable signatures, same as the node's
+                # own verify-what's-extractable contract
+                out.append(all(flat[pos : pos + count]))
+                pos += count
+        else:
+            out = flat
+        dt = time.monotonic() - t0
+        metrics.observe("serve.latency", dt, labels={"tenant": tname})
+        return {"ok": True, "verdicts": out, "cached": hits}
+
+    def _decode(self, rows, raws) -> tuple:
+        """Wire submission -> (cache keys, VerifyItem tuples, per-tx item
+        counts).  ``per_tx`` is only meaningful for the raw form."""
+        if (rows is None) == (raws is None):
+            raise ValueError("frame needs exactly one of items=/raw=")
+        keys: list = []
+        items: list = []
+        per_tx: list = []
+        if rows is not None:
+            if not isinstance(rows, list) or len(rows) > _MAX_ITEMS:
+                raise ValueError(f"items must be a list of <= {_MAX_ITEMS}")
+            for row in rows:
+                if not isinstance(row, (list, tuple)) or len(row) != 3:
+                    raise ValueError("item rows are [digest, pubkey, sig]")
+                keys.append(
+                    hashlib.sha256(
+                        "|".join(str(c) for c in row).encode()
+                    ).digest()
+                )
+                items.append(_parse_row(row))
+            return keys, items, per_tx
+        if not isinstance(raws, list) or len(raws) > _MAX_ITEMS:
+            raise ValueError(f"raw must be a list of <= {_MAX_ITEMS}")
+        for txhex in raws:
+            try:
+                raw = bytes.fromhex(txhex)
+                tx = Tx.deserialize(Reader(raw))
+                sig_items, _stats = extract_sig_items(tx)
+            except Exception as e:
+                raise ValueError(f"bad raw tx: {str(e)[:100]}")
+            base = double_sha256(raw)
+            per_tx.append(len(sig_items))
+            for i, si in enumerate(sig_items):
+                keys.append(hashlib.sha256(base + i.to_bytes(4, "big")).digest())
+                items.append(si.verify_item)
+        if len(keys) > _MAX_ITEMS:
+            raise ValueError(f"raw txs expand past {_MAX_ITEMS} items")
+        return keys, items, per_tx
+
+    def _append_receipt(self, fresh_keys: list, verdicts: list) -> None:
+        if self._receipts is None:
+            return
+        batch = hashlib.sha256(b"".join(fresh_keys)).digest()
+        vdig = hashlib.sha256(
+            bytes(1 if v else 0 for v in verdicts)
+        ).digest()
+        try:
+            self._receipts.append(
+                batch, vdig, _kernel_modes_now(),
+                getattr(self._engine, "last_rung", "none"),
+            )
+        except Exception:
+            # the receipt log failing must not fail verify replies —
+            # but it must be LOUD (a quiet receipt gap is exactly what
+            # the chain exists to rule out)
+            log.exception("[Serve] receipt append failed")
+            events.emit("serve.receipt_error")
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The ``stats()["serve"]`` / ``/serve`` endpoint snapshot."""
+        ledger = {}
+        led = getattr(self._engine, "ledger", None)
+        if callable(led):
+            snap = led()
+            ledger = {
+                "busy_seconds": snap.get("busy_seconds", 0.0),
+                "charged_seconds": snap.get("charged_seconds", 0.0),
+                "by_tenant": snap.get("by_tenant", {}),
+            }
+        return {
+            "port": self.port,
+            "connections": self._conns,
+            "tenants": {
+                tname: st.snapshot() for tname, st in self._tenants.items()
+            },
+            "cache": {
+                "entries": len(self._cache),
+                "max_entries": self._cache.max_entries,
+            },
+            "spend": ledger,
+            "receipts": (
+                self._receipts.stats() if self._receipts is not None else None
+            ),
+        }

@@ -596,6 +596,10 @@ class CostLedger:
         # charged to the EXECUTING host, so a stolen lane bills the
         # thief and per-host shares stay truthful under heavy stealing
         self._by_host: dict[str, float] = {}
+        # tenant -> [charged seconds, items]: serve-layer attribution
+        # (ISSUE 20).  Unattributed items bill to the node itself under
+        # the "" key, so conservation holds over the tenant axis too.
+        self._by_tenant: dict[str, list] = {}
 
     def charge(
         self,
@@ -604,12 +608,23 @@ class CostLedger:
         dt: float,
         rung: str,
         host: Optional[str] = None,
+        tenants: Optional[dict] = None,
     ) -> None:
         if total <= 0 or dt < 0:
             return
         shares = [
             (p, n, dt * n / total) for p, n in class_counts.items() if n > 0
         ]
+        tenant_shares = []
+        if tenants:
+            tenant_items = 0
+            for t, n in tenants.items():
+                if n > 0:
+                    tenant_shares.append((t, n, dt * n / total))
+                    tenant_items += n
+            rest = total - tenant_items
+            if rest > 0:
+                tenant_shares.append(("", rest, dt * rest / total))
         with self._lock:
             self._busy += dt
             if host is not None:
@@ -618,6 +633,12 @@ class CostLedger:
                 cell = self._cells.get((p, rung))
                 if cell is None:
                     cell = self._cells[(p, rung)] = [0.0, 0]
+                cell[0] += share
+                cell[1] += n
+            for t, n, share in tenant_shares:
+                cell = self._by_tenant.get(t)
+                if cell is None:
+                    cell = self._by_tenant[t] = [0.0, 0]
                 cell[0] += share
                 cell[1] += n
         host_labels = {} if host is None else {"host": host}
@@ -640,6 +661,7 @@ class CostLedger:
             cells = {k: list(v) for k, v in self._cells.items()}
             busy = self._busy
             by_host = dict(self._by_host)
+            by_tenant = {k: list(v) for k, v in self._by_tenant.items()}
         charged = sum(v[0] for v in cells.values())
         by_class: dict[str, dict] = {}
         for (p, rung), (secs, items) in sorted(cells.items()):
@@ -663,6 +685,13 @@ class CostLedger:
             # fleet mode only (ISSUE 19): busy seconds by EXECUTING host
             out["by_host"] = {
                 h: round(s, 6) for h, s in sorted(by_host.items())
+            }
+        if by_tenant:
+            # serve mode only (ISSUE 20): charged seconds + items by
+            # tenant ("" = the node's own share of tenant-mixed lanes)
+            out["by_tenant"] = {
+                t: {"seconds": round(v[0], 6), "items": v[1]}
+                for t, v in sorted(by_tenant.items())
             }
         return out
 
@@ -701,6 +730,7 @@ class VerifyEngine:
         # _dispatch_multi's (payloads, target) call shape).
         self._ledger = CostLedger()
         self._tls = threading.local()
+        self._last_rung = "none"  # rung of the latest served batch
         self._lane_tasks: set[asyncio.Task] = set()
         self._slots: Optional[asyncio.Semaphore] = None
         self._kick: Optional[asyncio.Event] = None
@@ -909,6 +939,12 @@ class VerifyEngine:
         seconds + the conservation pin — also under stats()["ledger"]."""
         return self._ledger.snapshot()
 
+    @property
+    def last_rung(self) -> str:
+        """The ladder rung that served the most recent batch ("none"
+        before any dispatch) — what a verdict receipt binds (ISSUE 20)."""
+        return self._last_rung
+
     def stats(self) -> dict:
         """Telemetry snapshot for Node.stats()/health()."""
         out = {
@@ -1045,31 +1081,37 @@ class VerifyEngine:
         items: Sequence[VerifyItem],
         priority: str = "bulk",
         affinity: Optional[int] = None,
+        tenant: Optional[str] = None,
     ) -> list[bool]:
         """Queue items; resolves when their lanes have been verified.
         ``priority``: ``block`` > ``mempool`` > ``bulk`` (sched.py) — the
         class whose lanes pack and dispatch first under saturation.
         ``affinity`` (fleet mode, ISSUE 19): a ``sched.affinity_key``
         routing this submission to its home host's packer — a placement
-        hint only, never a correctness input."""
-        return await self._enqueue(list(items), priority, affinity)
+        hint only, never a correctness input.  ``tenant`` (serve mode,
+        ISSUE 20): the registered tenant this submission's rung time
+        bills to in the cost ledger."""
+        return await self._enqueue(list(items), priority, affinity, tenant)
 
     async def verify_raw(
         self,
         raw,
         priority: str = "bulk",
         affinity: Optional[int] = None,
+        tenant: Optional[str] = None,
     ) -> list[bool]:
         """Queue a packed batch (RawBatch, or anything `as_raw_batch`
         coerces, e.g. txextract.RawSigItems): the native-extract fast path —
         no per-item Python objects anywhere between wire bytes and device."""
-        return await self._enqueue(as_raw_batch(raw), priority, affinity)
+        return await self._enqueue(as_raw_batch(raw), priority, affinity,
+                                   tenant)
 
     async def _enqueue(
         self,
         payload,
         priority: str = "bulk",
         affinity: Optional[int] = None,
+        tenant: Optional[str] = None,
     ) -> list[bool]:
         if not len(payload):
             return []
@@ -1083,7 +1125,8 @@ class VerifyEngine:
             tr = act[0]
             rec = tr.begin("verify.queue", act[1], items=len(payload))
             fut.add_done_callback(lambda _f, tr=tr, rec=rec: tr.end(rec))
-        sub = Submission(payload, fut, act, priority, affinity=affinity)
+        sub = Submission(payload, fut, act, priority, affinity=affinity,
+                         tenant=tenant)
         if self._fleet is not None:
             # host-affine route (ISSUE 19): keyed submissions land in
             # their home host's packer; keyless work stays central
@@ -1328,10 +1371,11 @@ class VerifyEngine:
             self._inflight[token] = time.monotonic()
         try:
             classes = lane.class_counts()
+            tenants = lane.tenant_counts()
             try:
                 results = await asyncio.to_thread(
                     self._dispatch_traced, payloads, lane.target, lane.act0,
-                    host, None, classes,
+                    host, None, classes, tenants,
                 )
             except HostLost as e:
                 assert host is not None and self._fleet is not None
@@ -1347,7 +1391,7 @@ class VerifyEngine:
                 results = await asyncio.to_thread(
                     self._dispatch_traced, payloads, lane.target, lane.act0,
                     None, "cpu" if self._cpu is not None else "oracle",
-                    classes,
+                    classes, tenants,
                 )
         except asyncio.CancelledError:
             # engine teardown mid-dispatch: waiters must not hang on a
@@ -1386,15 +1430,17 @@ class VerifyEngine:
         host: Optional[_HostState] = None,
         backend: Optional[str] = None,
         classes: Optional[dict] = None,
+        tenants: Optional[dict] = None,
     ) -> list[bool]:
         """Worker-thread entry: re-activate the submitting item's trace
         (contextvars do not cross ``to_thread`` from the queue loop — the
         loop's own context has no trace) so the dispatch/prepare/transfer/
         kernel/readback spans land in the item's pipeline tree.
-        ``classes`` (the lane's per-priority item counts) rides a
-        thread-local into _dispatch_multi's ledger charge — this IS the
-        dispatch thread."""
+        ``classes`` (the lane's per-priority item counts) and ``tenants``
+        (per-tenant counts, serve mode) ride a thread-local into
+        _dispatch_multi's ledger charge — this IS the dispatch thread."""
         self._tls.classes = classes
+        self._tls.tenants = tenants
         try:
             with _activate_trace(act):
                 if host is None and backend is None:
@@ -1407,6 +1453,7 @@ class VerifyEngine:
                 )
         finally:
             self._tls.classes = None
+            self._tls.tenants = None
 
     def _pick(self, n: int, host: Optional[_HostState] = None) -> str:
         """Resolve the starting backend rung for one batch.  Never blocks
@@ -1493,7 +1540,12 @@ class VerifyEngine:
             self._ledger.charge(
                 classes if classes else {"bulk": total}, total, dt, served,
                 host=host.name if host is not None else None,
+                tenants=getattr(self._tls, "tenants", None),
             )
+            # the rung that actually served the latest batch: what a
+            # verdict receipt binds (ISSUE 20) — best-effort under
+            # concurrency, exact in the serve bench's cpu-proxy shape
+            self._last_rung = served
             events.emit(
                 "verify.dispatch", backend=served, size=total,
                 occupancy=round(occupancy, 4) if occupancy is not None else None,

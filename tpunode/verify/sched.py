@@ -214,7 +214,7 @@ class Submission:
 
     __slots__ = (
         "payload", "n", "fut", "act", "priority", "enqueued",
-        "taken", "results", "remaining", "failed", "affinity",
+        "taken", "results", "remaining", "failed", "affinity", "tenant",
     )
 
     def __init__(
@@ -225,6 +225,7 @@ class Submission:
         priority: str,
         enqueued: Optional[float] = None,
         affinity: Optional[int] = None,
+        tenant: Optional[str] = None,
     ):
         if priority not in PRIORITIES:
             raise ValueError(
@@ -236,6 +237,9 @@ class Submission:
         self.act = act
         self.priority = priority
         self.affinity = affinity
+        # serve-layer attribution (ISSUE 20): the registered tenant this
+        # submission bills to, None for the node's own traffic
+        self.tenant = tenant
         self.enqueued = time.monotonic() if enqueued is None else enqueued
         self.taken = 0  # items already claimed into lanes
         self.results: list = [None] * self.n
@@ -312,6 +316,16 @@ class PackedLane:
         out: dict[str, int] = {}
         for sub, lo, hi in self.slices:
             out[sub.priority] = out.get(sub.priority, 0) + (hi - lo)
+        return out
+
+    def tenant_counts(self) -> dict[str, int]:
+        """Items per serve-layer tenant carried by this lane (ISSUE 20)
+        — empty for pure node traffic, so the ledger's tenant table only
+        exists when the serve subsystem is live."""
+        out: dict[str, int] = {}
+        for sub, lo, hi in self.slices:
+            if sub.tenant is not None:
+                out[sub.tenant] = out.get(sub.tenant, 0) + (hi - lo)
         return out
 
 
